@@ -1,0 +1,21 @@
+"""petastorm_tpu: a TPU-native Parquet data access framework for deep learning.
+
+Capability parity with petastorm (reference mounted at /root/reference), built
+TPU-first: datasets materialize to Parquet with a unified schema+codec system and
+read back through parallel prefetch/decode worker pools into sharded ``jax.Array``
+batches staged onto a TPU mesh.
+
+Top-level API mirrors the reference (petastorm/__init__.py:15-19):
+``make_reader``, ``make_batch_reader``, ``TransformSpec``, ``NoDataAvailableError``.
+"""
+
+from petastorm_tpu.errors import NoDataAvailableError  # noqa: F401
+from petastorm_tpu.transform import TransformSpec  # noqa: F401
+
+import importlib.util as _importlib_util
+
+if _importlib_util.find_spec('petastorm_tpu.reader') is not None:
+    # reader lands in a later build stage; schema/codec layer is usable without it
+    from petastorm_tpu.reader import make_reader, make_batch_reader  # noqa: F401
+
+__version__ = '0.1.0'

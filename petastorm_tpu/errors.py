@@ -1,0 +1,20 @@
+"""Framework-level exceptions.
+
+Parity: /root/reference/petastorm/errors.py:16 (``NoDataAvailableError``).
+"""
+
+
+class PetastormTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class NoDataAvailableError(PetastormTpuError):
+    """Raised when a reader configuration selects zero row groups.
+
+    For example when ``shard_count`` exceeds the number of row groups, or a
+    predicate/selector filters out every row group.
+    """
+
+
+class SchemaError(PetastormTpuError):
+    """Raised for schema definition / encoding / decoding violations."""

@@ -1,0 +1,52 @@
+"""User-supplied row/batch transforms executed on the decode workers.
+
+Parity: /root/reference/petastorm/transform.py:19-64 (``TransformSpec``,
+``transform_schema``). The transform runs on the CPU host inside the worker pool,
+*before* batches are staged toward the TPU, so its cost overlaps device compute.
+"""
+
+from __future__ import annotations
+
+from petastorm_tpu.unischema import Unischema, UnischemaField
+
+
+class TransformSpec(object):
+    """Describes a transform applied to each row dict (row readers) or each
+    column batch dict (batch readers) on the worker.
+
+    :param func: callable taking a row dict (or dict of column arrays for batch
+        readers) and returning the transformed dict. May be ``None`` if only
+        field editing/removal is needed.
+    :param edit_fields: list of :class:`UnischemaField` (or
+        ``(name, numpy_dtype, shape, nullable)`` tuples) added/replaced by ``func``.
+    :param removed_fields: names of fields ``func`` removes.
+    :param selected_fields: if not ``None``, an explicit post-transform field-name
+        whitelist (ordering of the resulting schema follows it).
+    """
+
+    def __init__(self, func=None, edit_fields=None, removed_fields=None, selected_fields=None):
+        self.func = func
+        self.edit_fields = [self._as_field(f) for f in (edit_fields or [])]
+        self.removed_fields = list(removed_fields or [])
+        self.selected_fields = list(selected_fields) if selected_fields is not None else None
+
+    @staticmethod
+    def _as_field(field_or_tuple):
+        if isinstance(field_or_tuple, UnischemaField):
+            return field_or_tuple
+        name, numpy_dtype, shape, nullable = field_or_tuple
+        return UnischemaField(name, numpy_dtype, shape, nullable=nullable)
+
+
+def transform_schema(schema, transform_spec):
+    """Derive the post-transform schema (reference transform.py:43-64)."""
+    removed = set(transform_spec.removed_fields)
+    edited = {f.name: f for f in transform_spec.edit_fields}
+    fields = {f.name: f for f in schema if f.name not in removed}
+    fields.update(edited)
+    if transform_spec.selected_fields is not None:
+        missing = [n for n in transform_spec.selected_fields if n not in fields]
+        if missing:
+            raise ValueError('selected_fields not present after transform: {}'.format(missing))
+        fields = {n: fields[n] for n in transform_spec.selected_fields}
+    return Unischema('{}_transformed'.format(schema.name), list(fields.values()))

@@ -1,0 +1,340 @@
+"""Unischema: a single schema definition rendered to numpy / Arrow / JAX views.
+
+Parity with the reference (/root/reference/petastorm/unischema.py):
+  * ``UnischemaField(name, numpy_dtype, shape, codec, nullable)`` (:35-80)
+  * ``Unischema`` with field attribute sugar (:180-186), ``create_schema_view``
+    (:188-229), cached namedtuple types (:83-103), ``from_arrow_schema`` (:291-340)
+  * ``dict_to_spark_row`` -> here ``encode_row`` (:343-383)
+  * ``insert_explicit_nulls`` (:386-401), ``match_unischema_fields`` (:404-441)
+
+TPU-first differences:
+  * Schemas serialize to JSON (``to_json``/``from_json``) instead of pickle, so
+    dataset metadata is language/version stable.
+  * ``as_arrow_schema`` replaces ``as_spark_schema`` — our writer is pyarrow-based.
+  * A row's in-memory form targets numpy arrays that can be staged into jax host
+    buffers without copies (C-contiguous, native byte order).
+"""
+
+from __future__ import annotations
+
+import re
+from collections import OrderedDict, namedtuple
+from decimal import Decimal
+
+import numpy as np
+import pyarrow as pa
+
+from petastorm_tpu.codecs import (DataFieldCodec, NdarrayCodec, ScalarCodec, ScalarListCodec,
+                                  codec_from_json)
+from petastorm_tpu.errors import SchemaError
+
+# ---------------------------------------------------------------------------
+# numpy dtype <-> stable JSON token
+# ---------------------------------------------------------------------------
+
+_SPECIAL_DTYPE_TOKENS = {
+    'string': np.str_,
+    'bytes': np.bytes_,
+    'decimal': Decimal,
+    'bool': np.bool_,
+    'datetime64': np.datetime64,
+}
+
+
+def _dtype_to_token(numpy_dtype):
+    for token, t in _SPECIAL_DTYPE_TOKENS.items():
+        if numpy_dtype is t:
+            return token
+    return np.dtype(numpy_dtype).str
+
+
+def _token_to_dtype(token):
+    if token in _SPECIAL_DTYPE_TOKENS:
+        return _SPECIAL_DTYPE_TOKENS[token]
+    return np.dtype(token).type
+
+
+class UnischemaField(object):
+    """A single field: name, numpy dtype, shape (``None`` entries are wildcards),
+    codec, nullability.
+
+    Equality/hash ignore the codec *instance* but compare codec JSON, mirroring the
+    reference's codec-insensitive semantics (unischema.py:58-80) while still
+    distinguishing storage formats.
+    """
+
+    __slots__ = ('name', 'numpy_dtype', 'shape', 'codec', 'nullable')
+
+    def __init__(self, name, numpy_dtype, shape=(), codec=None, nullable=False):
+        if codec is not None and not isinstance(codec, DataFieldCodec):
+            raise SchemaError('codec for field {} must be a DataFieldCodec, got {!r}'.format(name, codec))
+        self.name = name
+        self.numpy_dtype = numpy_dtype if numpy_dtype is Decimal else np.dtype(numpy_dtype).type
+        self.shape = tuple(shape) if shape is not None else None
+        self.codec = codec if codec is not None else self._default_codec()
+        self.nullable = bool(nullable)
+
+    def _default_codec(self):
+        if self.shape == ():
+            return ScalarCodec()
+        return NdarrayCodec()
+
+    @property
+    def is_scalar(self):
+        return self.shape == ()
+
+    def to_json(self):
+        return {
+            'name': self.name,
+            'numpy_dtype': _dtype_to_token(self.numpy_dtype),
+            'shape': list(self.shape) if self.shape is not None else None,
+            'codec': self.codec.to_json(),
+            'nullable': self.nullable,
+        }
+
+    @classmethod
+    def from_json(cls, spec):
+        return cls(
+            name=spec['name'],
+            numpy_dtype=_token_to_dtype(spec['numpy_dtype']),
+            shape=tuple(spec['shape']) if spec['shape'] is not None else None,
+            codec=codec_from_json(spec['codec']),
+            nullable=spec['nullable'],
+        )
+
+    def _key(self):
+        return (self.name, _dtype_to_token(self.numpy_dtype), self.shape, self.nullable)
+
+    def __eq__(self, other):
+        return isinstance(other, UnischemaField) and self._key() == other._key()
+
+    def __ne__(self, other):
+        return not self == other
+
+    def __hash__(self):
+        return hash(self._key())
+
+    def __repr__(self):
+        return 'UnischemaField(name={!r}, numpy_dtype={}, shape={}, codec={!r}, nullable={})'.format(
+            self.name, _dtype_to_token(self.numpy_dtype), self.shape, self.codec, self.nullable)
+
+
+class _NamedtupleCache(object):
+    """Cache namedtuple types by (schema name, field names) so repeated calls return
+    the *same* type object — required for type-identity sensitive consumers
+    (reference unischema.py:83-103)."""
+
+    _store = {}
+
+    @classmethod
+    def get(cls, parent_name, field_names):
+        key = (parent_name, tuple(field_names))
+        if key not in cls._store:
+            cls._store[key] = namedtuple(parent_name, field_names)
+        return cls._store[key]
+
+
+class Unischema(object):
+    """An ordered collection of :class:`UnischemaField`.
+
+    Field access sugar: ``schema.fields['id']`` or ``schema.id``.
+    """
+
+    def __init__(self, name, fields):
+        self._name = name
+        names = [f.name for f in fields]
+        dupes = sorted({n for n in names if names.count(n) > 1})
+        if dupes:
+            raise SchemaError('Duplicate field names in schema {}: {}'.format(name, dupes))
+        self._fields = OrderedDict((f.name, f) for f in sorted(fields, key=lambda f: f.name))
+        for f in self._fields.values():
+            if not hasattr(self, f.name):
+                setattr(self, f.name, f)
+
+    @property
+    def name(self):
+        return self._name
+
+    @property
+    def fields(self):
+        return self._fields
+
+    def create_schema_view(self, fields_or_patterns):
+        """Subset view by exact :class:`UnischemaField` instances, field names, or
+        regex patterns (reference unischema.py:188-229)."""
+        if isinstance(fields_or_patterns, Unischema):
+            raise SchemaError('create_schema_view expects a list of fields or patterns')
+        if isinstance(fields_or_patterns, str):
+            fields_or_patterns = [fields_or_patterns]
+        view_fields = []
+        for item in fields_or_patterns:
+            if isinstance(item, UnischemaField):
+                own = self._fields.get(item.name)
+                if own is None:
+                    raise SchemaError('Field {} does not belong to schema {}'.format(item.name, self._name))
+                if own != item:
+                    raise SchemaError(
+                        'Field {!r} does not match schema {}\'s definition {!r}'.format(item, self._name, own))
+                view_fields.append(own)
+            else:
+                matched = match_unischema_fields(self, [item])
+                if not matched:
+                    raise SchemaError('Pattern {!r} matched no fields in schema {}'.format(item, self._name))
+                view_fields.extend(matched)
+        # de-dup preserving order
+        seen = set()
+        unique = [f for f in view_fields if not (f.name in seen or seen.add(f.name))]
+        return Unischema('{}_view'.format(self._name), unique)
+
+    def make_namedtuple(self, **kwargs):
+        """Build a row namedtuple from per-field kwargs."""
+        return self.namedtuple(**{f: kwargs[f] for f in self._fields})
+
+    def make_namedtuple_from_dict(self, row_dict):
+        return self.namedtuple(**{f: row_dict[f] for f in self._fields})
+
+    @property
+    def namedtuple(self):
+        """The cached namedtuple type for rows of this schema."""
+        return _NamedtupleCache.get(self._name, list(self._fields))
+
+    def __iter__(self):
+        return iter(self._fields.values())
+
+    def __len__(self):
+        return len(self._fields)
+
+    def __repr__(self):
+        lines = ['Unischema({}, ['.format(self._name)]
+        lines.extend('  {!r},'.format(f) for f in self._fields.values())
+        lines.append('])')
+        return '\n'.join(lines)
+
+    # -- serialization ------------------------------------------------------
+
+    def to_json(self):
+        return {'name': self._name, 'fields': [f.to_json() for f in self._fields.values()]}
+
+    @classmethod
+    def from_json(cls, spec):
+        return cls(spec['name'], [UnischemaField.from_json(f) for f in spec['fields']])
+
+    # -- arrow rendering ----------------------------------------------------
+
+    def as_arrow_schema(self):
+        """Physical Arrow schema of the Parquet files this Unischema writes."""
+        return pa.schema([pa.field(f.name, f.codec.arrow_type(f), f.nullable) for f in self._fields.values()])
+
+    @classmethod
+    def from_arrow_schema(cls, arrow_schema, name='inferred', omit_unsupported_fields=True):
+        """Infer a Unischema for a plain (non-petastorm) Parquet store
+        (reference unischema.py:291-340). All fields come out as scalar columns;
+        list columns become 1-D variable-length arrays."""
+        fields = []
+        for arrow_field in arrow_schema:
+            try:
+                f = _unischema_field_from_arrow(arrow_field)
+            except SchemaError:
+                if omit_unsupported_fields:
+                    continue
+                raise
+            fields.append(f)
+        return cls(name, fields)
+
+
+_ARROW_TO_NUMPY = {
+    pa.int8(): np.int8, pa.uint8(): np.uint8,
+    pa.int16(): np.int16, pa.uint16(): np.uint16,
+    pa.int32(): np.int32, pa.uint32(): np.uint32,
+    pa.int64(): np.int64, pa.uint64(): np.uint64,
+    pa.float16(): np.float16, pa.float32(): np.float32, pa.float64(): np.float64,
+    pa.bool_(): np.bool_,
+    pa.string(): np.str_, pa.large_string(): np.str_,
+    pa.binary(): np.bytes_, pa.large_binary(): np.bytes_,
+    pa.date32(): np.datetime64, pa.date64(): np.datetime64,
+}
+
+
+def _numpy_from_arrow_type(arrow_type):
+    """Arrow type -> numpy type (reference unischema.py:444-477)."""
+    if arrow_type in _ARROW_TO_NUMPY:
+        return _ARROW_TO_NUMPY[arrow_type]
+    if pa.types.is_timestamp(arrow_type):
+        return np.datetime64
+    if pa.types.is_decimal(arrow_type):
+        return Decimal
+    if pa.types.is_dictionary(arrow_type):
+        return _numpy_from_arrow_type(arrow_type.value_type)
+    raise SchemaError('Cannot map Arrow type {} to numpy'.format(arrow_type))
+
+
+def _unischema_field_from_arrow(arrow_field):
+    t = arrow_field.type
+    if pa.types.is_list(t) or pa.types.is_large_list(t):
+        value_numpy = _numpy_from_arrow_type(t.value_type)
+        return UnischemaField(arrow_field.name, value_numpy, (None,),
+                             ScalarListCodec(), arrow_field.nullable)
+    numpy_dtype = _numpy_from_arrow_type(t)
+    return UnischemaField(arrow_field.name, numpy_dtype, (), ScalarCodec(), arrow_field.nullable)
+
+
+# ---------------------------------------------------------------------------
+# Row encode / null handling / field matching
+# ---------------------------------------------------------------------------
+
+def encode_row(schema, row_dict):
+    """Encode an in-memory row dict into the Parquet storage representation,
+    validating against the schema (reference ``dict_to_spark_row``,
+    unischema.py:343-383)."""
+    if not isinstance(row_dict, dict):
+        raise SchemaError('row must be a dict, got {}'.format(type(row_dict)))
+    unknown = set(row_dict.keys()) - set(schema.fields.keys())
+    if unknown:
+        raise SchemaError('Row contains fields not in schema {}: {}'.format(schema.name, sorted(unknown)))
+    full = dict(row_dict)
+    insert_explicit_nulls(schema, full)
+    encoded = {}
+    for field in schema:
+        value = full[field.name]
+        if value is None:
+            if not field.nullable:
+                raise SchemaError('Field {} is not nullable but got None'.format(field.name))
+            encoded[field.name] = None
+        else:
+            encoded[field.name] = field.codec.encode(field, value)
+    return encoded
+
+
+def insert_explicit_nulls(schema, row_dict):
+    """Add ``None`` for absent nullable fields, raise on absent non-nullable ones
+    (reference unischema.py:386-401)."""
+    for field in schema:
+        if field.name not in row_dict:
+            if field.nullable:
+                row_dict[field.name] = None
+            else:
+                raise SchemaError('Field {} is not nullable but is missing from the row'.format(field.name))
+
+
+def match_unischema_fields(schema, field_regex):
+    """Return fields whose names fully match any of the given regex patterns
+    (reference unischema.py:404-441 — fullmatch semantics, no legacy prefix mode)."""
+    if isinstance(field_regex, str):
+        field_regex = [field_regex]
+    compiled = [re.compile(p) for p in field_regex]
+    return [f for f in schema if any(p.fullmatch(f.name) for p in compiled)]
+
+
+def decode_row(row_dict, schema):
+    """Decode a storage row dict into the in-memory representation
+    (reference utils.py:54-87)."""
+    decoded = {}
+    for field_name, encoded in row_dict.items():
+        field = schema.fields.get(field_name)
+        if field is None:
+            raise SchemaError('Row contains field {!r} not present in schema {}'.format(field_name, schema.name))
+        if encoded is None:
+            decoded[field_name] = None
+        else:
+            decoded[field_name] = field.codec.decode(field, encoded)
+    return decoded

@@ -1,0 +1,151 @@
+"""Codec unit tests (modeled on reference tests/test_codec_*.py)."""
+
+from decimal import Decimal
+
+import numpy as np
+import pytest
+
+from petastorm_tpu.codecs import (CompressedImageCodec, CompressedNdarrayCodec, NdarrayCodec,
+                                  ScalarCodec, codec_from_json)
+from petastorm_tpu.errors import SchemaError
+from petastorm_tpu.unischema import UnischemaField
+
+
+def _field(name='f', dtype=np.uint8, shape=(2, 3), codec=None, nullable=False):
+    return UnischemaField(name, dtype, shape, codec, nullable)
+
+
+class TestScalarCodec:
+    def test_int_roundtrip(self):
+        codec = ScalarCodec()
+        field = _field(dtype=np.int32, shape=(), codec=codec)
+        encoded = codec.encode(field, 42)
+        assert codec.decode(field, encoded) == np.int32(42)
+
+    def test_string_roundtrip(self):
+        codec = ScalarCodec()
+        field = _field(dtype=np.str_, shape=(), codec=codec)
+        assert codec.decode(field, codec.encode(field, 'abc')) == 'abc'
+
+    def test_decimal_roundtrip(self):
+        codec = ScalarCodec()
+        field = _field(dtype=Decimal, shape=(), codec=codec)
+        encoded = codec.encode(field, Decimal('123.45'))
+        assert codec.decode(field, '123.45') == Decimal('123.45')
+        assert isinstance(encoded, Decimal) or isinstance(encoded, str)
+
+    def test_storage_dtype_override(self):
+        codec = ScalarCodec(dtype=np.int16)
+        field = _field(dtype=np.int64, shape=(), codec=codec)
+        import pyarrow as pa
+        assert codec.arrow_type(field) == pa.int16()
+
+    def test_rejects_non_scalar_field(self):
+        codec = ScalarCodec()
+        field = _field(dtype=np.int32, shape=(2,), codec=NdarrayCodec())
+        with pytest.raises(SchemaError):
+            codec.encode(field, np.zeros(2, dtype=np.int32))
+
+
+class TestNdarrayCodec:
+    def test_roundtrip(self):
+        codec = NdarrayCodec()
+        field = _field(dtype=np.float32, shape=(3, 4), codec=codec)
+        arr = np.arange(12, dtype=np.float32).reshape(3, 4)
+        out = codec.decode(field, codec.encode(field, arr))
+        np.testing.assert_array_equal(out, arr)
+        assert out.dtype == np.float32
+
+    def test_wildcard_shape(self):
+        codec = NdarrayCodec()
+        field = _field(dtype=np.int64, shape=(None, 2), codec=codec)
+        arr = np.zeros((7, 2), dtype=np.int64)
+        np.testing.assert_array_equal(codec.decode(field, codec.encode(field, arr)), arr)
+
+    def test_wrong_rank_raises(self):
+        codec = NdarrayCodec()
+        field = _field(dtype=np.int64, shape=(None, 2), codec=codec)
+        with pytest.raises(SchemaError):
+            codec.encode(field, np.zeros((7,), dtype=np.int64))
+
+    def test_wrong_dim_raises(self):
+        codec = NdarrayCodec()
+        field = _field(dtype=np.int64, shape=(None, 2), codec=codec)
+        with pytest.raises(SchemaError):
+            codec.encode(field, np.zeros((7, 3), dtype=np.int64))
+
+    def test_wrong_dtype_raises(self):
+        codec = NdarrayCodec()
+        field = _field(dtype=np.float32, shape=(2,), codec=codec)
+        with pytest.raises(SchemaError):
+            codec.encode(field, np.zeros(2, dtype=np.float64))
+
+
+class TestCompressedNdarrayCodec:
+    def test_roundtrip(self):
+        codec = CompressedNdarrayCodec()
+        field = _field(dtype=np.float64, shape=(100, 10), codec=codec)
+        arr = np.random.default_rng(1).random((100, 10))
+        out = codec.decode(field, codec.encode(field, arr))
+        np.testing.assert_array_equal(out, arr)
+
+    def test_compresses_redundant_data(self):
+        codec = CompressedNdarrayCodec()
+        raw = NdarrayCodec()
+        field = _field(dtype=np.float64, shape=(1000,), codec=codec)
+        arr = np.zeros(1000)
+        assert len(codec.encode(field, arr)) < len(raw.encode(field, arr))
+
+
+class TestCompressedImageCodec:
+    def test_png_lossless_roundtrip(self, rng):
+        codec = CompressedImageCodec('png')
+        field = _field(dtype=np.uint8, shape=(32, 16, 3), codec=codec)
+        img = rng.integers(0, 255, (32, 16, 3), dtype=np.uint8)
+        out = codec.decode(field, codec.encode(field, img))
+        np.testing.assert_array_equal(out, img)  # png is lossless; RGB order preserved
+
+    def test_grayscale_roundtrip(self, rng):
+        codec = CompressedImageCodec('png')
+        field = _field(dtype=np.uint8, shape=(32, 16), codec=codec)
+        img = rng.integers(0, 255, (32, 16), dtype=np.uint8)
+        out = codec.decode(field, codec.encode(field, img))
+        np.testing.assert_array_equal(out, img)
+
+    def test_jpeg_lossy_close(self, rng):
+        codec = CompressedImageCodec('jpeg', quality=95)
+        field = _field(dtype=np.uint8, shape=(64, 64, 3), codec=codec)
+        img = np.full((64, 64, 3), 128, dtype=np.uint8)
+        out = codec.decode(field, codec.encode(field, img))
+        assert out.shape == img.shape
+        assert np.abs(out.astype(int) - img.astype(int)).mean() < 5
+
+    def test_uint16_png(self, rng):
+        codec = CompressedImageCodec('png')
+        field = _field(dtype=np.uint16, shape=(8, 8), codec=codec)
+        img = rng.integers(0, 2 ** 16 - 1, (8, 8), dtype=np.uint16)
+        out = codec.decode(field, codec.encode(field, img))
+        np.testing.assert_array_equal(out, img)
+
+    def test_uint16_jpeg_rejected(self):
+        codec = CompressedImageCodec('jpeg')
+        field = _field(dtype=np.uint16, shape=(8, 8), codec=codec)
+        with pytest.raises(SchemaError):
+            codec.encode(field, np.zeros((8, 8), dtype=np.uint16))
+
+    def test_bad_format_rejected(self):
+        with pytest.raises(SchemaError):
+            CompressedImageCodec('webm')
+
+
+def test_codec_json_roundtrip():
+    for codec in [ScalarCodec(), ScalarCodec(dtype=np.int16), NdarrayCodec(),
+                  CompressedNdarrayCodec(), CompressedImageCodec('jpeg', quality=77)]:
+        restored = codec_from_json(codec.to_json())
+        assert restored.to_json() == codec.to_json()
+        assert type(restored) is type(codec)
+
+
+def test_unknown_codec_id_raises():
+    with pytest.raises(SchemaError):
+        codec_from_json({'codec_id': 'nope'})

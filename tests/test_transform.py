@@ -1,0 +1,47 @@
+"""TransformSpec tests (modeled on reference tests/test_transform.py)."""
+
+import numpy as np
+import pytest
+
+from petastorm_tpu.codecs import ScalarCodec
+from petastorm_tpu.transform import TransformSpec, transform_schema
+from petastorm_tpu.unischema import Unischema, UnischemaField
+
+
+def _schema():
+    return Unischema('S', [
+        UnischemaField('a', np.int32, (), ScalarCodec(), False),
+        UnischemaField('b', np.float32, (10,), None, False),
+        UnischemaField('c', np.str_, (), ScalarCodec(), False),
+    ])
+
+
+def test_remove_field():
+    spec = TransformSpec(removed_fields=['c'])
+    out = transform_schema(_schema(), spec)
+    assert set(out.fields) == {'a', 'b'}
+
+
+def test_edit_field_tuple_form():
+    spec = TransformSpec(edit_fields=[('b', np.float64, (5,), False)])
+    out = transform_schema(_schema(), spec)
+    assert out.fields['b'].numpy_dtype is np.float64
+    assert out.fields['b'].shape == (5,)
+
+
+def test_add_field():
+    spec = TransformSpec(edit_fields=[UnischemaField('d', np.int64, (), None, False)])
+    out = transform_schema(_schema(), spec)
+    assert 'd' in out.fields
+
+
+def test_selected_fields():
+    spec = TransformSpec(selected_fields=['c', 'a'])
+    out = transform_schema(_schema(), spec)
+    assert set(out.fields) == {'a', 'c'}
+
+
+def test_selected_missing_raises():
+    spec = TransformSpec(removed_fields=['c'], selected_fields=['c'])
+    with pytest.raises(ValueError):
+        transform_schema(_schema(), spec)

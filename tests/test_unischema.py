@@ -1,0 +1,222 @@
+"""Unischema unit tests (modeled on reference petastorm/tests/test_unischema.py)."""
+
+from decimal import Decimal
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from petastorm_tpu.codecs import CompressedImageCodec, NdarrayCodec, ScalarCodec
+from petastorm_tpu.errors import SchemaError
+from petastorm_tpu.unischema import (Unischema, UnischemaField, decode_row, encode_row,
+                                     insert_explicit_nulls, match_unischema_fields)
+
+
+def _sample_schema():
+    return Unischema('Sample', [
+        UnischemaField('id', np.int64, (), ScalarCodec(), False),
+        UnischemaField('name', np.str_, (), ScalarCodec(), False),
+        UnischemaField('image', np.uint8, (16, 32, 3), CompressedImageCodec('png'), False),
+        UnischemaField('embedding', np.float32, (None, 8), NdarrayCodec(), True),
+    ])
+
+
+def test_fields_sorted_and_attribute_access():
+    schema = _sample_schema()
+    assert list(schema.fields) == ['embedding', 'id', 'image', 'name']
+    assert schema.id.numpy_dtype is np.int64
+    assert schema.fields['image'].shape == (16, 32, 3)
+
+
+def test_field_equality_ignores_codec_instance():
+    f1 = UnischemaField('x', np.int32, (), ScalarCodec(), False)
+    f2 = UnischemaField('x', np.int32, (), ScalarCodec(), False)
+    assert f1 == f2
+    assert hash(f1) == hash(f2)
+    f3 = UnischemaField('x', np.int64, (), ScalarCodec(), False)
+    assert f1 != f3
+
+
+def test_create_schema_view_by_name_and_field():
+    schema = _sample_schema()
+    view = schema.create_schema_view(['id', schema.image])
+    assert set(view.fields) == {'id', 'image'}
+
+
+def test_create_schema_view_regex():
+    schema = _sample_schema()
+    view = schema.create_schema_view(['i.*'])
+    assert set(view.fields) == {'id', 'image'}
+
+
+def test_create_schema_view_no_match_raises():
+    schema = _sample_schema()
+    with pytest.raises(SchemaError):
+        schema.create_schema_view(['nonexistent_field'])
+
+
+def test_match_unischema_fields_fullmatch():
+    schema = _sample_schema()
+    # 'i' alone must NOT match 'id' (fullmatch semantics)
+    assert match_unischema_fields(schema, ['i']) == []
+    names = {f.name for f in match_unischema_fields(schema, ['id', 'na.*'])}
+    assert names == {'id', 'name'}
+
+
+def test_namedtuple_type_identity():
+    schema = _sample_schema()
+    assert schema.namedtuple is schema.namedtuple
+    row = schema.make_namedtuple(id=1, name='a', image=None, embedding=None)
+    assert row.id == 1
+
+
+def test_json_roundtrip():
+    schema = _sample_schema()
+    restored = Unischema.from_json(schema.to_json())
+    assert list(restored.fields) == list(schema.fields)
+    for name in schema.fields:
+        assert restored.fields[name] == schema.fields[name]
+        assert restored.fields[name].codec.to_json() == schema.fields[name].codec.to_json()
+
+
+def test_json_roundtrip_special_dtypes():
+    schema = Unischema('S', [
+        UnischemaField('d', Decimal, (), ScalarCodec(), False),
+        UnischemaField('s', np.str_, (), ScalarCodec(), False),
+        UnischemaField('b', np.bytes_, (), ScalarCodec(), False),
+        UnischemaField('t', np.datetime64, (), ScalarCodec(), False),
+    ])
+    restored = Unischema.from_json(schema.to_json())
+    assert restored.fields['d'].numpy_dtype is Decimal
+    assert restored.fields['s'].numpy_dtype is np.str_
+    assert restored.fields['t'].numpy_dtype is np.datetime64
+
+
+def test_encode_decode_row_roundtrip():
+    schema = _sample_schema()
+    image = np.random.default_rng(0).integers(0, 255, (16, 32, 3), dtype=np.uint8)
+    emb = np.arange(24, dtype=np.float32).reshape(3, 8)
+    row = {'id': 7, 'name': 'hello', 'image': image, 'embedding': emb}
+    encoded = encode_row(schema, row)
+    assert isinstance(encoded['image'], bytes)
+    decoded = decode_row(encoded, schema)
+    np.testing.assert_array_equal(decoded['image'], image)
+    np.testing.assert_array_equal(decoded['embedding'], emb)
+    assert decoded['id'] == 7
+    assert decoded['name'] == 'hello'
+
+
+def test_encode_row_unknown_field_raises():
+    schema = _sample_schema()
+    with pytest.raises(SchemaError):
+        encode_row(schema, {'bogus': 1})
+
+
+def test_encode_row_missing_non_nullable_raises():
+    schema = _sample_schema()
+    with pytest.raises(SchemaError):
+        encode_row(schema, {'id': 1})
+
+
+def test_insert_explicit_nulls():
+    schema = Unischema('S', [
+        UnischemaField('a', np.int32, (), ScalarCodec(), False),
+        UnischemaField('b', np.int32, (), ScalarCodec(), True),
+    ])
+    row = {'a': 1}
+    insert_explicit_nulls(schema, row)
+    assert row == {'a': 1, 'b': None}
+
+
+def test_nullable_field_encodes_none():
+    schema = _sample_schema()
+    image = np.zeros((16, 32, 3), dtype=np.uint8)
+    encoded = encode_row(schema, {'id': 1, 'name': 'x', 'image': image, 'embedding': None})
+    assert encoded['embedding'] is None
+    decoded = decode_row(encoded, schema)
+    assert decoded['embedding'] is None
+
+
+def test_as_arrow_schema():
+    schema = _sample_schema()
+    arrow = schema.as_arrow_schema()
+    assert arrow.field('id').type == pa.int64()
+    assert arrow.field('name').type == pa.string()
+    assert arrow.field('image').type == pa.binary()
+    assert arrow.field('embedding').nullable
+
+
+def test_from_arrow_schema_inference():
+    arrow = pa.schema([
+        pa.field('i32', pa.int32()),
+        pa.field('f64', pa.float64()),
+        pa.field('s', pa.string()),
+        pa.field('ts', pa.timestamp('us')),
+        pa.field('dec', pa.decimal128(10, 2)),
+        pa.field('lst', pa.list_(pa.int64())),
+    ])
+    schema = Unischema.from_arrow_schema(arrow)
+    assert schema.fields['i32'].numpy_dtype is np.int32
+    assert schema.fields['ts'].numpy_dtype is np.datetime64
+    assert schema.fields['dec'].numpy_dtype is Decimal
+    assert schema.fields['lst'].shape == (None,)
+
+
+def test_from_arrow_schema_unsupported_omitted():
+    arrow = pa.schema([
+        pa.field('ok', pa.int32()),
+        pa.field('bad', pa.struct([pa.field('x', pa.int32())])),
+    ])
+    schema = Unischema.from_arrow_schema(arrow)
+    assert list(schema.fields) == ['ok']
+    with pytest.raises(SchemaError):
+        Unischema.from_arrow_schema(arrow, omit_unsupported_fields=False)
+
+
+def test_duplicate_field_names_raise():
+    with pytest.raises(SchemaError):
+        Unischema('S', [
+            UnischemaField('x', np.int32, (), ScalarCodec(), False),
+            UnischemaField('x', np.float64, (), ScalarCodec(), False),
+        ])
+
+
+def test_create_schema_view_bare_string():
+    schema = Unischema('S', [
+        UnischemaField('a', np.int32, (), ScalarCodec(), False),
+        UnischemaField('b', np.int32, (), ScalarCodec(), False),
+        UnischemaField('ab', np.int32, (), ScalarCodec(), False),
+    ])
+    view = schema.create_schema_view('ab')  # single pattern, not chars 'a','b'
+    assert list(view.fields) == ['ab']
+
+
+def test_create_schema_view_mismatched_field_raises():
+    schema = _sample_schema()
+    with pytest.raises(SchemaError):
+        schema.create_schema_view([UnischemaField('id', np.float64, (5,), None, False)])
+
+
+def test_decode_row_unknown_field_raises_schema_error():
+    schema = _sample_schema()
+    with pytest.raises(SchemaError):
+        decode_row({'bogus': b'x'}, schema)
+
+
+def test_inferred_list_field_roundtrips():
+    arrow = pa.schema([pa.field('lst', pa.list_(pa.int64()))])
+    schema = Unischema.from_arrow_schema(arrow)
+    field = schema.fields['lst']
+    arr = np.array([1, 2, 3], dtype=np.int64)
+    encoded = field.codec.encode(field, arr)
+    np.testing.assert_array_equal(field.codec.decode(field, encoded), arr)
+    assert schema.as_arrow_schema().field('lst').type == pa.list_(pa.int64())
+
+
+def test_decimal_encodes_as_string():
+    schema = Unischema('S', [UnischemaField('d', Decimal, (), ScalarCodec(), False)])
+    encoded = encode_row(schema, {'d': Decimal('1.5')})
+    assert isinstance(encoded['d'], str)
+    # and it is writable into the declared arrow column type
+    pa.array([encoded['d']], type=schema.as_arrow_schema().field('d').type)
+    assert decode_row(encoded, schema)['d'] == Decimal('1.5')
