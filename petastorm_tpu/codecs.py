@@ -143,7 +143,10 @@ class ScalarCodec(DataFieldCodec):
             if value.shape != ():
                 raise SchemaError('Field {} expects a scalar, got array of shape {}'.format(field.name, value.shape))
             value = value[()]
-        return dtype(value).item() if dtype is not np.datetime64 else np.datetime64(value)
+        if dtype is np.datetime64:
+            # normalize to ns precision: the physical column is timestamp('ns')
+            return np.datetime64(value, 'ns')
+        return dtype(value).item()
 
     def decode(self, field, encoded):
         dtype = field.numpy_dtype

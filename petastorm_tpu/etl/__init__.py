@@ -1,0 +1,16 @@
+"""ETL: dataset materialization, metadata, and row-group indexing.
+
+Parity: /root/reference/petastorm/etl/ — minus the Spark dependency. Datasets are
+written by a local pyarrow-backed writer (optionally parallelized over a worker
+pool); metadata lives as JSON strings in the Parquet ``_common_metadata``
+key-value footer instead of pickles.
+"""
+
+from petastorm_tpu.etl.dataset_metadata import (  # noqa: F401
+    materialize_dataset, write_petastorm_dataset, DatasetWriter,
+    get_schema, get_schema_from_dataset_url, infer_or_load_unischema,
+    load_row_groups, RowGroupPiece, PetastormMetadataError,
+)
+from petastorm_tpu.etl.rowgroup_indexing import build_rowgroup_index, get_row_group_indexes  # noqa: F401
+from petastorm_tpu.etl.rowgroup_indexers import SingleFieldIndexer, FieldNotNullIndexer  # noqa: F401
+from petastorm_tpu.etl.indexer_base import RowGroupIndexerBase  # noqa: F401

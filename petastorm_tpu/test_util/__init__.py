@@ -1,0 +1,1 @@
+"""Test utilities: synthetic dataset writers and reader mocks."""

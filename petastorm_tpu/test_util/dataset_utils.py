@@ -1,0 +1,130 @@
+"""Synthetic dataset generation for tests and benchmarks.
+
+Mirrors the reference's central fixture pattern (tests/test_common.py:38-157):
+a rich ``TestSchema`` exercising scalars, images, ndarrays, nullable and
+variable-shape fields, written to a local tmpdir with real Parquet — no cluster.
+"""
+
+from __future__ import annotations
+
+from decimal import Decimal
+
+import numpy as np
+
+from petastorm_tpu.codecs import (CompressedImageCodec, CompressedNdarrayCodec, NdarrayCodec,
+                                  ScalarCodec)
+from petastorm_tpu.etl.dataset_metadata import materialize_dataset
+from petastorm_tpu.etl.rowgroup_indexers import FieldNotNullIndexer, SingleFieldIndexer
+from petastorm_tpu.etl.rowgroup_indexing import build_rowgroup_index
+from petastorm_tpu.unischema import Unischema, UnischemaField
+
+TestSchema = Unischema('TestSchema', [
+    UnischemaField('partition_key', np.str_, (), ScalarCodec(), False),
+    UnischemaField('id', np.int64, (), ScalarCodec(), False),
+    UnischemaField('id2', np.int32, (), ScalarCodec(), False),
+    UnischemaField('id_float', np.float64, (), ScalarCodec(), False),
+    UnischemaField('id_odd', np.bool_, (), ScalarCodec(), False),
+    UnischemaField('python_primitive_uint8', np.uint8, (), ScalarCodec(), False),
+    UnischemaField('image_png', np.uint8, (128, 256, 3), CompressedImageCodec('png'), False),
+    UnischemaField('matrix', np.float32, (32, 16, 3), NdarrayCodec(), False),
+    UnischemaField('decimal', Decimal, (), ScalarCodec(), False),
+    UnischemaField('matrix_uint16', np.uint16, (2, 3), NdarrayCodec(), False),
+    UnischemaField('matrix_string', np.bytes_, (None,), NdarrayCodec(), False),
+    UnischemaField('empty_matrix_string', np.bytes_, (None,), NdarrayCodec(), False),
+    UnischemaField('matrix_nullable', np.uint16, (None, 14), NdarrayCodec(), True),
+    UnischemaField('sensor_name', np.str_, (1,), NdarrayCodec(), False),
+    UnischemaField('string_array_nullable', np.str_, (None,), NdarrayCodec(), True),
+    UnischemaField('compressed_matrix', np.float32, (10,), CompressedNdarrayCodec(), False),
+])
+
+
+def create_test_row(idx, rng, image_shape=(128, 256, 3)):
+    """One synthetic TestSchema row (reference tests/test_common.py:59-94)."""
+    nullable_matrix = None if idx % 5 == 0 else rng.integers(
+        0, 2 ** 16 - 1, (rng.integers(1, 10), 14), dtype=np.uint16)
+    nullable_strings = None if idx % 3 == 0 else np.asarray(
+        ['a' * (idx % 7), 'bc', ''][:(idx % 3) + 1], dtype=np.str_)
+    return {
+        'partition_key': 'p_{}'.format(idx % 10),
+        'id': idx,
+        'id2': idx % 231,
+        'id_float': float(idx),
+        'id_odd': bool(idx % 2),
+        'python_primitive_uint8': (idx % 255),
+        'image_png': rng.integers(0, 255, image_shape, dtype=np.uint8),
+        'matrix': rng.random((32, 16, 3), dtype=np.float32),
+        'decimal': Decimal('{}.{}'.format(idx, idx % 100)),
+        'matrix_uint16': rng.integers(0, 2 ** 16 - 1, (2, 3), dtype=np.uint16),
+        'matrix_string': np.asarray([b'row', b'of', b'strings'][:idx % 3 + 1], dtype=np.bytes_),
+        'empty_matrix_string': np.asarray([], dtype=np.bytes_),
+        'matrix_nullable': nullable_matrix,
+        'sensor_name': np.asarray(['sensor_{}'.format(idx % 4)], dtype=np.str_),
+        'string_array_nullable': nullable_strings,
+        'compressed_matrix': rng.random(10, dtype=np.float32),
+    }
+
+
+def create_test_dataset(dataset_url, num_rows=100, rows_per_row_group=10, rows_per_file=30,
+                        seed=0, build_indexes=True, image_shape=(128, 256, 3)):
+    """Write the synthetic TestSchema dataset and (optionally) its row-group
+    indexes (reference tests/test_common.py:97-157)."""
+    rng = np.random.default_rng(seed)
+    rows = [create_test_row(i, rng, image_shape) for i in range(num_rows)]
+    with materialize_dataset(dataset_url, TestSchema, rows_per_row_group=rows_per_row_group,
+                             rows_per_file=rows_per_file) as writer:
+        for row in rows:
+            writer.write(row)
+    if build_indexes:
+        build_rowgroup_index(dataset_url, [
+            SingleFieldIndexer('id_index', 'id'),
+            SingleFieldIndexer('sensor_name_index', 'sensor_name'),
+            SingleFieldIndexer('partition_index', 'partition_key'),
+            FieldNotNullIndexer('matrix_nullable_index', 'matrix_nullable'),
+        ])
+    return rows
+
+
+def create_scalar_dataset(dataset_url, num_rows=100, rows_per_row_group=10, seed=0,
+                          partition_by=None):
+    """Plain scalar-only dataset for the batch-reader path
+    (reference tests/conftest.py scalar_dataset, test_common.py:160-245)."""
+    import datetime
+    rng = np.random.default_rng(seed)
+    schema = Unischema('ScalarSchema', [
+        UnischemaField('id', np.int64, (), ScalarCodec(), False),
+        UnischemaField('int_fixed_size_list', np.int64, (3,), NdarrayCodec(), False),
+        UnischemaField('float64', np.float64, (), ScalarCodec(), False),
+        UnischemaField('string', np.str_, (), ScalarCodec(), False),
+        UnischemaField('string2', np.str_, (), ScalarCodec(), False),
+        UnischemaField('datetime', np.datetime64, (), ScalarCodec(), True),
+    ])
+    rows = [{
+        'id': i,
+        'int_fixed_size_list': np.arange(3, dtype=np.int64) + i,
+        'float64': float(i) * 0.66,
+        'string': 'hello_{}'.format(i),
+        'string2': 'world_{}'.format(i % 5),
+        'datetime': np.datetime64(datetime.date(2020, 1, 1 + i % 28)),
+    } for i in range(num_rows)]
+    # write as a PLAIN parquet store (no petastorm metadata): exercise inference
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    from petastorm_tpu.fs import FilesystemResolver
+    resolver = FilesystemResolver(dataset_url)
+    fs, root = resolver.filesystem(), resolver.get_dataset_path()
+    fs.create_dir(root, recursive=True)
+    table = pa.Table.from_pydict({
+        'id': [r['id'] for r in rows],
+        'int_fixed_size_list': [list(r['int_fixed_size_list']) for r in rows],
+        'float64': [r['float64'] for r in rows],
+        'string': [r['string'] for r in rows],
+        'string2': [r['string2'] for r in rows],
+        'datetime': [r['datetime'].astype('datetime64[us]').item() for r in rows],
+    })
+    if partition_by:
+        pq.write_to_dataset(table, root, partition_cols=partition_by, filesystem=fs,
+                            row_group_size=rows_per_row_group)
+    else:
+        with fs.open_output_stream(root + '/data-00000.parquet') as sink:
+            pq.write_table(table, sink, row_group_size=rows_per_row_group)
+    return rows, schema

@@ -21,7 +21,8 @@ class TransformSpec(object):
         ``(name, numpy_dtype, shape, nullable)`` tuples) added/replaced by ``func``.
     :param removed_fields: names of fields ``func`` removes.
     :param selected_fields: if not ``None``, an explicit post-transform field-name
-        whitelist (ordering of the resulting schema follows it).
+        whitelist. (Note: the resulting schema's fields are name-sorted, as in any
+        Unischema — selection controls membership, not ordering.)
     """
 
     def __init__(self, func=None, edit_fields=None, removed_fields=None, selected_fields=None):

@@ -58,9 +58,10 @@ class UnischemaField(object):
     """A single field: name, numpy dtype, shape (``None`` entries are wildcards),
     codec, nullability.
 
-    Equality/hash ignore the codec *instance* but compare codec JSON, mirroring the
-    reference's codec-insensitive semantics (unischema.py:58-80) while still
-    distinguishing storage formats.
+    Equality/hash compare (name, dtype, shape, nullable) and deliberately ignore
+    the codec, mirroring the reference's codec-insensitive semantics
+    (unischema.py:58-80): two fields holding the same logical data are equal
+    regardless of on-disk storage format.
     """
 
     __slots__ = ('name', 'numpy_dtype', 'shape', 'codec', 'nullable')

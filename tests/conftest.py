@@ -20,3 +20,33 @@ import pytest  # noqa: E402
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)
+
+
+class SyntheticDataset(object):
+    def __init__(self, url, data, path):
+        self.url = url
+        self.data = data  # list of row dicts (in-memory representation)
+        self.path = path
+
+
+@pytest.fixture(scope='session')
+def synthetic_dataset(tmp_path_factory):
+    """100-row TestSchema dataset with row-group indexes
+    (mirrors reference tests/conftest.py:86-120)."""
+    from petastorm_tpu.test_util.dataset_utils import create_test_dataset
+    path = tmp_path_factory.mktemp('synthetic_dataset')
+    url = 'file://' + str(path)
+    data = create_test_dataset(url, num_rows=100, rows_per_row_group=10, rows_per_file=30)
+    return SyntheticDataset(url=url, data=data, path=str(path))
+
+
+@pytest.fixture(scope='session')
+def scalar_dataset(tmp_path_factory):
+    """Plain (non-petastorm) parquet store for the batch-reader path."""
+    from petastorm_tpu.test_util.dataset_utils import create_scalar_dataset
+    path = tmp_path_factory.mktemp('scalar_dataset')
+    url = 'file://' + str(path)
+    data, schema = create_scalar_dataset(url, num_rows=100, rows_per_row_group=10)
+    ds = SyntheticDataset(url=url, data=data, path=str(path))
+    ds.schema = schema
+    return ds

@@ -149,3 +149,13 @@ def test_codec_json_roundtrip():
 def test_unknown_codec_id_raises():
     with pytest.raises(SchemaError):
         codec_from_json({'codec_id': 'nope'})
+
+
+def test_datetime_scalar_writable_to_arrow_column():
+    import pyarrow as pa
+    codec = ScalarCodec()
+    field = _field(dtype=np.datetime64, shape=(), codec=codec)
+    # second-precision input must normalize to ns so it fits timestamp('ns')
+    encoded = codec.encode(field, np.datetime64('2024-01-02T03:04:05'))
+    pa.array([encoded], type=codec.arrow_type(field))
+    assert codec.decode(field, encoded) == np.datetime64('2024-01-02T03:04:05', 'ns')

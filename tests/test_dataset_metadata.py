@@ -1,0 +1,128 @@
+"""Write path + metadata tests (modeled on reference etl tests)."""
+
+import json
+
+import numpy as np
+import pyarrow.parquet as pq
+import pytest
+
+from petastorm_tpu.codecs import NdarrayCodec, ScalarCodec
+from petastorm_tpu.etl.dataset_metadata import (PetastormMetadataError, get_schema,
+                                                infer_or_load_unischema, load_row_groups,
+                                                materialize_dataset, read_metadata_value,
+                                                write_petastorm_dataset, ROW_GROUPS_PER_FILE_KEY)
+from petastorm_tpu.fs import FilesystemResolver, path_to_url
+from petastorm_tpu.unischema import Unischema, UnischemaField
+
+
+def _small_schema():
+    return Unischema('Small', [
+        UnischemaField('id', np.int64, (), ScalarCodec(), False),
+        UnischemaField('vec', np.float32, (4,), NdarrayCodec(), False),
+    ])
+
+
+def _rows(n):
+    return [{'id': i, 'vec': np.full(4, i, dtype=np.float32)} for i in range(n)]
+
+
+def test_write_and_load_row_groups(tmp_path):
+    url = path_to_url(tmp_path / 'ds')
+    write_petastorm_dataset(url, _small_schema(), _rows(25), rows_per_row_group=10)
+    pieces = load_row_groups(url)
+    assert len(pieces) == 3  # 10 + 10 + 5
+    schema = get_schema(url)
+    assert list(schema.fields) == ['id', 'vec']
+
+
+def test_rows_per_file_splits_files(tmp_path):
+    url = path_to_url(tmp_path / 'ds')
+    write_petastorm_dataset(url, _small_schema(), _rows(30), rows_per_row_group=5, rows_per_file=10)
+    pieces = load_row_groups(url)
+    assert len(pieces) == 6
+    assert len({p.path for p in pieces}) == 3
+
+
+def test_row_group_counts_metadata_written(tmp_path):
+    url = path_to_url(tmp_path / 'ds')
+    write_petastorm_dataset(url, _small_schema(), _rows(20), rows_per_row_group=10)
+    raw = read_metadata_value(url, ROW_GROUPS_PER_FILE_KEY)
+    counts = json.loads(raw.decode())
+    assert sum(counts.values()) == 2
+
+
+def test_load_row_groups_footer_fallback(tmp_path):
+    """Without _common_metadata, fall back to parallel footer reads."""
+    url = path_to_url(tmp_path / 'ds')
+    write_petastorm_dataset(url, _small_schema(), _rows(25), rows_per_row_group=10)
+    (tmp_path / 'ds' / '_common_metadata').unlink()
+    pieces = load_row_groups(url)
+    assert len(pieces) == 3
+    assert all(p.num_rows in (10, 5) for p in pieces)
+
+
+def test_get_schema_missing_metadata_raises(tmp_path):
+    url = path_to_url(tmp_path / 'ds')
+    write_petastorm_dataset(url, _small_schema(), _rows(5), rows_per_row_group=5)
+    (tmp_path / 'ds' / '_common_metadata').unlink()
+    with pytest.raises(PetastormMetadataError):
+        get_schema(url)
+
+
+def test_infer_schema_plain_parquet(scalar_dataset):
+    schema = infer_or_load_unischema(scalar_dataset.url)
+    assert schema.fields['id'].numpy_dtype is np.int64
+    assert schema.fields['string'].numpy_dtype is np.str_
+    assert schema.fields['int_fixed_size_list'].shape == (None,)
+
+
+def test_partitioned_write_and_pieces(tmp_path):
+    url = path_to_url(tmp_path / 'ds')
+    schema = Unischema('P', [
+        UnischemaField('part', np.int64, (), ScalarCodec(), False),
+        UnischemaField('value', np.float64, (), ScalarCodec(), False),
+    ])
+    rows = [{'part': i % 3, 'value': float(i)} for i in range(30)]
+    write_petastorm_dataset(url, schema, rows, rows_per_row_group=5, partition_by=['part'])
+    pieces = load_row_groups(url)
+    assert len(pieces) == 6  # 3 partitions x 10 rows / 5-per-rg
+    parts = {p.partition_keys.get('part') for p in pieces}
+    assert parts == {0, 1, 2}
+    # partition column is NOT in the physical files
+    some_file = pieces[0].path
+    pf = pq.ParquetFile(some_file)
+    assert 'part' not in pf.schema_arrow.names
+
+
+def test_materialize_empty_dataset_raises(tmp_path):
+    url = path_to_url(tmp_path / 'ds')
+    with pytest.raises(PetastormMetadataError):
+        with materialize_dataset(url, _small_schema()):
+            pass
+
+
+def test_filesystem_resolver_schemes(tmp_path):
+    fs_local = FilesystemResolver('file://' + str(tmp_path))
+    assert fs_local.get_dataset_path() == str(tmp_path)
+    from petastorm_tpu.errors import PetastormTpuError
+    with pytest.raises(PetastormTpuError):
+        FilesystemResolver(str(tmp_path))  # scheme-less rejected
+    with pytest.raises(PetastormTpuError):
+        FilesystemResolver('ftp://host/x')
+
+
+def test_resolver_picklable(tmp_path):
+    import pickle
+    resolver = FilesystemResolver('file://' + str(tmp_path))
+    restored = pickle.loads(pickle.dumps(resolver))
+    assert restored.get_dataset_path() == str(tmp_path)
+    factory = resolver.filesystem_factory()
+    assert factory() is not None
+
+
+def test_synthetic_dataset_fixture(synthetic_dataset):
+    pieces = load_row_groups(synthetic_dataset.url)
+    assert len(pieces) == 10  # 100 rows / 10 per row group
+    assert len({p.path for p in pieces}) == 4  # 30 rows per file -> 4 files
+    schema = get_schema(synthetic_dataset.url)
+    assert 'image_png' in schema.fields

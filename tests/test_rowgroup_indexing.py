@@ -1,0 +1,62 @@
+"""Row-group indexing tests (modeled on reference tests/test_rowgroup_indexing.py)."""
+
+import pytest
+
+from petastorm_tpu.errors import PetastormTpuError
+from petastorm_tpu.etl.rowgroup_indexers import FieldNotNullIndexer, SingleFieldIndexer
+from petastorm_tpu.etl.rowgroup_indexing import build_rowgroup_index, get_row_group_indexes
+
+
+def test_indexes_loaded(synthetic_dataset):
+    indexes = get_row_group_indexes(synthetic_dataset.url)
+    assert set(indexes) == {'id_index', 'sensor_name_index', 'partition_index',
+                            'matrix_nullable_index'}
+
+
+def test_single_field_index_lookup(synthetic_dataset):
+    indexes = get_row_group_indexes(synthetic_dataset.url)
+    id_index = indexes['id_index']
+    # id=5 lives in row group 0 (rows 0-9 with 10 rows per group)
+    assert id_index.get_row_group_indexes(5) == {0}
+    assert id_index.get_row_group_indexes(95) == {9}
+    assert id_index.get_row_group_indexes(12345) == set()
+
+
+def test_sensor_name_index_covers_all_groups(synthetic_dataset):
+    indexes = get_row_group_indexes(synthetic_dataset.url)
+    # each group of 10 consecutive ids contains all 4 sensor names (idx % 4)
+    sensors = indexes['sensor_name_index']
+    for s in range(4):
+        assert indexes['sensor_name_index'].get_row_group_indexes('sensor_{}'.format(s)) == set(range(10))
+    assert sorted(sensors.indexed_values) == ['sensor_0', 'sensor_1', 'sensor_2', 'sensor_3']
+
+
+def test_not_null_index(synthetic_dataset):
+    indexes = get_row_group_indexes(synthetic_dataset.url)
+    # matrix_nullable is null when idx % 5 == 0; every group of 10 has non-null rows
+    assert indexes['matrix_nullable_index'].get_row_group_indexes() == set(range(10))
+
+
+def test_indexer_merge():
+    a = SingleFieldIndexer('ix', 'f')
+    a.build_index([{'f': 1}, {'f': 2}], piece_index=0)
+    b = SingleFieldIndexer('ix', 'f')
+    b.build_index([{'f': 2}, {'f': 3}], piece_index=1)
+    merged = a + b
+    assert merged.get_row_group_indexes(2) == {0, 1}
+    assert merged.get_row_group_indexes(1) == {0}
+    with pytest.raises(PetastormTpuError):
+        a + SingleFieldIndexer('ix', 'other_field')
+
+
+def test_not_null_indexer_merge():
+    a = FieldNotNullIndexer('ix', 'f')
+    a.build_index([{'f': None}], piece_index=0)
+    b = FieldNotNullIndexer('ix', 'f')
+    b.build_index([{'f': 3}], piece_index=1)
+    assert (a + b).get_row_group_indexes() == {1}
+
+
+def test_empty_indexers_raises(synthetic_dataset):
+    with pytest.raises(PetastormTpuError):
+        build_rowgroup_index(synthetic_dataset.url, [])
