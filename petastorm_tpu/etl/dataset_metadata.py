@@ -135,10 +135,12 @@ class DatasetWriter(object):
             self.write(row)
 
     def _partition_dir(self, encoded_row):
+        from urllib.parse import quote
         parts = []
         for key in self._partition_by:
             value = encoded_row[key]
-            parts.append('{}={}'.format(key, value))
+            # percent-escape like hive so '/' etc. cannot corrupt the path
+            parts.append('{}={}'.format(key, quote(str(value), safe='')))
         return '/'.join(parts)
 
     def close(self):
@@ -196,7 +198,7 @@ class _PartitionWriter(object):
         self._pq_writer = pq.ParquetWriter(sink, p._arrow_schema, compression=p._compression)
         self._cur_relpath = relpath
         self._rows_in_file = 0
-        p._row_groups_per_file[relpath] = 0
+        p._row_groups_per_file[relpath] = []
 
     def _flush_row_group(self):
         if self._buffered_rows == 0:
@@ -208,7 +210,7 @@ class _PartitionWriter(object):
                   for name in p._data_field_names]
         table = pa.Table.from_arrays(arrays, schema=p._arrow_schema)
         self._pq_writer.write_table(table)  # one call == one row group
-        p._row_groups_per_file[self._cur_relpath] += 1
+        p._row_groups_per_file[self._cur_relpath].append(self._buffered_rows)
         self._rows_in_file += self._buffered_rows
         self._buffer = {name: [] for name in p._data_field_names}
         self._buffered_bytes = 0
@@ -237,8 +239,12 @@ def materialize_dataset(dataset_url, schema, row_group_size_mb=None, rows_per_ro
     writer = DatasetWriter(dataset_url, schema, row_group_size_mb=row_group_size_mb,
                            rows_per_row_group=rows_per_row_group, rows_per_file=rows_per_file,
                            partition_by=partition_by, compression=compression)
-    yield writer
-    writer.close()
+    try:
+        yield writer
+    finally:
+        # always release ParquetWriters/output streams, even when the caller's
+        # with-body raises mid-write
+        writer.close()
     _write_dataset_metadata(dataset_url, schema, writer.row_groups_per_file)
     # validation read (reference :117-130)
     pieces = load_row_groups(dataset_url)
@@ -327,17 +333,27 @@ def list_parquet_files(fs, root):
     return sorted(files)
 
 
+def _parse_partition_value(v, dtype):
+    if dtype is np.str_:
+        return v
+    if dtype is np.bool_:
+        # np.bool_('False') is True; parse textually
+        return v.strip().lower() in ('true', '1')
+    return np.dtype(dtype).type(v).item()
+
+
 def _partition_keys_from_relpath(relpath, schema=None):
     """Parse hive-style ``key=value`` path components into typed partition keys."""
+    from urllib.parse import unquote
     keys = {}
     for component in relpath.split('/')[:-1]:
         if '=' not in component:
             continue
         k, v = component.split('=', 1)
+        v = unquote(v)
         if schema is not None and k in schema.fields:
-            dtype = schema.fields[k].numpy_dtype
             try:
-                keys[k] = np.dtype(dtype).type(v).item() if dtype not in (np.str_,) else v
+                keys[k] = _parse_partition_value(v, schema.fields[k].numpy_dtype)
             except (ValueError, TypeError):
                 keys[k] = v
         else:
@@ -358,10 +374,12 @@ def load_row_groups(dataset_url, schema=None, max_footer_read_threads=10):
     """
     resolver = FilesystemResolver(dataset_url)
     fs, root = resolver.filesystem(), resolver.get_dataset_path()
-    if schema is None:
-        schema = _try_get_schema(fs, root)
+    arrow_meta_schema = _read_common_metadata(fs, root)  # single read serves schema + counts
+    if schema is None and arrow_meta_schema is not None and arrow_meta_schema.metadata and \
+            UNISCHEMA_KEY in arrow_meta_schema.metadata:
+        schema = Unischema.from_json(
+            json.loads(arrow_meta_schema.metadata[UNISCHEMA_KEY].decode('utf-8')))
 
-    arrow_meta_schema = _read_common_metadata(fs, root)
     if arrow_meta_schema is not None and arrow_meta_schema.metadata and \
             ROW_GROUPS_PER_FILE_KEY in arrow_meta_schema.metadata:
         counts = json.loads(arrow_meta_schema.metadata[ROW_GROUPS_PER_FILE_KEY].decode('utf-8'))
@@ -369,8 +387,13 @@ def load_row_groups(dataset_url, schema=None, max_footer_read_threads=10):
         for relpath in sorted(counts):
             full = posixpath.join(root, relpath)
             partition_keys = _partition_keys_from_relpath(relpath, schema)
-            for rg in range(counts[relpath]):
-                pieces.append(RowGroupPiece(full, rg, partition_keys=partition_keys))
+            entry = counts[relpath]
+            # value is a list of per-row-group row counts (an int count is
+            # accepted for datasets written before row counts were recorded)
+            row_counts = entry if isinstance(entry, list) else [None] * entry
+            for rg, num_rows in enumerate(row_counts):
+                pieces.append(RowGroupPiece(full, rg, num_rows=num_rows,
+                                            partition_keys=partition_keys))
         return pieces
 
     summary_path = posixpath.join(root, _SUMMARY_METADATA)

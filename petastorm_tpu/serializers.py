@@ -1,0 +1,45 @@
+"""Payload serializers for the worker-process -> main-process results channel.
+
+Parity: /root/reference/petastorm/reader_impl/{pickle_serializer,
+pyarrow_serializer, arrow_table_serializer}.py. Pickle is the default;
+``ArrowTableSerializer`` moves columnar batches as Arrow IPC record-batch
+streams, which is zero-copy on the receive side.
+"""
+
+from __future__ import annotations
+
+import pickle
+
+import pyarrow as pa
+
+
+class PickleSerializer(object):
+    def serialize(self, obj):
+        return pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+
+    def deserialize(self, data):
+        return pickle.loads(data)
+
+
+class ArrowTableSerializer(object):
+    """Serializes ``pyarrow.Table`` payloads as IPC streams
+    (reference arrow_table_serializer.py:23-33). Non-table payloads (e.g.
+    exceptions) fall back to pickle with a marker byte."""
+
+    _TABLE = b'T'
+    _PICKLE = b'P'
+
+    def serialize(self, obj):
+        if isinstance(obj, pa.Table):
+            sink = pa.BufferOutputStream()
+            with pa.ipc.new_stream(sink, obj.schema) as writer:
+                writer.write_table(obj)
+            return self._TABLE + sink.getvalue().to_pybytes()
+        return self._PICKLE + pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+
+    def deserialize(self, data):
+        marker, body = data[:1], data[1:]
+        if marker == self._TABLE:
+            with pa.ipc.open_stream(pa.BufferReader(body)) as reader:
+                return reader.read_all()
+        return pickle.loads(body)

@@ -1,0 +1,64 @@
+"""Stub workers for pool protocol tests
+(reference /root/reference/petastorm/workers_pool/tests/stub_workers.py)."""
+
+from __future__ import annotations
+
+import time
+
+from petastorm_tpu.workers.worker_base import WorkerBase
+
+
+class IdentityWorker(WorkerBase):
+    """Publishes each ventilated value unchanged."""
+
+    def process(self, value):
+        self.publish(value)
+
+
+class DoubleOutputWorker(WorkerBase):
+    """Publishes two results per item."""
+
+    def process(self, value):
+        self.publish(value)
+        self.publish(value + 1000)
+
+
+class ZeroOutputWorker(WorkerBase):
+    """Consumes items without publishing anything."""
+
+    def process(self, value):
+        pass
+
+
+class SleepyIdentityWorker(WorkerBase):
+    """Sleeps then publishes — for concurrency/backpressure tests."""
+
+    def process(self, value, sleep_s=0.01):
+        time.sleep(sleep_s)
+        self.publish(value)
+
+
+class ExceptionEveryNWorker(WorkerBase):
+    """Raises on every item whose value % n == 0; args is n."""
+
+    def process(self, value):
+        n = self.args or 5
+        if value % n == 0:
+            raise ValueError('stub failure on {}'.format(value))
+        self.publish(value)
+
+
+class ArrowTableWorker(WorkerBase):
+    """Publishes a pyarrow table of n rows — for serializer tests."""
+
+    def process(self, n):
+        import numpy as np
+        import pyarrow as pa
+        self.publish(pa.table({'x': np.arange(n)}))
+
+
+class SetupArgsEchoWorker(WorkerBase):
+    """Publishes its setup args — verifies setup args survive process spawn."""
+
+    def process(self, value):
+        self.publish((value, self.args))

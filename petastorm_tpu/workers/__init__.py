@@ -1,0 +1,13 @@
+"""Execution runtime: worker pools and the ventilator.
+
+Parity: /root/reference/petastorm/workers_pool/ — a uniform
+``start/ventilate/get_results/stop/join`` pool protocol over threads, spawned
+processes (ZMQ transport), or the caller thread (dummy), fed by a
+``ConcurrentVentilator`` with bounded in-flight work.
+"""
+
+from petastorm_tpu.workers.worker_base import WorkerBase, EmptyResultError  # noqa: F401
+from petastorm_tpu.workers.thread_pool import ThreadPool  # noqa: F401
+from petastorm_tpu.workers.dummy_pool import DummyPool  # noqa: F401
+from petastorm_tpu.workers.process_pool import ProcessPool  # noqa: F401
+from petastorm_tpu.workers.ventilator import ConcurrentVentilator  # noqa: F401

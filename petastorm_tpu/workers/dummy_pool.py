@@ -1,0 +1,62 @@
+"""Dummy pool: synchronous execution on the caller thread.
+
+Parity: /root/reference/petastorm/workers_pool/dummy_pool.py:20-91. Exists for
+debugging and profiling — worker code runs where a profiler/debugger can see it.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+from petastorm_tpu.workers.worker_base import EmptyResultError
+
+
+class DummyPool(object):
+    def __init__(self, workers_count=1, results_queue_size=None):
+        self._results = deque()
+        self._worker = None
+        self._ventilator = None
+        self.workers_count = workers_count
+
+    def start(self, worker_class, worker_setup_args=None, ventilator=None):
+        if self._worker is not None:
+            raise RuntimeError('Pool already started')
+        self._worker = worker_class(0, self._results.append, worker_setup_args)
+        if ventilator is not None:
+            self._ventilator = ventilator
+            self._ventilator.start()
+
+    def ventilate(self, *args, **kwargs):
+        self._worker.process(*args, **kwargs)
+        if self._ventilator is not None:
+            self._ventilator.processed_item()
+
+    def get_results(self):
+        # give a lazy ventilator thread a chance to feed us before declaring empty
+        import time
+        while not self._results:
+            if self._ventilator is None or self._ventilator.completed():
+                # re-check: the ventilator may have appended a result between the
+                # emptiness check and completed() flipping true
+                if self._results:
+                    break
+                raise EmptyResultError()
+            time.sleep(0.001)
+        return self._results.popleft()
+
+    def stop(self):
+        if self._ventilator is not None:
+            self._ventilator.stop()
+
+    def join(self):
+        if self._worker is not None:
+            self._worker.shutdown()
+            self._worker = None
+
+    @property
+    def diagnostics(self):
+        return {'output_queue_size': len(self._results)}
+
+    @property
+    def results_qsize(self):
+        return len(self._results)

@@ -1,0 +1,259 @@
+"""Process pool: spawned worker processes with a ZMQ star topology.
+
+Parity: /root/reference/petastorm/workers_pool/process_pool.py —
+main PUSH -> workers (ventilate), main PUB -> workers (control),
+workers PUSH -> main PULL (results) (:52-74); spawn not fork (:15-17);
+startup handshake (:208-214); orphaned-worker suicide via a main-pid monitor
+thread (:324-331); slow-joiner-safe shutdown rebroadcasting FINISHED (:287-304);
+pluggable payload serializers; ``diagnostics`` (:306-314).
+
+Sockets are ipc:// endpoints in a private temp dir (lower latency than tcp
+loopback, no port conflicts).
+
+Note: workers are spawned, so (as with any ``multiprocessing`` spawn user)
+scripts creating a ProcessPool at module level must guard the pool-creating code
+with ``if __name__ == '__main__':`` — the child re-imports ``__main__``.
+"""
+
+from __future__ import annotations
+
+import logging
+import multiprocessing
+import os
+import pickle
+import shutil
+import sys
+import tempfile
+import threading
+import time
+
+import zmq
+
+from petastorm_tpu.serializers import PickleSerializer
+from petastorm_tpu.workers.worker_base import EmptyResultError, TimeoutWaitingForResultError
+
+logger = logging.getLogger(__name__)
+
+_CONTROL_FINISHED = b'FINISHED'
+_STARTED, _DATA, _DONE, _ERROR = b'S', b'D', b'F', b'E'
+
+_WORKER_STARTUP_TIMEOUT_S = 30
+_DEFAULT_RESULTS_HWM = 50
+
+
+class ProcessPool(object):
+    def __init__(self, workers_count, results_queue_size=_DEFAULT_RESULTS_HWM, serializer=None):
+        self._workers_count = workers_count
+        self._results_hwm = results_queue_size
+        self._serializer = serializer or PickleSerializer()
+        self._context = None
+        self._processes = []
+        self._ventilator = None
+        self._ventilated_items = 0
+        self._completed_items = 0
+        self._stopped = False
+        self._ipc_dir = None
+
+    @property
+    def workers_count(self):
+        return self._workers_count
+
+    def start(self, worker_class, worker_setup_args=None, ventilator=None):
+        if self._processes:
+            raise RuntimeError('Pool already started')
+        self._context = zmq.Context()
+        self._ipc_dir = tempfile.mkdtemp(prefix='pstpu_pool_')
+        vent_addr = 'ipc://' + os.path.join(self._ipc_dir, 'vent')
+        result_addr = 'ipc://' + os.path.join(self._ipc_dir, 'result')
+        control_addr = 'ipc://' + os.path.join(self._ipc_dir, 'control')
+
+        self._ventilator_send = self._context.socket(zmq.PUSH)
+        self._ventilator_send.setsockopt(zmq.LINGER, 0)
+        self._ventilator_send.bind(vent_addr)
+        self._results_receive = self._context.socket(zmq.PULL)
+        self._results_receive.setsockopt(zmq.RCVHWM, self._results_hwm)
+        self._results_receive.bind(result_addr)
+        self._control_send = self._context.socket(zmq.PUB)
+        self._control_send.setsockopt(zmq.LINGER, 0)
+        self._control_send.bind(control_addr)
+
+        # spawn (NOT fork): forked children inherit locked mutexes/threads from
+        # Arrow, JAX, etc. (reference process_pool.py:15-17 for the JVM analog)
+        ctx = multiprocessing.get_context('spawn')
+        setup_blob = pickle.dumps((worker_class, worker_setup_args, self._serializer),
+                                  protocol=pickle.HIGHEST_PROTOCOL)
+        for worker_id in range(self._workers_count):
+            p = ctx.Process(
+                target=_worker_bootstrap,
+                args=(worker_id, os.getpid(), setup_blob, vent_addr, result_addr, control_addr,
+                      self._results_hwm),
+                daemon=True)
+            p.start()
+            self._processes.append(p)
+
+        # startup handshake: wait until every worker connected and reported in
+        deadline = time.monotonic() + _WORKER_STARTUP_TIMEOUT_S
+        started = 0
+        while started < self._workers_count:
+            if time.monotonic() > deadline:
+                self.stop(); self.join()
+                raise TimeoutWaitingForResultError(
+                    'Only {} of {} workers started within {}s'.format(
+                        started, self._workers_count, _WORKER_STARTUP_TIMEOUT_S))
+            if self._results_receive.poll(100):
+                kind, _ = self._results_receive.recv_multipart()
+                if kind == _STARTED:
+                    started += 1
+
+        if ventilator is not None:
+            self._ventilator = ventilator
+            self._ventilator.start()
+
+    def ventilate(self, *args, **kwargs):
+        self._ventilated_items += 1
+        self._ventilator_send.send_pyobj((args, kwargs))
+
+    def get_results(self, timeout_s=60.0):
+        deadline = time.monotonic() + timeout_s
+        while True:
+            if not self._results_receive.poll(50):
+                if self._all_done():
+                    raise EmptyResultError()
+                if time.monotonic() > deadline:
+                    raise TimeoutWaitingForResultError(
+                        'No results from worker processes in {}s; {} items in flight'.format(
+                            timeout_s, self._ventilated_items - self._completed_items))
+                continue
+            kind, payload = self._results_receive.recv_multipart()
+            if kind == _DATA:
+                return self._serializer.deserialize(payload)
+            elif kind == _DONE:
+                self._completed_items += 1
+                if self._ventilator is not None:
+                    self._ventilator.processed_item()
+            elif kind == _ERROR:
+                raise pickle.loads(payload)
+            # late _STARTED messages are ignored
+
+    def _all_done(self):
+        if self._ventilated_items > self._completed_items:
+            return False
+        if self._ventilator is not None and not self._ventilator.completed():
+            return False
+        return True
+
+    def stop(self):
+        if self._stopped:
+            return
+        if self._ventilator is not None:
+            self._ventilator.stop()
+        self._stopped = True
+        # slow-joiner-safe: a worker that connects its SUB socket after this
+        # publish would miss it, so join() rebroadcasts while draining
+        self._control_send.send(_CONTROL_FINISHED)
+
+    def join(self):
+        if not self._stopped:
+            raise RuntimeError('join() must be called after stop()')
+        deadline = time.monotonic() + 10
+        while any(p.is_alive() for p in self._processes) and time.monotonic() < deadline:
+            self._control_send.send(_CONTROL_FINISHED)
+            # drain results so workers blocked on a full PUSH queue can exit
+            while self._results_receive.poll(0):
+                self._results_receive.recv_multipart()
+            time.sleep(0.05)
+        for p in self._processes:
+            if p.is_alive():
+                logger.warning('Terminating unresponsive worker pid=%s', p.pid)
+                p.terminate()
+            p.join()
+        self._processes = []
+        for sock in (self._ventilator_send, self._results_receive, self._control_send):
+            sock.close()
+        self._context.term()
+        if self._ipc_dir:
+            shutil.rmtree(self._ipc_dir, ignore_errors=True)
+
+    @property
+    def diagnostics(self):
+        return {'items_consumed': self._completed_items,
+                'items_ventilated': self._ventilated_items,
+                'items_inprocess': self._ventilated_items - self._completed_items}
+
+    @property
+    def results_qsize(self):
+        return 0  # unknown: lives in zmq buffers
+
+
+# ---------------------------------------------------------------------------
+# Worker process side
+# ---------------------------------------------------------------------------
+
+def _worker_bootstrap(worker_id, main_pid, setup_blob, vent_addr, result_addr, control_addr,
+                      results_hwm):
+    """Entry point of a spawned worker process."""
+    worker_class, worker_setup_args, serializer = pickle.loads(setup_blob)
+
+    _start_orphan_monitor(main_pid)
+
+    context = zmq.Context()
+    vent_recv = context.socket(zmq.PULL)
+    vent_recv.connect(vent_addr)
+    result_send = context.socket(zmq.PUSH)
+    result_send.setsockopt(zmq.SNDHWM, results_hwm)
+    result_send.connect(result_addr)
+    control_recv = context.socket(zmq.SUB)
+    control_recv.setsockopt(zmq.SUBSCRIBE, b'')
+    control_recv.connect(control_addr)
+
+    poller = zmq.Poller()
+    poller.register(vent_recv, zmq.POLLIN)
+    poller.register(control_recv, zmq.POLLIN)
+
+    def publish(data):
+        result_send.send_multipart([_DATA, serializer.serialize(data)])
+
+    worker = worker_class(worker_id, publish, worker_setup_args)
+    result_send.send_multipart([_STARTED, b''])
+
+    try:
+        while True:
+            events = dict(poller.poll(100))
+            if control_recv in events:
+                if control_recv.recv() == _CONTROL_FINISHED:
+                    break
+            if vent_recv in events:
+                args, kwargs = vent_recv.recv_pyobj()
+                try:
+                    worker.process(*args, **kwargs)
+                    result_send.send_multipart([_DONE, b''])
+                except Exception:  # noqa: BLE001 - forwarded to the main process
+                    exc = sys.exc_info()[1]
+                    logger.exception('Worker %d failed', worker_id)
+                    try:
+                        blob = pickle.dumps(exc)
+                    except Exception:  # unpicklable exception: forward a summary
+                        blob = pickle.dumps(RuntimeError('{}: {}'.format(type(exc).__name__, exc)))
+                    result_send.send_multipart([_ERROR, blob])
+                    result_send.send_multipart([_DONE, b''])
+    finally:
+        worker.shutdown()
+        for sock in (vent_recv, result_send, control_recv):
+            sock.close()
+        context.term()
+
+
+def _start_orphan_monitor(main_pid):
+    """Kill this worker when the main process disappears
+    (reference process_pool.py:324-331)."""
+
+    def monitor():
+        while True:
+            try:
+                os.kill(main_pid, 0)
+            except OSError:
+                logger.warning('Main process %d is gone; worker exiting', main_pid)
+                os._exit(1)
+            time.sleep(1.0)
+
+    threading.Thread(target=monitor, daemon=True).start()

@@ -1,0 +1,177 @@
+"""Thread pool: N daemon worker threads with a bounded results queue.
+
+Parity: /root/reference/petastorm/workers_pool/thread_pool.py (worker exceptions
+forwarded through the results queue and re-raised in the consumer :68-73,169-172;
+per-item completion sentinel :63; stop-aware blocking put :200-214; optional
+per-thread cProfile :41-49,190-198; ``diagnostics`` :219-221).
+
+Threads are the right default on the TPU host: the hot work (Parquet decode,
+image decode) happens in Arrow/OpenCV C++ which releases the GIL.
+"""
+
+from __future__ import annotations
+
+import logging
+import pstats
+import queue
+import sys
+import threading
+
+from petastorm_tpu.workers.worker_base import (EmptyResultError, WorkerTerminationRequested)
+
+logger = logging.getLogger(__name__)
+
+_DATA, _DONE, _ERROR = 0, 1, 2
+DEFAULT_RESULTS_QUEUE_SIZE = 50
+
+
+class ThreadPool(object):
+    def __init__(self, workers_count, results_queue_size=DEFAULT_RESULTS_QUEUE_SIZE, profiling_enabled=False):
+        self._workers_count = workers_count
+        self._results_queue = queue.Queue(maxsize=results_queue_size)
+        self._profiling_enabled = profiling_enabled
+        self._profiles = []
+        self._task_queue = queue.Queue()
+        self._stop_event = threading.Event()
+        self._threads = []
+        self._ventilator = None
+        self._ventilated_items = 0
+        self._completed_items = 0
+        self._counter_lock = threading.Lock()
+
+    @property
+    def workers_count(self):
+        return self._workers_count
+
+    def start(self, worker_class, worker_setup_args=None, ventilator=None):
+        if self._threads:
+            raise RuntimeError('Pool already started')
+        for worker_id in range(self._workers_count):
+            worker = worker_class(worker_id, self._publish, worker_setup_args)
+            thread = threading.Thread(target=self._worker_loop, args=(worker,), daemon=True)
+            thread.start()
+            self._threads.append(thread)
+        if ventilator is not None:
+            self._ventilator = ventilator
+            self._ventilator.start()
+
+    def ventilate(self, *args, **kwargs):
+        with self._counter_lock:
+            self._ventilated_items += 1
+        self._task_queue.put((args, kwargs))
+
+    def get_results(self):
+        """Block until a result is available; raise :class:`EmptyResultError` when
+        all ventilated items are processed and no more will be ventilated."""
+        while True:
+            try:
+                kind, payload = self._results_queue.get(block=False)
+            except queue.Empty:
+                if self._all_done():
+                    raise EmptyResultError()
+                try:
+                    kind, payload = self._results_queue.get(timeout=0.05)
+                except queue.Empty:
+                    continue
+            if kind == _DATA:
+                return payload
+            elif kind == _DONE:
+                self._count_completed()
+            else:  # _ERROR
+                raise payload
+
+    def _count_completed(self):
+        with self._counter_lock:
+            self._completed_items += 1
+        if self._ventilator is not None:
+            self._ventilator.processed_item()
+
+    def _all_done(self):
+        with self._counter_lock:
+            outstanding = self._ventilated_items > self._completed_items
+        if outstanding or not self._results_queue.empty():
+            return False
+        if self._ventilator is not None and not self._ventilator.completed():
+            return False
+        return True
+
+    def stop(self):
+        if self._ventilator is not None:
+            self._ventilator.stop()
+        self._stop_event.set()
+
+    def join(self):
+        if not self._stop_event.is_set():
+            raise RuntimeError('join() must be called after stop()')
+        # drain the results queue so workers blocked on a full queue can exit
+        for thread in self._threads:
+            while thread.is_alive():
+                try:
+                    while True:
+                        self._results_queue.get(block=False)
+                except queue.Empty:
+                    pass
+                thread.join(timeout=0.05)
+        self._threads = []
+        if self._profiling_enabled and self._profiles:
+            stats = pstats.Stats(*self._profiles)
+            stats.sort_stats('cumulative').print_stats()
+
+    @property
+    def diagnostics(self):
+        return {'output_queue_size': self._results_queue.qsize()}
+
+    @property
+    def results_qsize(self):
+        return self._results_queue.qsize()
+
+    # -- worker side --------------------------------------------------------
+
+    def _publish(self, data):
+        self._stop_aware_put((_DATA, data))
+
+    def _stop_aware_put(self, item):
+        """Bounded put that aborts when the pool is stopping, so workers never
+        deadlock against a full results queue (reference thread_pool.py:200-214)."""
+        while not self._stop_event.is_set():
+            try:
+                self._results_queue.put(item, timeout=0.05)
+                return
+            except queue.Full:
+                continue
+        raise WorkerTerminationRequested()
+
+    def _worker_loop(self, worker):
+        profiler = None
+        if self._profiling_enabled:
+            import cProfile
+            profiler = cProfile.Profile()
+        try:
+            while not self._stop_event.is_set():
+                try:
+                    args, kwargs = self._task_queue.get(timeout=0.05)
+                except queue.Empty:
+                    continue
+                try:
+                    if profiler is not None:
+                        profiler.enable()
+                    try:
+                        worker.process(*args, **kwargs)
+                    finally:
+                        if profiler is not None:
+                            profiler.disable()
+                    self._stop_aware_put((_DONE, None))
+                except WorkerTerminationRequested:
+                    return
+                except Exception:  # noqa: BLE001 - forwarded to consumer
+                    exc = sys.exc_info()[1]
+                    logger.exception('Worker %d failed processing an item', worker.worker_id)
+                    try:
+                        self._stop_aware_put((_ERROR, exc))
+                        self._stop_aware_put((_DONE, None))
+                    except WorkerTerminationRequested:
+                        return
+        finally:
+            if profiler is not None:
+                self._profiles.append(pstats.Stats(profiler))
+            worker.shutdown()

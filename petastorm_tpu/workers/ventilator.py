@@ -1,0 +1,130 @@
+"""Ventilator: feeds work items into a pool with bounded in-flight count.
+
+Parity: /root/reference/petastorm/workers_pool/ventilator.py:55-166
+(``ConcurrentVentilator``: background feeding thread, bounded ventilation queue
+via processed-item callbacks, per-epoch reshuffle, ``iterations=None`` infinite
+epochs, ``completed()``/``reset()``).
+
+Improvement over the reference (SURVEY.md §5 checkpoint gap): the reshuffle RNG
+is seedable, making epoch order reproducible when ``random_seed`` is given.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+class VentilatorBase(object):
+    def start(self):
+        raise NotImplementedError
+
+    def processed_item(self):
+        raise NotImplementedError
+
+    def completed(self):
+        raise NotImplementedError
+
+    def stop(self):
+        raise NotImplementedError
+
+
+class ConcurrentVentilator(VentilatorBase):
+    """Ventilates ``items_to_ventilate`` (a list of kwargs dicts for
+    ``pool.ventilate``) from a background thread.
+
+    :param ventilate_fn: callable(**item) — normally ``pool.ventilate``
+    :param items_to_ventilate: list of dicts
+    :param iterations: number of passes over the items; ``None`` = infinite
+    :param max_ventilation_queue_size: max in-flight (ventilated - processed)
+        items; defaults to ``len(items_to_ventilate)``
+    :param randomize_item_order: reshuffle item order before each epoch
+    :param random_seed: seed for the reshuffle RNG (``None`` = nondeterministic)
+    """
+
+    def __init__(self, ventilate_fn, items_to_ventilate, iterations=1,
+                 max_ventilation_queue_size=None, randomize_item_order=False,
+                 random_seed=None):
+        if iterations is not None and (not isinstance(iterations, int) or iterations < 1):
+            raise ValueError('iterations must be a positive integer or None, got {!r}'.format(iterations))
+        self._ventilate_fn = ventilate_fn
+        self._items_to_ventilate = list(items_to_ventilate)
+        self._iterations_remaining = iterations
+        self._max_ventilation_queue_size = (max_ventilation_queue_size
+                                            if max_ventilation_queue_size is not None
+                                            else max(1, len(self._items_to_ventilate)))
+        self._randomize_item_order = randomize_item_order
+        self._rng = np.random.default_rng(random_seed)
+
+        self._in_flight = 0
+        self._in_flight_cv = threading.Condition()
+        self._stop_requested = False
+        self._completed = len(self._items_to_ventilate) == 0
+        self._thread = None
+
+    def start(self):
+        if self._thread is not None:
+            raise RuntimeError('Ventilator already started')
+        if self._completed:
+            return
+        self._thread = threading.Thread(target=self._ventilate_loop, daemon=True)
+        self._thread.start()
+
+    def processed_item(self):
+        """Called by the pool/consumer when one ventilated item finished
+        processing; unblocks the feeding thread."""
+        with self._in_flight_cv:
+            self._in_flight -= 1
+            self._in_flight_cv.notify()
+
+    def completed(self):
+        """True when no more items will ever be ventilated."""
+        return self._completed
+
+    def reset(self):
+        """Restart ventilation for the originally requested number of iterations.
+        Only valid after the previous run completed (the reference refuses
+        mid-epoch reset citing races, reader.py:431-438 — we do too)."""
+        if not self._completed:
+            raise RuntimeError('Cannot reset ventilator while ventilation is still in progress')
+        if self._thread is not None:
+            self._thread.join()
+        self._completed = len(self._items_to_ventilate) == 0
+        self._stop_requested = False
+        self._thread = None
+        with self._in_flight_cv:
+            self._in_flight = 0
+        self.start()
+
+    def stop(self):
+        self._stop_requested = True
+        with self._in_flight_cv:
+            self._in_flight_cv.notify_all()
+        if self._thread is not None:
+            self._thread.join()
+        self._completed = True
+
+    def _ventilate_loop(self):
+        items = list(self._items_to_ventilate)
+        while not self._stop_requested:
+            if self._randomize_item_order:
+                order = self._rng.permutation(len(items))
+                items = [items[i] for i in order]
+            for item in items:
+                with self._in_flight_cv:
+                    while (self._in_flight >= self._max_ventilation_queue_size
+                           and not self._stop_requested):
+                        self._in_flight_cv.wait(timeout=0.1)
+                    if self._stop_requested:
+                        return
+                    self._in_flight += 1
+                self._ventilate_fn(**item)
+            if self._iterations_remaining is not None:
+                self._iterations_remaining -= 1
+                if self._iterations_remaining <= 0:
+                    break
+        self._completed = True

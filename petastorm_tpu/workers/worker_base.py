@@ -1,0 +1,46 @@
+"""Worker protocol shared by all pool implementations.
+
+Parity: /root/reference/petastorm/workers_pool/worker_base.py:18-35 and the
+sentinels in workers_pool/__init__.py:16-26.
+"""
+
+from __future__ import annotations
+
+
+class EmptyResultError(Exception):
+    """Raised by ``pool.get_results()`` when all ventilated work has been
+    processed and no further results will arrive."""
+
+
+class TimeoutWaitingForResultError(Exception):
+    """Raised when a pool timed out waiting for worker results."""
+
+
+class WorkerTerminationRequested(Exception):
+    """Raised inside a worker's ``process`` by ``publish`` when the pool is
+    stopping, to unwind the worker promptly."""
+
+
+class WorkerBase(object):
+    """A worker processes one ventilated item per ``process`` call and publishes
+    zero or more results via ``publish_func``.
+
+    :param worker_id: ordinal of this worker in the pool
+    :param publish_func: callable(result) delivering a result to the consumer
+    :param args: pool-wide setup arguments (must be picklable for process pools)
+    """
+
+    def __init__(self, worker_id, publish_func, args):
+        self.worker_id = worker_id
+        self.publish_func = publish_func
+        self.args = args
+
+    def process(self, *args, **kwargs):
+        """Handle one ventilated item."""
+        raise NotImplementedError
+
+    def publish(self, data):
+        self.publish_func(data)
+
+    def shutdown(self):
+        """Called once when the pool stops; release worker-held resources."""

@@ -48,7 +48,10 @@ def test_row_group_counts_metadata_written(tmp_path):
     write_petastorm_dataset(url, _small_schema(), _rows(20), rows_per_row_group=10)
     raw = read_metadata_value(url, ROW_GROUPS_PER_FILE_KEY)
     counts = json.loads(raw.decode())
-    assert sum(counts.values()) == 2
+    assert sum(len(v) for v in counts.values()) == 2
+    # fast path populates per-piece row counts too
+    pieces = load_row_groups(url)
+    assert [p.num_rows for p in pieces] == [10, 10]
 
 
 def test_load_row_groups_footer_fallback(tmp_path):
@@ -126,3 +129,33 @@ def test_synthetic_dataset_fixture(synthetic_dataset):
     assert len({p.path for p in pieces}) == 4  # 30 rows per file -> 4 files
     schema = get_schema(synthetic_dataset.url)
     assert 'image_png' in schema.fields
+
+
+def test_partition_values_with_slash_and_bool(tmp_path):
+    url = path_to_url(tmp_path / 'ds')
+    schema = Unischema('P', [
+        UnischemaField('kind', np.str_, (), ScalarCodec(), False),
+        UnischemaField('flag', np.bool_, (), ScalarCodec(), False),
+        UnischemaField('value', np.float64, (), ScalarCodec(), False),
+    ])
+    rows = [{'kind': 'a/b', 'flag': i % 2 == 0, 'value': float(i)} for i in range(8)]
+    write_petastorm_dataset(url, schema, rows, rows_per_row_group=4,
+                            partition_by=['kind', 'flag'])
+    pieces = load_row_groups(url)
+    kinds = {p.partition_keys['kind'] for p in pieces}
+    flags = {p.partition_keys['flag'] for p in pieces}
+    assert kinds == {'a/b'}
+    assert flags == {True, False}
+    assert all(isinstance(p.partition_keys['flag'], bool) for p in pieces)
+
+
+def test_materialize_closes_writers_on_body_exception(tmp_path):
+    url = path_to_url(tmp_path / 'ds')
+    with pytest.raises(RuntimeError, match='boom'):
+        with materialize_dataset(url, _small_schema(), rows_per_row_group=5) as w:
+            w.write({'id': 1, 'vec': np.zeros(4, dtype=np.float32)})
+            raise RuntimeError('boom')
+    # the writer was closed: the partial file has a valid footer
+    files = [f for f in (tmp_path / 'ds').iterdir() if f.suffix == '.parquet']
+    assert files
+    pq.ParquetFile(files[0])  # parses footer without error

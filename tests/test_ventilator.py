@@ -1,0 +1,142 @@
+"""Ventilator tests (modeled on reference workers_pool/tests/test_ventilator.py)."""
+
+import threading
+import time
+
+import pytest
+
+from petastorm_tpu.test_util.stub_workers import IdentityWorker
+from petastorm_tpu.workers import ConcurrentVentilator, EmptyResultError, ThreadPool
+
+
+def _drain(pool, limit=None):
+    results = []
+    while limit is None or len(results) < limit:
+        try:
+            results.append(pool.get_results())
+        except EmptyResultError:
+            break
+    return results
+
+
+def test_ventilator_feeds_all_items():
+    pool = ThreadPool(2)
+    items = [{'value': i} for i in range(40)]
+    vent = ConcurrentVentilator(pool.ventilate, items)
+    pool.start(IdentityWorker, ventilator=vent)
+    assert sorted(_drain(pool)) == list(range(40))
+    pool.stop(); pool.join()
+
+
+def test_bounded_in_flight():
+    """Ventilator never exceeds max in-flight items (reference :51)."""
+    observed_max = [0]
+    in_flight = [0]
+    lock = threading.Lock()
+
+    class TrackingPool(ThreadPool):
+        def ventilate(self, *args, **kwargs):
+            with lock:
+                in_flight[0] += 1
+                observed_max[0] = max(observed_max[0], in_flight[0])
+            super().ventilate(*args, **kwargs)
+
+    pool = TrackingPool(2)
+    items = [{'value': i} for i in range(50)]
+    vent = ConcurrentVentilator(pool.ventilate, items, max_ventilation_queue_size=5)
+
+    class CountingWorker(IdentityWorker):
+        def process(self, value):
+            with lock:
+                in_flight[0] -= 1
+            self.publish(value)
+
+    pool.start(CountingWorker, ventilator=vent)
+    results = _drain(pool)
+    assert len(results) == 50
+    assert observed_max[0] <= 5 + 2  # small slack: decrement happens at process start
+    pool.stop(); pool.join()
+
+
+def test_multiple_iterations():
+    pool = ThreadPool(2)
+    items = [{'value': i} for i in range(10)]
+    vent = ConcurrentVentilator(pool.ventilate, items, iterations=3)
+    pool.start(IdentityWorker, ventilator=vent)
+    results = _drain(pool)
+    assert len(results) == 30
+    assert sorted(results) == sorted(list(range(10)) * 3)
+    pool.stop(); pool.join()
+
+
+def test_infinite_iterations_and_stop():
+    pool = ThreadPool(2)
+    items = [{'value': i} for i in range(5)]
+    vent = ConcurrentVentilator(pool.ventilate, items, iterations=None,
+                                max_ventilation_queue_size=10)
+    pool.start(IdentityWorker, ventilator=vent)
+    got = _drain(pool, limit=50)
+    assert len(got) == 50
+    pool.stop()
+    pool.join()
+
+
+def test_randomized_order_seeded_reproducible():
+    orders = []
+    for _ in range(2):
+        order = []
+        vent = ConcurrentVentilator(lambda value: order.append(value),
+                                    [{'value': i} for i in range(100)],
+                                    randomize_item_order=True, random_seed=7)
+        # feed synchronously: report processed as soon as ventilated
+        vent.processed_item = lambda: None
+        vent.start()
+        while not vent.completed():
+            time.sleep(0.01)
+        orders.append(order)
+    assert orders[0] == orders[1]
+    assert orders[0] != sorted(orders[0])
+
+
+def test_unseeded_orders_differ():
+    orders = []
+    for _ in range(2):
+        order = []
+        vent = ConcurrentVentilator(lambda value: order.append(value),
+                                    [{'value': i} for i in range(100)],
+                                    randomize_item_order=True)
+        vent.start()
+        while not vent.completed():
+            time.sleep(0.01)
+        orders.append(order)
+    assert orders[0] != orders[1]
+
+
+def test_reset_replays_items():
+    pool = ThreadPool(2)
+    items = [{'value': i} for i in range(10)]
+    vent = ConcurrentVentilator(pool.ventilate, items)
+    pool.start(IdentityWorker, ventilator=vent)
+    first = _drain(pool)
+    assert sorted(first) == list(range(10))
+    vent.reset()
+    second = _drain(pool)
+    assert sorted(second) == list(range(10))
+    pool.stop(); pool.join()
+
+
+def test_reset_while_running_raises():
+    vent = ConcurrentVentilator(lambda value: time.sleep(0.001),
+                                [{'value': i} for i in range(1000)],
+                                max_ventilation_queue_size=1)
+    vent.start()
+    with pytest.raises(RuntimeError):
+        vent.reset()
+    vent.stop()
+
+
+def test_bad_iterations_rejected():
+    with pytest.raises(ValueError):
+        ConcurrentVentilator(lambda: None, [], iterations=0)
+    with pytest.raises(ValueError):
+        ConcurrentVentilator(lambda: None, [], iterations=-1)

@@ -1,0 +1,165 @@
+"""Pool protocol tests across thread/process/dummy pools
+(modeled on reference workers_pool/tests/test_workers_pool.py)."""
+
+import pytest
+
+from petastorm_tpu.serializers import ArrowTableSerializer, PickleSerializer
+from petastorm_tpu.test_util.stub_workers import (DoubleOutputWorker, ExceptionEveryNWorker,
+                                                  IdentityWorker, SetupArgsEchoWorker,
+                                                  SleepyIdentityWorker, ZeroOutputWorker)
+from petastorm_tpu.workers import (ConcurrentVentilator, DummyPool, EmptyResultError, ProcessPool,
+                                   ThreadPool)
+
+ALL_POOLS = [lambda n=3: ThreadPool(n), lambda n=3: DummyPool(n)]
+POOL_IDS = ['thread', 'dummy']
+
+
+def _drain(pool):
+    results = []
+    while True:
+        try:
+            results.append(pool.get_results())
+        except EmptyResultError:
+            return results
+
+
+@pytest.mark.parametrize('make_pool', ALL_POOLS, ids=POOL_IDS)
+def test_identity_all_items(make_pool):
+    pool = make_pool()
+    pool.start(IdentityWorker)
+    for i in range(50):
+        pool.ventilate(i)
+    results = _drain(pool)
+    assert sorted(results) == list(range(50))
+    pool.stop(); pool.join()
+
+
+@pytest.mark.parametrize('make_pool', ALL_POOLS, ids=POOL_IDS)
+def test_multiple_results_per_item(make_pool):
+    pool = make_pool()
+    pool.start(DoubleOutputWorker)
+    for i in range(10):
+        pool.ventilate(i)
+    results = _drain(pool)
+    assert len(results) == 20
+    pool.stop(); pool.join()
+
+
+@pytest.mark.parametrize('make_pool', ALL_POOLS, ids=POOL_IDS)
+def test_zero_output_workers(make_pool):
+    """Items that publish nothing still count as processed (reference :268-297)."""
+    pool = make_pool()
+    pool.start(ZeroOutputWorker)
+    for i in range(20):
+        pool.ventilate(i)
+    assert _drain(pool) == []
+    pool.stop(); pool.join()
+
+
+def test_thread_pool_exception_propagates():
+    pool = ThreadPool(2)
+    pool.start(ExceptionEveryNWorker, worker_setup_args=1)  # fail on every item
+    pool.ventilate(5)
+    with pytest.raises(ValueError, match='stub failure on 5'):
+        _drain(pool)
+    pool.stop(); pool.join()
+
+
+def test_thread_pool_continues_after_exception():
+    pool = ThreadPool(1)
+    pool.start(ExceptionEveryNWorker, worker_setup_args=5)
+    for i in [1, 2, 5, 3]:
+        pool.ventilate(i)
+    results, errors = [], []
+    while True:
+        try:
+            results.append(pool.get_results())
+        except EmptyResultError:
+            break
+        except ValueError as e:
+            errors.append(e)
+    assert sorted(results) == [1, 2, 3]
+    assert len(errors) == 1
+    pool.stop(); pool.join()
+
+
+def test_thread_pool_fifo_single_worker():
+    pool = ThreadPool(1)
+    pool.start(IdentityWorker)
+    for i in range(30):
+        pool.ventilate(i)
+    assert _drain(pool) == list(range(30))
+    pool.stop(); pool.join()
+
+
+def test_stop_mid_work_does_not_hang():
+    pool = ThreadPool(4, results_queue_size=2)
+    pool.start(SleepyIdentityWorker)
+    for i in range(100):
+        pool.ventilate(i, sleep_s=0.005)
+    # consume a few then stop: workers blocked on the full results queue must exit
+    for _ in range(3):
+        pool.get_results()
+    pool.stop()
+    pool.join()
+
+
+def test_diagnostics():
+    pool = ThreadPool(2)
+    pool.start(IdentityWorker)
+    assert 'output_queue_size' in pool.diagnostics
+    pool.stop(); pool.join()
+
+
+# ---------------------------------------------------------------------------
+# Process pool (spawned subprocesses; heavier — keep the matrix small)
+# ---------------------------------------------------------------------------
+
+class TestProcessPool:
+    def test_identity(self):
+        pool = ProcessPool(2)
+        pool.start(IdentityWorker)
+        for i in range(20):
+            pool.ventilate(i)
+        results = _drain(pool)
+        assert sorted(results) == list(range(20))
+        pool.stop(); pool.join()
+
+    def test_setup_args_survive_spawn(self):
+        pool = ProcessPool(2)
+        pool.start(SetupArgsEchoWorker, worker_setup_args={'key': [1, 2, 3]})
+        pool.ventilate(7)
+        value, args = pool.get_results()
+        assert value == 7 and args == {'key': [1, 2, 3]}
+        pool.stop(); pool.join()
+
+    def test_exception_propagates(self):
+        pool = ProcessPool(1)
+        pool.start(ExceptionEveryNWorker, worker_setup_args=1)
+        pool.ventilate(5)
+        with pytest.raises(ValueError, match='stub failure on 5'):
+            _drain(pool)
+        pool.stop(); pool.join()
+
+    def test_arrow_table_serializer(self):
+        import pyarrow as pa
+        from petastorm_tpu.test_util.stub_workers import ArrowTableWorker
+
+        pool = ProcessPool(1, serializer=ArrowTableSerializer())
+        pool.start(ArrowTableWorker)
+        pool.ventilate(5)
+        table = pool.get_results()
+        assert isinstance(table, pa.Table)
+        assert table.num_rows == 5
+        pool.stop(); pool.join()
+
+
+def test_serializers_roundtrip():
+    import numpy as np
+    import pyarrow as pa
+    for s in (PickleSerializer(), ArrowTableSerializer()):
+        assert s.deserialize(s.serialize({'a': 1})) == {'a': 1}
+    s = ArrowTableSerializer()
+    t = pa.table({'x': np.arange(10), 'y': ['a'] * 10})
+    out = s.deserialize(s.serialize(t))
+    assert out.equals(t)
