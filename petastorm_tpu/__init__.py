@@ -12,10 +12,6 @@ Top-level API mirrors the reference (petastorm/__init__.py:15-19):
 from petastorm_tpu.errors import NoDataAvailableError  # noqa: F401
 from petastorm_tpu.transform import TransformSpec  # noqa: F401
 
-import importlib.util as _importlib_util
-
-if _importlib_util.find_spec('petastorm_tpu.reader') is not None:
-    # reader lands in a later build stage; schema/codec layer is usable without it
-    from petastorm_tpu.reader import make_reader, make_batch_reader  # noqa: F401
+from petastorm_tpu.reader import make_reader, make_batch_reader  # noqa: F401
 
 __version__ = '0.1.0'

@@ -1,0 +1,24 @@
+"""Row-group cache protocol.
+
+Parity: /root/reference/petastorm/cache.py:21-39 (``CacheBase`` read-through
+protocol, ``NullCache`` passthrough).
+"""
+
+from __future__ import annotations
+
+
+class CacheBase(object):
+    def get(self, key, fill_cache_func):
+        """Return the cached value for ``key``; on miss call ``fill_cache_func()``,
+        store its result, and return it."""
+        raise NotImplementedError
+
+    def cleanup(self):
+        """Remove cache resources (optional)."""
+
+
+class NullCache(CacheBase):
+    """Never caches: always calls through."""
+
+    def get(self, key, fill_cache_func):
+        return fill_cache_func()

@@ -68,14 +68,24 @@ class FilesystemResolver(object):
         """A picklable zero-arg callable recreating the filesystem in another
         process (pyarrow filesystems themselves are picklable in modern Arrow,
         but a URL-based factory stays robust across versions)."""
-        url = self._url
-        return lambda: FilesystemResolver(url).filesystem()
+        return _FilesystemFactory(self._url)
 
     def __getstate__(self):
         return {'url': self._url}
 
     def __setstate__(self, state):
         self.__init__(state['url'])
+
+
+class _FilesystemFactory(object):
+    """Picklable zero-arg filesystem factory (spawned worker processes re-resolve
+    the URL instead of shipping a live filesystem handle)."""
+
+    def __init__(self, url):
+        self._url = url
+
+    def __call__(self):
+        return FilesystemResolver(self._url).filesystem()
 
 
 def path_to_url(path):

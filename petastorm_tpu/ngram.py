@@ -1,0 +1,141 @@
+"""NGram: windowed sequence readout over timestamp-ordered rows.
+
+Parity: /root/reference/petastorm/ngram.py:20-339 — fields-per-timestep dict,
+``delta_threshold``, ``timestamp_overlap`` (:102-125); sliding-window assembly
+with timestamp-delta filtering (:225-270); per-timestep schema views (:215-223);
+regex field resolution (:195-203). Windows never cross row-group boundaries
+(:85-91) — sequences longer than a row group require larger row groups.
+
+This is the framework's long-sequence primitive: the JAX adapter stacks the
+per-timestep rows time-major so a window lands on device as ``[T, ...]`` arrays
+ready for scan/attention kernels.
+"""
+
+from __future__ import annotations
+
+from petastorm_tpu.errors import PetastormTpuError
+from petastorm_tpu.unischema import Unischema, UnischemaField, match_unischema_fields
+
+
+class NGram(object):
+    """
+    :param fields: dict mapping integer timestep offset -> list of
+        :class:`UnischemaField` or regex pattern strings. Offsets must be
+        consecutive integers (any base), e.g. ``{-1: [...], 0: [...], 1: [...]}``.
+    :param delta_threshold: maximum allowed timestamp delta between two
+        consecutive timesteps in a window; windows violating it are dropped.
+    :param timestamp_field: the :class:`UnischemaField` (or name) ordering rows.
+    :param timestamp_overlap: if False, consecutive windows never share rows.
+    """
+
+    def __init__(self, fields, delta_threshold, timestamp_field, timestamp_overlap=True):
+        if not isinstance(fields, dict) or not fields:
+            raise PetastormTpuError('fields must be a non-empty dict of offset -> field list')
+        offsets = sorted(fields.keys())
+        if offsets != list(range(offsets[0], offsets[-1] + 1)):
+            raise PetastormTpuError('NGram offsets must be consecutive integers, got {}'.format(offsets))
+        self._fields = {k: list(v) for k, v in fields.items()}
+        self._delta_threshold = delta_threshold
+        self._timestamp_field_name = (timestamp_field.name
+                                      if isinstance(timestamp_field, UnischemaField)
+                                      else timestamp_field)
+        self._timestamp_overlap = timestamp_overlap
+        self._min_offset = offsets[0]
+        self._max_offset = offsets[-1]
+
+    @property
+    def length(self):
+        """Window length in timesteps."""
+        return self._max_offset - self._min_offset + 1
+
+    @property
+    def fields(self):
+        return self._fields
+
+    @property
+    def delta_threshold(self):
+        return self._delta_threshold
+
+    @property
+    def timestamp_field_name(self):
+        return self._timestamp_field_name
+
+    @property
+    def timestamp_overlap(self):
+        return self._timestamp_overlap
+
+    def resolve_regex_field_names(self, schema):
+        """Replace regex pattern strings in the per-timestep field lists with the
+        concrete schema fields they match (reference ngram.py:195-203)."""
+        for offset, field_list in self._fields.items():
+            resolved = []
+            for item in field_list:
+                if isinstance(item, UnischemaField):
+                    resolved.append(item)
+                else:
+                    matched = match_unischema_fields(schema, [item])
+                    if not matched:
+                        raise PetastormTpuError(
+                            'NGram pattern {!r} matched no fields in schema {}'.format(item, schema.name))
+                    resolved.extend(matched)
+            self._fields[offset] = resolved
+
+    def get_field_names_at_timestep(self, offset):
+        return [f.name if isinstance(f, UnischemaField) else f for f in self._fields.get(offset, [])]
+
+    def get_field_names_at_all_timesteps(self):
+        names = set()
+        for offset in self._fields:
+            names.update(self.get_field_names_at_timestep(offset))
+        names.add(self._timestamp_field_name)
+        return sorted(names)
+
+    def get_schema_at_timestep(self, schema, offset):
+        """Schema view containing only this timestep's fields
+        (reference ngram.py:215-223)."""
+        names = [n for n in self.get_field_names_at_timestep(offset) if n in schema.fields]
+        return schema.create_schema_view([schema.fields[n] for n in names])
+
+    def form_ngram(self, data, schema):
+        """Assemble windows from decoded rows of ONE row group.
+
+        :param data: list of row dicts (will be sorted by the timestamp field)
+        :param schema: the (possibly transformed) row schema
+        :return: list of dicts offset -> per-timestep row dict (only that
+            timestep's fields)
+        """
+        rows = sorted(data, key=lambda r: r[self._timestamp_field_name])
+        length = self.length
+        ngrams = []
+        start = 0
+        while start + length <= len(rows):
+            window = rows[start:start + length]
+            if self._window_within_threshold(window):
+                ngram = {}
+                for offset in range(self._min_offset, self._max_offset + 1):
+                    row = window[offset - self._min_offset]
+                    wanted = self.get_field_names_at_timestep(offset)
+                    ngram[offset] = {k: row[k] for k in wanted if k in row}
+                ngrams.append(ngram)
+                start += length if not self._timestamp_overlap else 1
+            else:
+                start += 1
+        return ngrams
+
+    def _window_within_threshold(self, window):
+        if self._delta_threshold is None:
+            return True
+        ts = [r[self._timestamp_field_name] for r in window]
+        for a, b in zip(ts, ts[1:]):
+            if b - a > self._delta_threshold:
+                return False
+        return True
+
+    def make_namedtuple(self, schema, ngram_as_dicts):
+        """Convert an ngram of row dicts into offset -> schema-view namedtuple
+        (what the reader yields)."""
+        result = {}
+        for offset, row in ngram_as_dicts.items():
+            view = self.get_schema_at_timestep(schema, offset)
+            result[offset] = view.make_namedtuple(**{k: row[k] for k in view.fields})
+        return result

@@ -1,0 +1,140 @@
+"""Row predicates with a column-pruning contract.
+
+Parity: /root/reference/petastorm/predicates.py:26-183. A predicate declares the
+fields it needs (``get_fields``) so workers read/decode only those columns first,
+evaluate the mask, and early-exit empty row groups before touching heavy columns
+(the reference's in-worker pushdown, py_dict_reader_worker.py:188-252). When all
+predicate fields are partition keys, the reader evaluates the predicate at the
+piece level and drops whole row groups without any I/O.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+
+
+class PredicateBase(object):
+    def get_fields(self):
+        """Names of fields ``do_include`` needs."""
+        raise NotImplementedError
+
+    def do_include(self, values):
+        """values: dict field_name -> decoded value for one row. Return True to
+        keep the row."""
+        raise NotImplementedError
+
+
+class in_set(PredicateBase):
+    """Keep rows whose scalar field value is in ``inclusion_values``."""
+
+    def __init__(self, inclusion_values, field_name):
+        self._inclusion_values = set(inclusion_values)
+        self._field_name = field_name
+
+    def get_fields(self):
+        return {self._field_name}
+
+    def do_include(self, values):
+        return values[self._field_name] in self._inclusion_values
+
+
+class in_intersection(PredicateBase):
+    """Keep rows whose array field intersects ``inclusion_values``."""
+
+    def __init__(self, inclusion_values, field_name):
+        self._inclusion_values = set(inclusion_values)
+        self._field_name = field_name
+
+    def get_fields(self):
+        return {self._field_name}
+
+    def do_include(self, values):
+        value = values[self._field_name]
+        if value is None:
+            return False
+        return not self._inclusion_values.isdisjoint(
+            v for v in (value.flat if isinstance(value, np.ndarray) else value))
+
+
+class in_lambda(PredicateBase):
+    """Arbitrary user predicate over the named fields; optional mutable state
+    object is passed as a second argument when provided."""
+
+    def __init__(self, predicate_fields, predicate_func, state=None):
+        self._predicate_fields = list(predicate_fields)
+        self._predicate_func = predicate_func
+        self._state = state
+
+    def get_fields(self):
+        return set(self._predicate_fields)
+
+    def do_include(self, values):
+        if self._state is None:
+            return self._predicate_func(values)
+        return self._predicate_func(values, self._state)
+
+
+class in_negate(PredicateBase):
+    def __init__(self, predicate):
+        self._predicate = predicate
+
+    def get_fields(self):
+        return self._predicate.get_fields()
+
+    def do_include(self, values):
+        return not self._predicate.do_include(values)
+
+
+class in_reduce(PredicateBase):
+    """Compose predicates with a reduction over their booleans, e.g.
+    ``in_reduce([p1, p2], all)`` or ``in_reduce([p1, p2], any)``."""
+
+    def __init__(self, predicate_list, reduce_func):
+        self._predicate_list = list(predicate_list)
+        self._reduce_func = reduce_func
+
+    def get_fields(self):
+        fields = set()
+        for p in self._predicate_list:
+            fields |= set(p.get_fields())
+        return fields
+
+    def do_include(self, values):
+        return self._reduce_func([p.do_include(values) for p in self._predicate_list])
+
+
+class in_pseudorandom_split(PredicateBase):
+    """Deterministic hash-bucket train/val/test split on a field
+    (reference predicates.py:144-183).
+
+    ``fraction_list`` are the subset fractions (must sum to <= 1.0);
+    ``subset_index`` selects which subset this predicate keeps. The same field
+    value always lands in the same subset, across runs and processes.
+    """
+
+    _BUCKETS = 2 ** 32
+
+    def __init__(self, fraction_list, subset_index, predicate_field):
+        if not 0 <= subset_index < len(fraction_list):
+            raise ValueError('subset_index {} out of range for {} fractions'.format(
+                subset_index, len(fraction_list)))
+        if sum(fraction_list) > 1.0 + 1e-9:
+            raise ValueError('fractions must sum to <= 1.0, got {}'.format(sum(fraction_list)))
+        cumsum = np.cumsum([0.0] + list(fraction_list))
+        self._low = cumsum[subset_index]
+        self._high = cumsum[subset_index + 1]
+        self._predicate_field = predicate_field
+
+    def get_fields(self):
+        return {self._predicate_field}
+
+    def do_include(self, values):
+        value = values[self._predicate_field]
+        if isinstance(value, bytes):
+            raw = value
+        else:
+            raw = str(value).encode('utf-8')
+        bucket = int.from_bytes(hashlib.md5(raw).digest()[:4], 'big') / self._BUCKETS
+        return self._low <= bucket < self._high

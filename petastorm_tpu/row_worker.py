@@ -1,0 +1,215 @@
+"""Row-oriented decode worker: loads ONE row group per task, decodes per-row.
+
+Parity: /root/reference/petastorm/py_dict_reader_worker.py — in-worker predicate
+pushdown (read+decode predicate columns first, early-exit empty masks, then read
+the rest, :188-252), read-through cache keyed on dataset/piece (:160-163), NGram
+assembly (:165-166), shuffle_row_drop_partition row subsetting (:254-274, with
+NGram-aware spillover :266-271), and a consumer-side results-queue reader that
+converts row dicts to schema namedtuples (:64-97).
+
+TPU-first: decode happens here on the CPU host, overlapped with device compute;
+rows are selected BEFORE decode so predicates/row-drop never pay image-decode
+cost for dropped rows.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from collections import deque
+
+import numpy as np
+import pyarrow.parquet as pq
+
+from petastorm_tpu.workers.worker_base import EmptyResultError, WorkerBase
+
+
+def _cache_key(dataset_path, piece, column_names):
+    cols = hashlib.md5(','.join(sorted(column_names)).encode()).hexdigest()[:8]
+    return '{}:{}:rg{}:{}'.format(
+        hashlib.md5(dataset_path.encode()).hexdigest(), piece.path, piece.row_group, cols)
+
+
+def select_row_drop_indices(num_rows, partition_spec, ngram=None):
+    """Row indices kept for one shuffle-row-drop partition.
+
+    ``partition_spec`` is ``(partition_index, num_partitions)``. With an NGram,
+    each partition spills over by ``length - 1`` rows so windows spanning the
+    partition boundary are not lost (reference py_dict_reader_worker.py:266-271).
+    """
+    if partition_spec is None:
+        return np.arange(num_rows)
+    part, n_parts = partition_spec
+    chunks = np.array_split(np.arange(num_rows), n_parts)
+    chunk = chunks[part]
+    if ngram is not None and len(chunk) and chunk[-1] < num_rows - 1:
+        spill = np.arange(chunk[-1] + 1, min(chunk[-1] + ngram.length, num_rows))
+        chunk = np.concatenate([chunk, spill])
+    return chunk
+
+
+class RowGroupDecoderWorker(WorkerBase):
+    """``args`` (picklable, shared by all workers):
+      dataset_path, filesystem_factory, pieces, schema (full stored schema),
+      output_schema (post column-selection, pre-transform), transform_spec,
+      transformed_schema, ngram, cache
+    """
+
+    def __init__(self, worker_id, publish_func, args):
+        super().__init__(worker_id, publish_func, args)
+        self._fs = None
+        self._open_files = {}
+
+    def _parquet_file(self, path):
+        if self._fs is None:
+            self._fs = self.args['filesystem_factory']()
+        if path not in self._open_files:
+            if len(self._open_files) > 8:  # bound per-worker open handles
+                _, old = self._open_files.popitem()
+                old.close()
+            self._open_files[path] = pq.ParquetFile(self._fs.open_input_file(path))
+        return self._open_files[path]
+
+    def shutdown(self):
+        for f in self._open_files.values():
+            try:
+                f.close()
+            except Exception:  # noqa: BLE001
+                pass
+        self._open_files = {}
+
+    # -- main task ----------------------------------------------------------
+
+    def process(self, piece_index, worker_predicate=None, shuffle_row_drop_partition=None):
+        args = self.args
+        piece = args['pieces'][piece_index]
+        out_schema = args['output_schema']
+        ngram = args['ngram']
+
+        if ngram is not None:
+            needed = [n for n in ngram.get_field_names_at_all_timesteps() if n in out_schema.fields]
+        else:
+            needed = list(out_schema.fields)
+
+        cache = args['cache']
+        if worker_predicate is None and shuffle_row_drop_partition is None:
+            key = _cache_key(args['dataset_path'], piece, needed)
+            rows = cache.get(key, lambda: self._load_rows(piece, needed))
+        elif worker_predicate is not None:
+            rows = self._load_rows_with_predicate(piece, needed, worker_predicate,
+                                                  shuffle_row_drop_partition)
+        else:
+            rows = self._load_rows(piece, needed, shuffle_row_drop_partition)
+
+        transform = args['transform_spec']
+        if transform is not None and transform.func is not None:
+            rows = [transform.func(r) for r in rows]
+        if transform is not None:
+            final_fields = set(args['transformed_schema'].fields)
+            rows = [{k: v for k, v in r.items() if k in final_fields} for r in rows]
+
+        if ngram is not None:
+            rows = ngram.form_ngram(rows, args['transformed_schema'] or out_schema)
+
+        if rows:
+            self.publish(rows)
+
+    # -- loading ------------------------------------------------------------
+
+    def _read_columns(self, piece, column_names, row_indices=None):
+        """Read the named logical columns of the piece; returns (dict of
+        per-column python value lists, num_rows). Partition-key columns are
+        materialized from the piece's path."""
+        schema = self.args['schema']
+        physical = [c for c in column_names if c not in piece.partition_keys
+                    and c in schema.fields]
+        pf = self._parquet_file(piece.path)
+        table = pf.read_row_group(piece.row_group, columns=physical)
+        num_rows = table.num_rows
+        if row_indices is not None:
+            table = table.take(row_indices)
+        columns = {name: table.column(name).to_pylist() for name in physical}
+        n = table.num_rows
+        for key, value in piece.partition_keys.items():
+            if key in column_names:
+                columns[key] = [value] * n
+        return columns, num_rows
+
+    def _decode_rows(self, columns, column_names, n):
+        schema = self.args['schema']
+        decoded_cols = {}
+        for name in column_names:
+            field = schema.fields[name]
+            col = columns[name]
+            codec = field.codec
+            decoded_cols[name] = [None if v is None else codec.decode(field, v) for v in col]
+        return [{name: decoded_cols[name][i] for name in column_names} for i in range(n)]
+
+    def _load_rows(self, piece, column_names, shuffle_row_drop_partition=None):
+        indices = None
+        if shuffle_row_drop_partition is not None:
+            pf = self._parquet_file(piece.path)
+            num_rows = piece.num_rows or pf.metadata.row_group(piece.row_group).num_rows
+            indices = select_row_drop_indices(num_rows, shuffle_row_drop_partition,
+                                              self.args['ngram'])
+        columns, _ = self._read_columns(piece, column_names, indices)
+        n = len(next(iter(columns.values()))) if columns else 0
+        return self._decode_rows(columns, column_names, n)
+
+    def _load_rows_with_predicate(self, piece, column_names, predicate,
+                                  shuffle_row_drop_partition):
+        """Predicate pushdown: decode predicate columns first, mask, early-exit,
+        then read+decode remaining columns only for surviving rows."""
+        predicate_fields = sorted(predicate.get_fields())
+        schema = self.args['schema']
+        unknown = [f for f in predicate_fields
+                   if f not in schema.fields and f not in piece.partition_keys]
+        if unknown:
+            raise ValueError('Predicate fields {} are not in the dataset schema'.format(unknown))
+
+        pf = self._parquet_file(piece.path)
+        num_rows = pf.metadata.row_group(piece.row_group).num_rows
+        drop_indices = select_row_drop_indices(num_rows, shuffle_row_drop_partition,
+                                               self.args['ngram'])
+        pred_columns, _ = self._read_columns(piece, predicate_fields, drop_indices
+                                             if shuffle_row_drop_partition else None)
+        n = len(next(iter(pred_columns.values()))) if pred_columns else 0
+        pred_rows = self._decode_rows(pred_columns, predicate_fields, n)
+        mask = [predicate.do_include(r) for r in pred_rows]
+        if not any(mask):
+            return []
+        kept_local = np.flatnonzero(mask)
+        base = drop_indices if shuffle_row_drop_partition else np.arange(num_rows)
+        kept_global = base[kept_local]
+
+        remaining = [c for c in column_names if c not in predicate_fields]
+        rem_columns, _ = self._read_columns(piece, remaining, kept_global)
+        rem_rows = self._decode_rows(rem_columns, remaining, len(kept_global))
+        result = []
+        for i, local_idx in enumerate(kept_local):
+            row = dict(pred_rows[local_idx])
+            row.update(rem_rows[i])
+            result.append({k: row[k] for k in column_names if k in row})
+        return result
+
+
+class RowResultsQueueReader(object):
+    """Consumer-side: converts published row-dict chunks into schema namedtuples,
+    one row per ``read_next`` call (reference py_dict_reader_worker.py:64-97)."""
+
+    def __init__(self, schema, ngram=None):
+        self._schema = schema
+        self._ngram = ngram
+        self._buffer = deque()
+
+    @property
+    def batched_output(self):
+        return False
+
+    def read_next(self, pool):
+        while not self._buffer:
+            rows = pool.get_results()  # raises EmptyResultError at end of epoch
+            self._buffer.extend(rows)
+        row = self._buffer.popleft()
+        if self._ngram is not None:
+            return self._ngram.make_namedtuple(self._schema, row)
+        return self._schema.make_namedtuple(**row)

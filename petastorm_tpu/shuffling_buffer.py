@@ -1,0 +1,121 @@
+"""Client-side shuffling buffers for stream decorrelation.
+
+Parity: /root/reference/petastorm/reader_impl/shuffling_buffer.py (preallocated
+slot array, O(1) random-swap retrieve :158-167, ``min_after_retrieve`` watermark
++ ``finish()`` drain :169-180, ``NoopShufflingBuffer`` :75-100).
+
+Improvement: the RNG is seedable (the reference's ``np.random.randint`` is
+unseeded — SURVEY.md §5 reproducibility gap).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+import numpy as np
+
+
+class ShufflingBufferBase(object):
+    def add_many(self, items):
+        raise NotImplementedError
+
+    def retrieve(self):
+        raise NotImplementedError
+
+    def can_add(self):
+        raise NotImplementedError
+
+    def can_retrieve(self):
+        raise NotImplementedError
+
+    @property
+    def size(self):
+        raise NotImplementedError
+
+    def finish(self):
+        """No more items will be added; drain everything remaining."""
+        raise NotImplementedError
+
+
+class NoopShufflingBuffer(ShufflingBufferBase):
+    """FIFO passthrough."""
+
+    def __init__(self):
+        self._items = deque()
+
+    def add_many(self, items):
+        self._items.extend(items)
+
+    def retrieve(self):
+        return self._items.popleft()
+
+    def can_add(self):
+        return True
+
+    def can_retrieve(self):
+        return len(self._items) > 0
+
+    @property
+    def size(self):
+        return len(self._items)
+
+    def finish(self):
+        pass
+
+
+class RandomShufflingBuffer(ShufflingBufferBase):
+    """
+    :param shuffling_buffer_capacity: soft target capacity; ``can_add`` turns
+        False once reached (adds beyond it are still accepted — a caller may add
+        a whole row group at once)
+    :param min_after_retrieve: minimum items that must remain after a retrieve
+        (decorrelation floor); until ``finish()``, retrieval stalls below it
+    :param extra_capacity: headroom above capacity for bulk adds
+    :param seed: RNG seed (None = nondeterministic)
+    """
+
+    def __init__(self, shuffling_buffer_capacity, min_after_retrieve, extra_capacity=1000,
+                 seed=None):
+        if min_after_retrieve >= shuffling_buffer_capacity:
+            raise ValueError('min_after_retrieve ({}) must be smaller than capacity ({})'.format(
+                min_after_retrieve, shuffling_buffer_capacity))
+        self._capacity = shuffling_buffer_capacity
+        self._min_after_retrieve = min_after_retrieve
+        self._extra_capacity = extra_capacity
+        self._items = []
+        self._done_adding = False
+        self._rng = np.random.default_rng(seed)
+
+    def add_many(self, items):
+        if self._done_adding:
+            raise RuntimeError('Cannot add after finish()')
+        if len(self._items) + len(items) > self._capacity + self._extra_capacity:
+            raise RuntimeError(
+                'Attempt to add {} items to a buffer holding {} (capacity {} + extra {}). '
+                'Increase extra_capacity or add smaller chunks.'.format(
+                    len(items), len(self._items), self._capacity, self._extra_capacity))
+        self._items.extend(items)
+
+    def retrieve(self):
+        if not self.can_retrieve():
+            raise RuntimeError('Buffer cannot retrieve now: size={} min_after_retrieve={}'.format(
+                len(self._items), self._min_after_retrieve))
+        idx = int(self._rng.integers(0, len(self._items)))
+        # O(1): swap the chosen slot with the last element and pop
+        self._items[idx], self._items[-1] = self._items[-1], self._items[idx]
+        return self._items.pop()
+
+    def can_add(self):
+        return len(self._items) < self._capacity and not self._done_adding
+
+    def can_retrieve(self):
+        if self._done_adding:
+            return len(self._items) > 0
+        return len(self._items) > self._min_after_retrieve
+
+    @property
+    def size(self):
+        return len(self._items)
+
+    def finish(self):
+        self._done_adding = True

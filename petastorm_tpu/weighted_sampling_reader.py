@@ -1,0 +1,67 @@
+"""Mix several readers with given sampling probabilities.
+
+Parity: /root/reference/petastorm/weighted_sampling_reader.py:20-106 — each
+``__next__`` draws one of the underlying readers from the cumulative probability
+vector; schemas and batched-ness must match. RNG is seedable here (the
+reference's is not).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from petastorm_tpu.errors import PetastormTpuError
+
+
+class WeightedSamplingReader(object):
+    def __init__(self, readers, probabilities, seed=None):
+        if len(readers) != len(probabilities) or not readers:
+            raise PetastormTpuError('readers and probabilities must be non-empty, same length')
+        total = float(sum(probabilities))
+        if total <= 0:
+            raise PetastormTpuError('probabilities must sum to a positive value')
+        self._readers = list(readers)
+        self._cum = np.cumsum(np.asarray(probabilities, dtype=np.float64) / total)
+        self._rng = np.random.default_rng(seed)
+
+        first = self._readers[0]
+        for other in self._readers[1:]:
+            if other.batched_output != first.batched_output:
+                raise PetastormTpuError('All mixed readers must agree on batched_output')
+            if getattr(other, 'ngram', None) != getattr(first, 'ngram', None):
+                raise PetastormTpuError('All mixed readers must use the same NGram spec')
+            if list(other.transformed_schema.fields) != list(first.transformed_schema.fields):
+                raise PetastormTpuError('All mixed readers must produce the same fields')
+        self.batched_output = first.batched_output
+        self.ngram = getattr(first, 'ngram', None)
+        self.transformed_schema = first.transformed_schema
+        self.last_row_consumed = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        choice = int(np.searchsorted(self._cum, self._rng.random(), side='right'))
+        choice = min(choice, len(self._readers) - 1)
+        try:
+            return next(self._readers[choice])
+        except StopIteration:
+            self.last_row_consumed = True
+            raise
+
+    next = __next__
+
+    def stop(self):
+        for r in self._readers:
+            r.stop()
+
+    def join(self):
+        for r in self._readers:
+            r.join()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc_value, tb):
+        self.stop()
+        self.join()

@@ -16,6 +16,7 @@ class DummyPool(object):
         self._results = deque()
         self._worker = None
         self._ventilator = None
+        self._worker_error = None
         self.workers_count = workers_count
 
     def start(self, worker_class, worker_setup_args=None, ventilator=None):
@@ -27,19 +28,34 @@ class DummyPool(object):
             self._ventilator.start()
 
     def ventilate(self, *args, **kwargs):
-        self._worker.process(*args, **kwargs)
-        if self._ventilator is not None:
-            self._ventilator.processed_item()
+        try:
+            self._worker.process(*args, **kwargs)
+        except Exception as e:  # noqa: BLE001 - forwarded to the consumer, like
+            # ThreadPool/ProcessPool do; without this a ventilator-thread failure
+            # would leave get_results() spinning forever
+            self._worker_error = e
+            if self._ventilator is not None:
+                self._ventilator.stop()
+            raise
+        finally:
+            if self._ventilator is not None:
+                self._ventilator.processed_item()
 
     def get_results(self):
         # give a lazy ventilator thread a chance to feed us before declaring empty
         import time
         while not self._results:
+            if self._worker_error is not None:
+                error, self._worker_error = self._worker_error, None
+                raise error
             if self._ventilator is None or self._ventilator.completed():
                 # re-check: the ventilator may have appended a result between the
                 # emptiness check and completed() flipping true
                 if self._results:
                     break
+                if self._worker_error is not None:
+                    error, self._worker_error = self._worker_error, None
+                    raise error
                 raise EmptyResultError()
             time.sleep(0.001)
         return self._results.popleft()

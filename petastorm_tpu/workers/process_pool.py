@@ -42,10 +42,14 @@ _DEFAULT_RESULTS_HWM = 50
 
 
 class ProcessPool(object):
-    def __init__(self, workers_count, results_queue_size=_DEFAULT_RESULTS_HWM, serializer=None):
+    def __init__(self, workers_count, results_queue_size=_DEFAULT_RESULTS_HWM, serializer=None,
+                 results_timeout_s=None):
+        """``results_timeout_s``: raise if no worker message arrives within this
+        many seconds (None = block indefinitely, matching ThreadPool)."""
         self._workers_count = workers_count
         self._results_hwm = results_queue_size
         self._serializer = serializer or PickleSerializer()
+        self._results_timeout_s = results_timeout_s
         self._context = None
         self._processes = []
         self._ventilator = None
@@ -113,13 +117,14 @@ class ProcessPool(object):
         self._ventilated_items += 1
         self._ventilator_send.send_pyobj((args, kwargs))
 
-    def get_results(self, timeout_s=60.0):
-        deadline = time.monotonic() + timeout_s
+    def get_results(self, timeout_s=None):
+        timeout_s = timeout_s if timeout_s is not None else self._results_timeout_s
+        deadline = (time.monotonic() + timeout_s) if timeout_s is not None else None
         while True:
             if not self._results_receive.poll(50):
                 if self._all_done():
                     raise EmptyResultError()
-                if time.monotonic() > deadline:
+                if deadline is not None and time.monotonic() > deadline:
                     raise TimeoutWaitingForResultError(
                         'No results from worker processes in {}s; {} items in flight'.format(
                             timeout_s, self._ventilated_items - self._completed_items))

@@ -104,7 +104,7 @@ class ConcurrentVentilator(VentilatorBase):
         self._stop_requested = True
         with self._in_flight_cv:
             self._in_flight_cv.notify_all()
-        if self._thread is not None:
+        if self._thread is not None and self._thread is not threading.current_thread():
             self._thread.join()
         self._completed = True
 

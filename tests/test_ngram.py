@@ -1,0 +1,116 @@
+"""NGram tests (modeled on reference tests/test_ngram_end_to_end.py)."""
+
+import numpy as np
+import pytest
+
+from petastorm_tpu import make_reader
+from petastorm_tpu.errors import PetastormTpuError
+from petastorm_tpu.ngram import NGram
+from petastorm_tpu.test_util.dataset_utils import TestSchema
+
+
+def _ts_ngram(length=3, delta_threshold=1, overlap=True, fields=None):
+    per_step = fields or [TestSchema.id, TestSchema.id2]
+    return NGram({i: list(per_step) for i in range(length)},
+                 delta_threshold=delta_threshold,
+                 timestamp_field=TestSchema.id,
+                 timestamp_overlap=overlap)
+
+
+class TestFormNgram:
+    def test_basic_window(self):
+        ngram = _ts_ngram(length=3)
+        rows = [{'id': i, 'id2': i * 10} for i in range(5)]
+        out = ngram.form_ngram(rows, TestSchema)
+        assert len(out) == 3  # windows starting at 0,1,2
+        assert [out[0][t]['id'] for t in range(3)] == [0, 1, 2]
+        assert out[1][0]['id'] == 1
+
+    def test_delta_threshold_drops_gaps(self):
+        ngram = _ts_ngram(length=2, delta_threshold=1)
+        rows = [{'id': i, 'id2': 0} for i in [0, 1, 5, 6]]
+        out = ngram.form_ngram(rows, TestSchema)
+        pairs = [(w[0]['id'], w[1]['id']) for w in out]
+        assert pairs == [(0, 1), (5, 6)]  # (1,5) violates the threshold
+
+    def test_no_overlap(self):
+        ngram = _ts_ngram(length=2, overlap=False)
+        rows = [{'id': i, 'id2': 0} for i in range(6)]
+        out = ngram.form_ngram(rows, TestSchema)
+        starts = [w[0]['id'] for w in out]
+        assert starts == [0, 2, 4]
+
+    def test_unsorted_input_gets_sorted(self):
+        ngram = _ts_ngram(length=2)
+        rows = [{'id': i, 'id2': 0} for i in [3, 1, 0, 2]]
+        out = ngram.form_ngram(rows, TestSchema)
+        assert [(w[0]['id'], w[1]['id']) for w in out] == [(0, 1), (1, 2), (2, 3)]
+
+    def test_different_fields_per_timestep(self):
+        ngram = NGram({0: [TestSchema.id, TestSchema.id2], 1: [TestSchema.id]},
+                      delta_threshold=1, timestamp_field=TestSchema.id)
+        rows = [{'id': i, 'id2': i} for i in range(3)]
+        out = ngram.form_ngram(rows, TestSchema)
+        assert set(out[0][0].keys()) == {'id', 'id2'}
+        assert set(out[0][1].keys()) == {'id'}
+
+    def test_negative_offsets(self):
+        ngram = NGram({-1: [TestSchema.id], 0: [TestSchema.id], 1: [TestSchema.id]},
+                      delta_threshold=1, timestamp_field=TestSchema.id)
+        rows = [{'id': i} for i in range(4)]
+        out = ngram.form_ngram(rows, TestSchema)
+        assert len(out) == 2
+        assert sorted(out[0].keys()) == [-1, 0, 1]
+
+    def test_non_consecutive_offsets_rejected(self):
+        with pytest.raises(PetastormTpuError):
+            NGram({0: [TestSchema.id], 2: [TestSchema.id]}, 1, TestSchema.id)
+
+    def test_regex_resolution(self):
+        ngram = NGram({0: ['id.*'], 1: ['id']}, delta_threshold=1, timestamp_field='id')
+        ngram.resolve_regex_field_names(TestSchema)
+        names = set(ngram.get_field_names_at_timestep(0))
+        assert {'id', 'id2', 'id_float', 'id_odd'} == names
+
+
+class TestNgramEndToEnd:
+    def test_ngram_read(self, synthetic_dataset):
+        ngram = _ts_ngram(length=3, delta_threshold=1)
+        with make_reader(synthetic_dataset.url, reader_pool_type='dummy', ngram=ngram,
+                         shuffle_row_groups=False) as reader:
+            windows = list(reader)
+        # 10 row groups x 10 rows, windows within groups: 8 per group
+        assert len(windows) == 80
+        w = windows[0]
+        assert sorted(w.keys()) == [0, 1, 2]
+        ids = [w[t].id for t in range(3)]
+        assert ids[1] == ids[0] + 1 and ids[2] == ids[0] + 2
+        # namedtuples carry only that timestep's fields
+        assert set(w[0]._fields) == {'id', 'id2'}
+
+    def test_ngram_never_crosses_rowgroup_boundary(self, synthetic_dataset):
+        ngram = _ts_ngram(length=3, delta_threshold=1)
+        with make_reader(synthetic_dataset.url, reader_pool_type='dummy', ngram=ngram,
+                         shuffle_row_groups=False) as reader:
+            starts = sorted(w[0].id for w in reader)
+        # starts 8,9 of each group of 10 can't fit a 3-window
+        expected = sorted(i for i in range(100) if i % 10 <= 7)
+        assert starts == expected
+
+    def test_ngram_with_images(self, synthetic_dataset):
+        ngram = NGram({0: [TestSchema.id, TestSchema.image_png], 1: [TestSchema.id]},
+                      delta_threshold=1, timestamp_field=TestSchema.id)
+        with make_reader(synthetic_dataset.url, reader_pool_type='dummy', ngram=ngram,
+                         shuffle_row_groups=False) as reader:
+            w = next(iter(reader))
+        expected = {r['id']: r for r in synthetic_dataset.data}
+        np.testing.assert_array_equal(w[0].image_png, expected[w[0].id]['image_png'])
+
+    def test_ngram_shuffle_row_drop_spillover(self, synthetic_dataset):
+        """Row-drop partitions must not lose windows at partition boundaries."""
+        ngram = _ts_ngram(length=2, delta_threshold=1)
+        with make_reader(synthetic_dataset.url, reader_pool_type='dummy', ngram=ngram,
+                         shuffle_row_groups=False, shuffle_row_drop_partitions=2) as reader:
+            starts = sorted(w[0].id for w in reader)
+        expected = sorted(i for i in range(100) if i % 10 <= 8)
+        assert starts == expected

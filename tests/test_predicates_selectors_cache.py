@@ -1,0 +1,155 @@
+"""Unit tests for predicates, weighted sampling, and the disk cache."""
+
+import numpy as np
+import pytest
+
+from petastorm_tpu.cache import NullCache
+from petastorm_tpu.local_disk_cache import LocalDiskCache
+from petastorm_tpu.predicates import (in_intersection, in_lambda, in_negate,
+                                      in_pseudorandom_split, in_reduce, in_set)
+from petastorm_tpu.weighted_sampling_reader import WeightedSamplingReader
+from petastorm_tpu.errors import PetastormTpuError
+
+
+class TestPredicates:
+    def test_in_set(self):
+        p = in_set({1, 2}, 'f')
+        assert p.do_include({'f': 1}) and not p.do_include({'f': 3})
+        assert p.get_fields() == {'f'}
+
+    def test_in_intersection(self):
+        p = in_intersection({1, 2}, 'f')
+        assert p.do_include({'f': np.array([5, 2])})
+        assert not p.do_include({'f': np.array([5, 6])})
+        assert not p.do_include({'f': None})
+
+    def test_in_negate_and_reduce(self):
+        p = in_negate(in_set({1}, 'f'))
+        assert p.do_include({'f': 2})
+        both = in_reduce([in_set({1, 2}, 'f'), in_set({2, 3}, 'f')], all)
+        assert both.do_include({'f': 2}) and not both.do_include({'f': 1})
+        either = in_reduce([in_set({1}, 'f'), in_set({3}, 'f')], any)
+        assert either.do_include({'f': 3})
+
+    def test_in_lambda_with_state(self):
+        seen = []
+        p = in_lambda(['f'], lambda v, s: s.append(v['f']) or True, seen)
+        assert p.do_include({'f': 9})
+        assert seen == [9]
+
+    def test_pseudorandom_split_deterministic(self):
+        p = in_pseudorandom_split([0.3, 0.7], 0, 'f')
+        r1 = [p.do_include({'f': i}) for i in range(100)]
+        r2 = [p.do_include({'f': i}) for i in range(100)]
+        assert r1 == r2
+        assert 10 <= sum(r1) <= 60
+
+    def test_pseudorandom_split_validation(self):
+        with pytest.raises(ValueError):
+            in_pseudorandom_split([0.5, 0.6], 0, 'f')
+        with pytest.raises(ValueError):
+            in_pseudorandom_split([0.5], 2, 'f')
+
+
+class TestLocalDiskCache:
+    def test_read_through(self, tmp_path):
+        cache = LocalDiskCache(str(tmp_path))
+        calls = []
+
+        def fill():
+            calls.append(1)
+            return {'data': np.arange(5)}
+
+        v1 = cache.get('k1', fill)
+        v2 = cache.get('k1', fill)
+        assert len(calls) == 1
+        np.testing.assert_array_equal(v1['data'], v2['data'])
+
+    def test_eviction_under_size_limit(self, tmp_path):
+        cache = LocalDiskCache(str(tmp_path), size_limit_bytes=50_000)
+        for i in range(20):
+            cache.get('key_{}'.format(i), lambda i=i: np.zeros(1000, dtype=np.float64))
+        import os
+        total = sum(os.path.getsize(os.path.join(dp, f))
+                    for dp, _, fs in os.walk(str(tmp_path)) for f in fs)
+        assert total <= 60_000  # bounded (some slack for in-flight entry)
+
+    def test_cleanup(self, tmp_path):
+        d = tmp_path / 'c'
+        cache = LocalDiskCache(str(d), cleanup=True)
+        cache.get('k', lambda: 1)
+        cache.cleanup()
+        assert not d.exists()
+
+    def test_null_cache_never_stores(self):
+        calls = []
+        c = NullCache()
+        c.get('k', lambda: calls.append(1))
+        c.get('k', lambda: calls.append(1))
+        assert len(calls) == 2
+
+    def test_picklable(self, tmp_path):
+        import pickle
+        cache = LocalDiskCache(str(tmp_path))
+        restored = pickle.loads(pickle.dumps(cache))
+        assert restored.get('k', lambda: 7) == 7
+
+
+class TestWeightedSampling:
+    class FakeReader:
+        def __init__(self, value, schema):
+            self.value = value
+            self.batched_output = False
+            self.ngram = None
+            self.transformed_schema = schema
+            self.stopped = False
+
+        def __next__(self):
+            return self.value
+
+        def stop(self):
+            self.stopped = True
+
+        def join(self):
+            pass
+
+    def _schema(self):
+        from petastorm_tpu.codecs import ScalarCodec
+        from petastorm_tpu.unischema import Unischema, UnischemaField
+        return Unischema('S', [UnischemaField('x', np.int64, (), ScalarCodec(), False)])
+
+    def test_mixing_ratio(self):
+        schema = self._schema()
+        readers = [self.FakeReader('a', schema), self.FakeReader('b', schema)]
+        mixed = WeightedSamplingReader(readers, [0.8, 0.2], seed=0)
+        out = [next(mixed) for _ in range(1000)]
+        frac_a = out.count('a') / 1000
+        assert 0.75 < frac_a < 0.85
+
+    def test_mismatched_schema_rejected(self):
+        from petastorm_tpu.codecs import ScalarCodec
+        from petastorm_tpu.unischema import Unischema, UnischemaField
+        s1 = self._schema()
+        s2 = Unischema('S2', [UnischemaField('y', np.int64, (), ScalarCodec(), False)])
+        with pytest.raises(PetastormTpuError):
+            WeightedSamplingReader([self.FakeReader('a', s1), self.FakeReader('b', s2)],
+                                   [0.5, 0.5])
+
+    def test_stop_propagates(self):
+        schema = self._schema()
+        readers = [self.FakeReader('a', schema), self.FakeReader('b', schema)]
+        mixed = WeightedSamplingReader(readers, [0.5, 0.5])
+        mixed.stop(); mixed.join()
+        assert all(r.stopped for r in readers)
+
+
+def test_weighted_sampling_end_to_end(synthetic_dataset):
+    from petastorm_tpu import make_reader
+    r1 = make_reader(synthetic_dataset.url, reader_pool_type='dummy', num_epochs=None,
+                     schema_fields=['id'], predicate=None, shuffle_row_groups=False)
+    r2 = make_reader(synthetic_dataset.url, reader_pool_type='dummy', num_epochs=None,
+                     schema_fields=['id'], shuffle_row_groups=False)
+    mixed = WeightedSamplingReader([r1, r2], [0.5, 0.5], seed=1)
+    rows = [next(mixed) for _ in range(50)]
+    assert len(rows) == 50
+    mixed.stop(); mixed.join()

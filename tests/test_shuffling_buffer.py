@@ -1,0 +1,77 @@
+"""Shuffling buffer tests (modeled on reference tests/test_shuffling_buffer.py)."""
+
+import numpy as np
+import pytest
+
+from petastorm_tpu.shuffling_buffer import NoopShufflingBuffer, RandomShufflingBuffer
+
+
+class TestNoop:
+    def test_fifo(self):
+        buf = NoopShufflingBuffer()
+        buf.add_many([1, 2, 3])
+        assert [buf.retrieve() for _ in range(3)] == [1, 2, 3]
+        assert not buf.can_retrieve()
+
+
+class TestRandom:
+    def test_all_items_out(self):
+        buf = RandomShufflingBuffer(100, min_after_retrieve=10, seed=0)
+        buf.add_many(range(50))
+        out = []
+        while buf.can_retrieve():
+            out.append(buf.retrieve())
+        assert len(out) == 40  # stalls at the watermark
+        buf.finish()
+        while buf.can_retrieve():
+            out.append(buf.retrieve())
+        assert sorted(out) == list(range(50))
+
+    def test_shuffles(self):
+        buf = RandomShufflingBuffer(1000, min_after_retrieve=1, seed=7)
+        buf.add_many(range(500))
+        buf.finish()
+        out = [buf.retrieve() for _ in range(500)]
+        assert out != list(range(500))
+        assert sorted(out) == list(range(500))
+
+    def test_seeded_reproducible(self):
+        outs = []
+        for _ in range(2):
+            buf = RandomShufflingBuffer(100, min_after_retrieve=1, seed=42)
+            buf.add_many(range(100))
+            buf.finish()
+            outs.append([buf.retrieve() for _ in range(100)])
+        assert outs[0] == outs[1]
+
+    def test_can_add_respects_capacity(self):
+        buf = RandomShufflingBuffer(10, min_after_retrieve=2, extra_capacity=100)
+        assert buf.can_add()
+        buf.add_many(range(10))
+        assert not buf.can_add()
+
+    def test_overflow_raises(self):
+        buf = RandomShufflingBuffer(10, min_after_retrieve=2, extra_capacity=5)
+        with pytest.raises(RuntimeError):
+            buf.add_many(range(100))
+
+    def test_add_after_finish_raises(self):
+        buf = RandomShufflingBuffer(10, min_after_retrieve=2)
+        buf.finish()
+        with pytest.raises(RuntimeError):
+            buf.add_many([1])
+
+    def test_bad_watermark(self):
+        with pytest.raises(ValueError):
+            RandomShufflingBuffer(10, min_after_retrieve=10)
+
+    def test_decorrelation_quality(self):
+        """Rank correlation of shuffled vs input order should be near zero
+        (reference test_util/shuffling_analysis.py:52-85 methodology)."""
+        n = 2000
+        buf = RandomShufflingBuffer(n + 1, min_after_retrieve=1, extra_capacity=n, seed=1)
+        buf.add_many(range(n))
+        buf.finish()
+        out = np.array([buf.retrieve() for _ in range(n)])
+        corr = np.corrcoef(np.arange(n), out)[0, 1]
+        assert abs(corr) < 0.1
