@@ -1,0 +1,10 @@
+"""JAX adapter: the framework's primary training-loop interface.
+
+Replaces the reference's TF/torch adapter layer (tf_utils.py / pytorch.py) with
+a TPU-first design: batches collate into numpy host buffers, convert to (sharded)
+``jax.Array``s, and stream through a double-buffered device prefetch so host
+decode overlaps device compute.
+"""
+
+from petastorm_tpu.jax.loader import JaxDataLoader, make_jax_dataset  # noqa: F401
+from petastorm_tpu.jax.infeed import prefetch_to_device  # noqa: F401

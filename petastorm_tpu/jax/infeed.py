@@ -1,0 +1,74 @@
+"""Device infeed: double-buffered host->device staging.
+
+The BASELINE metric is input-stall % / TPU duty cycle: the device must never
+wait for the host. ``prefetch_to_device`` keeps ``size`` batches in flight —
+``jax.device_put`` is asynchronous, so transfer of batch N+1 overlaps compute
+on batch N (the classic double-buffering at size=2).
+
+Replaces the reference's ``tf.data`` prefetch / torch pin_memory+workers combo.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+import numpy as np
+
+
+#: numpy dtype kinds that can live on device; everything else (strings, objects,
+#: datetimes) stays host-side numpy
+JAX_COMPATIBLE_KINDS = ('b', 'i', 'u', 'f', 'c')
+
+
+def stage_batch(batch, target):
+    """Recursively move numeric arrays of a (possibly nested) batch dict onto
+    ``target`` — a ``jax.Device`` (device_put) or a ``jax.sharding.Sharding``
+    (global array assembled from this process's local shard). The single
+    canonical host->device staging routine, shared by :class:`JaxDataLoader`,
+    :func:`prefetch_to_device`, and ``parallel.make_global_batch``."""
+    import jax
+    from jax.sharding import Sharding
+
+    def put(x):
+        if isinstance(x, dict):
+            return {k: put(v) for k, v in x.items()}
+        if isinstance(x, np.ndarray) and x.dtype.kind in JAX_COMPATIBLE_KINDS:
+            if isinstance(target, Sharding):
+                global_shape = (x.shape[0] * jax.process_count(),) + x.shape[1:]
+                return jax.make_array_from_process_local_data(target, x, global_shape)
+            return jax.device_put(x, target)
+        return x
+
+    return put(batch)
+
+
+def prefetch_to_device(iterator, target=None, size=2):
+    """Yield batches from ``iterator`` staged onto ``target`` (a device or a
+    ``Sharding``; default: the default device), keeping ``size`` transfers in
+    flight ahead of the consumer.
+
+    :param iterator: iterable of batch dicts (possibly nested, e.g. NGram)
+    :param target: ``jax.Device`` | ``jax.sharding.Sharding`` | None
+    :param size: prefetch depth; 2 = double buffering
+    """
+    import jax
+
+    if target is None:
+        target = jax.devices()[0]
+    if size < 1:
+        raise ValueError('size must be >= 1')
+
+    queue = deque()
+    it = iter(iterator)
+    try:
+        while True:
+            while len(queue) < size:
+                try:
+                    queue.append(stage_batch(next(it), target))
+                except StopIteration:
+                    while queue:
+                        yield queue.popleft()
+                    return
+            yield queue.popleft()
+    finally:
+        queue.clear()

@@ -1,0 +1,176 @@
+"""JaxDataLoader: reader rows -> fixed-size batches of (sharded) jax Arrays.
+
+Functional parity with the reference's ``pytorch.DataLoader`` (pytorch.py:94-215):
+dtype sanitization, client-side shuffling buffer (row-wise transposition of
+batched readers' columnar output, :163-175), fixed-``batch_size`` accumulation,
+drain-then-final-batch on exhaustion (:182-192), context-manager stop (:209-215).
+
+TPU-first differences:
+  * static shapes by default (``drop_last=True``): XLA recompiles on shape
+    change, so ragged final batches are dropped unless asked for;
+  * output is a dict of numpy arrays, optionally converted to ``jax.Array``s
+    (single device or a ``Sharding``) — non-numeric columns stay numpy;
+  * NGram windows batch time-major: offset -> field -> ``[B, ...]`` arrays.
+"""
+
+from __future__ import annotations
+
+import logging
+from decimal import Decimal
+
+import numpy as np
+
+from petastorm_tpu.errors import PetastormTpuError
+from petastorm_tpu.jax.infeed import stage_batch
+from petastorm_tpu.shuffling_buffer import make_shuffling_buffer_factory
+
+logger = logging.getLogger(__name__)
+
+
+def _sanitize_value(value, field_name):
+    """numpy-ify one row value; Decimal -> float64 (reference pytorch.py:36-66
+    promotes torch-hostile dtypes similarly)."""
+    if isinstance(value, Decimal):
+        return np.float64(value)
+    if isinstance(value, np.datetime64):
+        return value.astype('datetime64[ns]').astype(np.int64)  # ns ticks
+    return value
+
+
+def collate_rows(rows, field_names=None):
+    """Stack a list of row dicts/namedtuples into a dict of [B, ...] arrays.
+
+    Fields with non-uniform shapes raise with guidance (pad/crop in a
+    TransformSpec); string/object fields become object arrays (host-only).
+    """
+    if not rows:
+        raise PetastormTpuError('Cannot collate an empty batch')
+    first = rows[0]
+    if hasattr(first, '_asdict'):
+        rows = [r._asdict() for r in rows]
+        first = rows[0]
+    names = field_names or list(first.keys())
+    batch = {}
+    for name in names:
+        values = [_sanitize_value(r[name], name) for r in rows]
+        v0 = values[0]
+        if v0 is None or isinstance(v0, (str, bytes)):
+            arr = np.empty(len(values), dtype=object)
+            arr[:] = values
+            batch[name] = arr
+            continue
+        try:
+            batch[name] = np.stack(values)
+        except ValueError:
+            shapes = {np.shape(v) for v in values}
+            if len(shapes) > 1:
+                raise PetastormTpuError(
+                    'Field {!r} has non-uniform shapes {} within a batch; use a '
+                    'TransformSpec to crop/pad it to a fixed shape, or exclude it via '
+                    'schema_fields.'.format(name, sorted(shapes)))
+            raise
+    return batch
+
+
+def _rows_from_columnar_batch(batch_namedtuple):
+    """Transpose a batched reader's columnar output into row dicts
+    (reference pytorch.py:163-175)."""
+    d = batch_namedtuple._asdict()
+    n = len(next(iter(d.values())))
+    return [{k: v[i] for k, v in d.items()} for i in range(n)]
+
+
+class JaxDataLoader(object):
+    """
+    :param reader: a :class:`petastorm_tpu.reader.Reader` (row or batch oriented)
+    :param batch_size: rows per emitted batch
+    :param shuffling_queue_capacity: >0 enables a client-side
+        :class:`RandomShufflingBuffer` of that capacity
+    :param min_after_retrieve: decorrelation floor of the shuffling buffer
+        (default capacity//2)
+    :param seed: shuffling buffer RNG seed
+    :param drop_last: drop the ragged final batch (default True: static shapes
+        keep XLA from recompiling)
+    :param to_device: ``None`` -> numpy host batches; a ``jax.Device`` -> arrays
+        committed to it; a ``jax.sharding.Sharding`` -> global sharded arrays
+        (multi-host: each process feeds its local shard)
+    """
+
+    def __init__(self, reader, batch_size, shuffling_queue_capacity=0,
+                 min_after_retrieve=None, seed=None, drop_last=True, to_device=None):
+        if batch_size < 1:
+            raise ValueError('batch_size must be >= 1')
+        self.reader = reader
+        self.batch_size = batch_size
+        self._drop_last = drop_last
+        self._to_device = to_device
+        self._make_buffer = make_shuffling_buffer_factory(
+            shuffling_queue_capacity, min_after_retrieve, seed, batch_size,
+            batched_reader=reader.batched_output)
+        self._ngram = getattr(reader, 'ngram', None)
+
+    # -- iteration ----------------------------------------------------------
+
+    def __iter__(self):
+        buffer = self._make_buffer()
+        pending = []
+        for item in self.reader:
+            if self.reader.batched_output:
+                buffer.add_many(_rows_from_columnar_batch(item))
+            else:
+                buffer.add_many([item])
+            while buffer.can_retrieve():
+                pending.append(buffer.retrieve())
+                if len(pending) == self.batch_size:
+                    yield self._emit(pending)
+                    pending = []
+        buffer.finish()
+        while buffer.can_retrieve():
+            pending.append(buffer.retrieve())
+            if len(pending) == self.batch_size:
+                yield self._emit(pending)
+                pending = []
+        if pending and not self._drop_last:
+            yield self._emit(pending)
+
+    def _emit(self, rows):
+        if self._ngram is not None:
+            batch = self._collate_ngram(rows)
+        else:
+            batch = collate_rows(rows)
+        if self._to_device is not None:
+            batch = self._stage(batch)
+        return batch
+
+    def _collate_ngram(self, windows):
+        """windows: list of dicts offset -> namedtuple. Returns
+        offset -> field -> [B, ...]."""
+        out = {}
+        for offset in windows[0]:
+            out[offset] = collate_rows([w[offset] for w in windows])
+        return out
+
+    def _stage(self, batch):
+        return stage_batch(batch, self._to_device)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def stop(self):
+        self.reader.stop()
+
+    def join(self):
+        self.reader.join()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc_value, tb):
+        self.stop()
+        self.join()
+
+
+def make_jax_dataset(reader, batch_size, **loader_kwargs):
+    """Generator of batches — the ``make_petastorm_dataset`` analog
+    (reference tf_utils.py:348-402)."""
+    loader = JaxDataLoader(reader, batch_size, **loader_kwargs)
+    return iter(loader)

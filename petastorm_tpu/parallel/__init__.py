@@ -1,0 +1,6 @@
+"""Mesh/sharding helpers: map the share-nothing reader topology onto a JAX mesh."""
+
+from petastorm_tpu.parallel.mesh import (  # noqa: F401
+    make_mesh, data_sharding, reader_shard_for_process, make_global_batch,
+    process_local_batch_size,
+)

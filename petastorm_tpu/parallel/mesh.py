@@ -1,0 +1,93 @@
+"""Mesh and sharding helpers.
+
+The reference's only distributed mechanism is arithmetic sharding:
+``index % shard_count == cur_shard`` (reference reader.py:485-502) — each
+training node reads a disjoint row-group subset with zero communication. Here
+the same share-nothing topology is derived from the JAX distributed runtime:
+
+  * ``reader_shard_for_process()`` -> (jax.process_index(), jax.process_count())
+    gives each pod host its reader shard;
+  * each host's loader produces the host-local rows of a global batch;
+  * ``make_global_batch`` assembles the global ``jax.Array`` via
+    ``jax.make_array_from_process_local_data`` — XLA moves nothing between
+    hosts for the data path (ICI/DCN are used only by model collectives).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def make_mesh(axis_names=('data',), axis_shapes=None, devices=None):
+    """Build a ``jax.sharding.Mesh``.
+
+    :param axis_names: mesh axis names, e.g. ``('data',)`` or ``('data', 'model')``
+    :param axis_shapes: sizes per axis — a sequence aligned with ``axis_names``,
+        or a dict ``{axis_name: size}``. ``None``/``-1`` entries (or a missing
+        dict key — at most one) absorb the remaining devices. Default: all
+        devices on the first axis.
+    :param devices: device list (default ``jax.devices()``)
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if axis_shapes is None:
+        shapes = [n] + [1] * (len(axis_names) - 1)
+    else:
+        if isinstance(axis_shapes, dict):
+            unknown_names = set(axis_shapes) - set(axis_names)
+            if unknown_names:
+                raise ValueError('axis_shapes names {} not in axis_names {}'.format(
+                    sorted(unknown_names), axis_names))
+            axis_shapes = [axis_shapes.get(name, -1) for name in axis_names]
+        shapes = list(axis_shapes)
+        if len(shapes) != len(axis_names):
+            raise ValueError('axis_shapes and axis_names must have equal length')
+        unknown = [i for i, s in enumerate(shapes) if s is None or s == -1]
+        known = int(np.prod([s for s in shapes if s not in (None, -1)])) if shapes else 1
+        if len(unknown) > 1:
+            raise ValueError('At most one axis size may be None/-1')
+        if unknown:
+            if n % known:
+                raise ValueError('{} devices not divisible by fixed axis product {}'.format(n, known))
+            shapes[unknown[0]] = n // known
+        if int(np.prod(shapes)) != n:
+            raise ValueError('Mesh shape {} does not use all {} devices'.format(shapes, n))
+    mesh_devices = np.asarray(devices).reshape(shapes)
+    return Mesh(mesh_devices, axis_names)
+
+
+def data_sharding(mesh, batch_axes='data'):
+    """NamedSharding that splits the leading (batch) dimension over the given
+    mesh axis (or axes)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+    if isinstance(batch_axes, str):
+        batch_axes = (batch_axes,)
+    return NamedSharding(mesh, PartitionSpec(batch_axes))
+
+
+def reader_shard_for_process():
+    """(cur_shard, shard_count) for this host — pass straight to make_reader
+    (replaces the reference's manual rank plumbing)."""
+    import jax
+    return jax.process_index(), jax.process_count()
+
+
+def process_local_batch_size(global_batch_size):
+    """Rows this host's loader must produce per global batch."""
+    import jax
+    if global_batch_size % jax.process_count():
+        raise ValueError('global_batch_size {} not divisible by process_count {}'.format(
+            global_batch_size, jax.process_count()))
+    return global_batch_size // jax.process_count()
+
+
+def make_global_batch(local_batch, sharding):
+    """dict of host-local numpy arrays -> dict of global sharded ``jax.Array``.
+
+    Non-numeric columns (strings, objects, datetimes) pass through as numpy —
+    host-side metadata cannot live on device."""
+    from petastorm_tpu.jax.infeed import stage_batch
+    return stage_batch(local_batch, sharding)

@@ -15,6 +15,21 @@ from collections import deque
 import numpy as np
 
 
+def make_shuffling_buffer_factory(capacity, min_after_retrieve=None, seed=None,
+                                  batch_size=1, batched_reader=False):
+    """Factory-of-factories shared by the JAX and torch loaders.
+
+    ``capacity <= 0`` -> FIFO passthrough. For batched (columnar) readers the
+    extra headroom is effectively unbounded: a whole row group is added at once
+    and may dwarf the capacity (reference pytorch.py:133-137 sizes the buffer
+    the same way)."""
+    if capacity <= 0:
+        return NoopShufflingBuffer
+    floor = min_after_retrieve if min_after_retrieve is not None else max(1, capacity // 2)
+    extra = 10 ** 8 if batched_reader else max(1000, batch_size)
+    return lambda: RandomShufflingBuffer(capacity, floor, extra_capacity=extra, seed=seed)
+
+
 class ShufflingBufferBase(object):
     def add_many(self, items):
         raise NotImplementedError

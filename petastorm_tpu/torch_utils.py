@@ -1,0 +1,103 @@
+"""PyTorch adapter — capability parity with the reference's ``petastorm.pytorch``
+(/root/reference/petastorm/pytorch.py:94-215): dtype sanitization, client-side
+shuffling buffer, fixed-size collation, partial final batch, context-manager
+stop. Torch is NOT the primary interface of this framework (the JAX loader is);
+this adapter exists so reference users can migrate incrementally.
+"""
+
+from __future__ import annotations
+
+from decimal import Decimal
+
+import numpy as np
+
+from petastorm_tpu.jax.loader import _rows_from_columnar_batch
+from petastorm_tpu.shuffling_buffer import make_shuffling_buffer_factory
+
+_TORCH_HOSTILE_PROMOTIONS = {
+    np.dtype(np.uint16): np.int32,
+    np.dtype(np.uint32): np.int64,
+    np.dtype(np.uint64): np.int64,
+}
+
+
+def _sanitize_torch_types(row_dict):
+    """Promote torch-hostile dtypes (reference pytorch.py:36-66)."""
+    out = {}
+    for name, value in row_dict.items():
+        if isinstance(value, Decimal):
+            value = float(value)
+        elif isinstance(value, np.datetime64):
+            value = value.astype('datetime64[ns]').astype(np.int64)
+        elif isinstance(value, np.ndarray):
+            if value.dtype in _TORCH_HOSTILE_PROMOTIONS:
+                value = value.astype(_TORCH_HOSTILE_PROMOTIONS[value.dtype])
+            elif value.dtype.kind in ('U', 'S', 'O'):
+                raise TypeError(
+                    'Field {!r} is a string/object array; torch tensors cannot hold it. '
+                    'Exclude it via schema_fields or convert it in a TransformSpec.'.format(name))
+        elif isinstance(value, np.generic) and value.dtype in _TORCH_HOSTILE_PROMOTIONS:
+            value = value.astype(_TORCH_HOSTILE_PROMOTIONS[value.dtype])
+        out[name] = value
+    return out
+
+
+def decimal_friendly_collate(batch):
+    """default_collate that tolerates Decimals (reference pytorch.py:69-91)."""
+    import torch
+    from torch.utils.data._utils.collate import default_collate
+    if isinstance(batch[0], Decimal):
+        return torch.tensor([float(x) for x in batch], dtype=torch.float64)
+    if isinstance(batch[0], dict):
+        return {k: decimal_friendly_collate([b[k] for b in batch]) for k in batch[0]}
+    return default_collate(batch)
+
+
+class DataLoader(object):
+    """Iterates a reader, accumulates ``batch_size`` rows, collates to torch
+    tensors; optional client-side shuffling buffer."""
+
+    def __init__(self, reader, batch_size=1, collate_fn=decimal_friendly_collate,
+                 shuffling_queue_capacity=0, min_after_retrieve=None, seed=None):
+        self.reader = reader
+        self.batch_size = batch_size
+        self.collate_fn = collate_fn
+        self._make_buffer = make_shuffling_buffer_factory(
+            shuffling_queue_capacity, min_after_retrieve, seed, batch_size,
+            batched_reader=reader.batched_output)
+
+    def __iter__(self):
+        buffer = self._make_buffer()
+        pending = []
+        for item in self.reader:
+            if self.reader.batched_output:
+                rows = _rows_from_columnar_batch(item)
+                buffer.add_many([_sanitize_torch_types(r) for r in rows])
+            else:
+                buffer.add_many([_sanitize_torch_types(item._asdict())])
+            while buffer.can_retrieve():
+                pending.append(buffer.retrieve())
+                if len(pending) == self.batch_size:
+                    yield self.collate_fn(pending)
+                    pending = []
+        buffer.finish()
+        while buffer.can_retrieve():
+            pending.append(buffer.retrieve())
+            if len(pending) == self.batch_size:
+                yield self.collate_fn(pending)
+                pending = []
+        if pending:  # partial final batch (reference pytorch.py:182-192)
+            yield self.collate_fn(pending)
+
+    def stop(self):
+        self.reader.stop()
+
+    def join(self):
+        self.reader.join()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc_value, tb):
+        self.stop()
+        self.join()

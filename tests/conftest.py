@@ -7,11 +7,18 @@ multi-node sharding in-process, test_end_to_end.py:426-448).
 
 import os
 
-# Must be set before jax (or anything importing jax) initializes its backends.
-os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+# Must run before jax initializes its backends. Force CPU (overriding any
+# ambient TPU platform, which this image pins via jax.config in sitecustomize):
+# the suite simulates an 8-device mesh so sharding logic is tested without pod
+# hardware.
+os.environ['JAX_PLATFORMS'] = 'cpu'
 xla_flags = os.environ.get('XLA_FLAGS', '')
 if '--xla_force_host_platform_device_count' not in xla_flags:
     os.environ['XLA_FLAGS'] = (xla_flags + ' --xla_force_host_platform_device_count=8').strip()
+
+import jax  # noqa: E402
+
+jax.config.update('jax_platforms', 'cpu')
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -1,0 +1,219 @@
+"""JAX adapter tests: loader collation, device staging, mesh sharding
+(runs on 8 virtual CPU devices — see conftest)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from petastorm_tpu import make_batch_reader, make_reader, TransformSpec
+from petastorm_tpu.errors import PetastormTpuError
+from petastorm_tpu.jax import JaxDataLoader, make_jax_dataset, prefetch_to_device
+from petastorm_tpu.parallel import (data_sharding, make_global_batch, make_mesh,
+                                    process_local_batch_size, reader_shard_for_process)
+
+
+FIXED_FIELDS = ['id', 'matrix', 'id_float']
+
+
+def test_loader_batches_fixed_shapes(synthetic_dataset):
+    with make_reader(synthetic_dataset.url, reader_pool_type='dummy',
+                     schema_fields=FIXED_FIELDS, shuffle_row_groups=False) as reader:
+        batches = list(JaxDataLoader(reader, batch_size=32))
+    assert len(batches) == 3  # 100 rows, drop_last=True
+    b = batches[0]
+    assert b['matrix'].shape == (32, 32, 16, 3)
+    assert b['id'].shape == (32,)
+    assert isinstance(b['id'], np.ndarray)  # host batch by default
+
+
+def test_loader_keep_last(synthetic_dataset):
+    with make_reader(synthetic_dataset.url, reader_pool_type='dummy',
+                     schema_fields=['id'], shuffle_row_groups=False) as reader:
+        batches = list(JaxDataLoader(reader, batch_size=32, drop_last=False))
+    assert [len(b['id']) for b in batches] == [32, 32, 32, 4]
+    all_ids = np.concatenate([b['id'] for b in batches])
+    assert sorted(all_ids.tolist()) == list(range(100))
+
+
+def test_loader_to_device(synthetic_dataset):
+    with make_reader(synthetic_dataset.url, reader_pool_type='dummy',
+                     schema_fields=['id', 'matrix'], shuffle_row_groups=False) as reader:
+        batch = next(iter(JaxDataLoader(reader, batch_size=16,
+                                        to_device=jax.devices()[0])))
+    assert isinstance(batch['id'], jax.Array)
+    assert batch['matrix'].dtype == jnp.float32
+
+
+def test_loader_sharded_across_mesh(synthetic_dataset):
+    mesh = make_mesh(('data',))
+    sharding = data_sharding(mesh)
+    with make_reader(synthetic_dataset.url, reader_pool_type='dummy',
+                     schema_fields=['id', 'matrix'], shuffle_row_groups=False) as reader:
+        batch = next(iter(JaxDataLoader(reader, batch_size=16, to_device=sharding)))
+    assert isinstance(batch['id'], jax.Array)
+    assert batch['id'].sharding == sharding
+    # each of the 8 devices holds 2 rows
+    assert len(batch['id'].addressable_shards) == 8
+    assert batch['id'].addressable_shards[0].data.shape == (2,)
+    # jit computation over the sharded array works
+    total = jax.jit(lambda x: jnp.sum(x))(batch['id'])
+    assert int(total) == sum(range(16))
+
+
+def test_loader_shuffling_buffer(synthetic_dataset):
+    def ids_with(capacity, seed):
+        with make_reader(synthetic_dataset.url, reader_pool_type='dummy',
+                         schema_fields=['id'], shuffle_row_groups=False) as reader:
+            loader = JaxDataLoader(reader, batch_size=10, drop_last=False,
+                                   shuffling_queue_capacity=capacity, seed=seed)
+            return np.concatenate([b['id'] for b in loader]).tolist()
+
+    plain = ids_with(0, None)
+    assert plain == list(range(100))
+    shuffled = ids_with(50, 3)
+    assert sorted(shuffled) == list(range(100))
+    assert shuffled != plain
+    assert ids_with(50, 3) == shuffled  # seeded => reproducible
+
+
+def test_loader_strings_stay_on_host(synthetic_dataset):
+    with make_reader(synthetic_dataset.url, reader_pool_type='dummy',
+                     schema_fields=['id', 'partition_key'],
+                     shuffle_row_groups=False) as reader:
+        batch = next(iter(JaxDataLoader(reader, batch_size=8,
+                                        to_device=jax.devices()[0])))
+    assert isinstance(batch['id'], jax.Array)
+    assert isinstance(batch['partition_key'], np.ndarray)
+    assert batch['partition_key'].dtype == object
+
+
+def test_loader_nonuniform_shape_raises_helpfully(synthetic_dataset):
+    with make_reader(synthetic_dataset.url, reader_pool_type='dummy',
+                     schema_fields=['id', 'matrix_string'],
+                     shuffle_row_groups=False) as reader:
+        with pytest.raises(PetastormTpuError, match='TransformSpec'):
+            next(iter(JaxDataLoader(reader, batch_size=8)))
+
+
+def test_loader_from_batch_reader(scalar_dataset):
+    with make_batch_reader(scalar_dataset.url, reader_pool_type='dummy',
+                           schema_fields=['id', 'float64', 'int_fixed_size_list'],
+                           shuffle_row_groups=False) as reader:
+        batches = list(JaxDataLoader(reader, batch_size=25))
+    assert len(batches) == 4
+    assert batches[0]['int_fixed_size_list'].shape == (25, 3)
+    ids = np.concatenate([b['id'] for b in batches])
+    assert sorted(ids.tolist()) == list(range(100))
+
+
+def test_loader_decimal_promoted(synthetic_dataset):
+    with make_reader(synthetic_dataset.url, reader_pool_type='dummy',
+                     schema_fields=['id', 'decimal'], shuffle_row_groups=False) as reader:
+        batch = next(iter(JaxDataLoader(reader, batch_size=8)))
+    assert batch['decimal'].dtype == np.float64
+
+
+def test_ngram_loader_batches(synthetic_dataset):
+    from petastorm_tpu.ngram import NGram
+    from petastorm_tpu.test_util.dataset_utils import TestSchema
+    ngram = NGram({0: [TestSchema.id, TestSchema.matrix], 1: [TestSchema.id]},
+                  delta_threshold=1, timestamp_field=TestSchema.id)
+    with make_reader(synthetic_dataset.url, reader_pool_type='dummy', ngram=ngram,
+                     shuffle_row_groups=False) as reader:
+        batch = next(iter(JaxDataLoader(reader, batch_size=4)))
+    assert sorted(batch.keys()) == [0, 1]
+    assert batch[0]['matrix'].shape == (4, 32, 16, 3)
+    np.testing.assert_array_equal(batch[1]['id'], batch[0]['id'] + 1)
+
+
+def test_prefetch_to_device(synthetic_dataset):
+    with make_reader(synthetic_dataset.url, reader_pool_type='dummy',
+                     schema_fields=['id'], shuffle_row_groups=False) as reader:
+        host_batches = JaxDataLoader(reader, batch_size=20)
+        staged = list(prefetch_to_device(host_batches, jax.devices()[0], size=2))
+    assert len(staged) == 5
+    assert all(isinstance(b['id'], jax.Array) for b in staged)
+    ids = np.concatenate([np.asarray(b['id']) for b in staged])
+    assert sorted(ids.tolist()) == list(range(100))
+
+
+def test_prefetch_with_sharding(synthetic_dataset):
+    mesh = make_mesh(('data',))
+    sharding = data_sharding(mesh)
+    with make_reader(synthetic_dataset.url, reader_pool_type='dummy',
+                     schema_fields=['id'], shuffle_row_groups=False) as reader:
+        staged = list(prefetch_to_device(JaxDataLoader(reader, batch_size=16),
+                                         sharding, size=2))
+    assert all(b['id'].sharding == sharding for b in staged)
+
+
+def test_make_jax_dataset(synthetic_dataset):
+    with make_reader(synthetic_dataset.url, reader_pool_type='dummy',
+                     schema_fields=['id'], shuffle_row_groups=False) as reader:
+        it = make_jax_dataset(reader, 50)
+        assert len(next(it)['id']) == 50
+
+
+class TestMeshHelpers:
+    def test_make_mesh_default(self):
+        mesh = make_mesh(('data',))
+        assert mesh.devices.shape == (8,)
+
+    def test_make_mesh_2d_with_wildcard(self):
+        mesh = make_mesh(('data', 'model'), axis_shapes=(-1, 2))
+        assert mesh.devices.shape == (4, 2)
+
+    def test_make_mesh_bad_shape(self):
+        with pytest.raises(ValueError):
+            make_mesh(('data', 'model'), axis_shapes=(3, 2))
+
+    def test_reader_shard_for_process(self):
+        cur, count = reader_shard_for_process()
+        assert (cur, count) == (0, 1)  # single-process test env
+
+    def test_process_local_batch_size(self):
+        assert process_local_batch_size(64) == 64
+
+    def test_make_global_batch(self):
+        mesh = make_mesh(('data',))
+        sharding = data_sharding(mesh)
+        local = {'x': np.arange(16, dtype=np.float32), 's': np.array(['a'] * 16, dtype=object)}
+        global_batch = make_global_batch(local, sharding)
+        assert isinstance(global_batch['x'], jax.Array)
+        assert global_batch['s'].dtype == object
+
+
+def test_shuffling_with_batch_reader_large_rowgroup(tmp_path):
+    """A whole row group added at once must not overflow the shuffling buffer
+    (regression: extra_capacity too small for columnar adds)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    from petastorm_tpu.fs import path_to_url
+    path = tmp_path / 'big_rg'
+    path.mkdir()
+    pq.write_table(pa.table({'id': np.arange(3000)}), str(path / 'f.parquet'),
+                   row_group_size=3000)
+    with make_batch_reader(path_to_url(path), reader_pool_type='dummy') as reader:
+        loader = JaxDataLoader(reader, batch_size=64, shuffling_queue_capacity=100, seed=0)
+        ids = np.concatenate([b['id'] for b in loader])
+    assert len(ids) == 2944  # 3000 - ragged last batch dropped
+
+
+def test_make_mesh_dict_shapes():
+    mesh = make_mesh(('data', 'model'), axis_shapes={'model': 2})
+    assert mesh.devices.shape == (4, 2)
+    with pytest.raises(ValueError):
+        make_mesh(('data',), axis_shapes={'bogus': 2})
+
+
+def test_make_global_batch_datetime_stays_host():
+    mesh = make_mesh(('data',))
+    sharding = data_sharding(mesh)
+    local = {'ts': np.array(['2024-01-01'] * 8, dtype='datetime64[ns]'),
+             'x': np.arange(8, dtype=np.float32)}
+    out = make_global_batch(local, sharding)
+    assert isinstance(out['ts'], np.ndarray)  # host-side
+    import jax as _jax
+    assert isinstance(out['x'], _jax.Array)
