@@ -1,0 +1,10 @@
+"""Reference models consuming the input pipeline.
+
+The framework's job is feeding TPUs (BASELINE.md: ImageNet-Parquet ResNet-50
+examples/sec/chip and input-stall %); these models are the measurement loads:
+ResNet-50 (flagship, mirrors the reference's imagenet example) and a small
+MNIST convnet (mirrors examples/mnist).
+"""
+
+from petastorm_tpu.models.resnet import ResNet, resnet18, resnet50  # noqa: F401
+from petastorm_tpu.models.mnist import MnistCNN  # noqa: F401

@@ -1,0 +1,31 @@
+"""Small MNIST convnet — the framework analog of the reference's
+examples/mnist model (which lives in torch there)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MnistCNN(nn.Module):
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train=True):
+        x = x.astype(self.dtype)
+        if x.ndim == 3:
+            x = x[..., None]  # HWC with a single channel
+        x = nn.Conv(32, (3, 3), dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(64, (3, 3), dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(128, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        return x

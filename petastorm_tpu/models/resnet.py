@@ -1,0 +1,110 @@
+"""ResNet in flax.linen, TPU-first.
+
+The reference ships an ImageNet *data* example (examples/imagenet/schema.py) and
+leaves the model to torch; here the model is part of the framework so the
+BASELINE pipeline (ImageNet-Parquet -> ResNet-50 on TPU) is self-contained.
+
+TPU notes: NHWC layout (XLA's native conv layout on TPU), bfloat16 compute with
+float32 batch-norm statistics and output head, stride-2 3x3 convs land on the
+MXU as implicit GEMMs.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    strides: int = 1
+    conv: ModuleDef = nn.Conv
+    norm: ModuleDef = nn.BatchNorm
+    act: Callable = nn.relu
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1), use_bias=False, name='conv1')(x)
+        y = self.norm(name='bn1')(y)
+        y = self.act(y)
+        y = self.conv(self.filters, (3, 3), (self.strides, self.strides),
+                      use_bias=False, name='conv2')(y)
+        y = self.norm(name='bn2')(y)
+        y = self.act(y)
+        y = self.conv(4 * self.filters, (1, 1), use_bias=False, name='conv3')(y)
+        y = self.norm(scale_init=nn.initializers.zeros_init(), name='bn3')(y)
+        if residual.shape != y.shape:
+            residual = self.conv(4 * self.filters, (1, 1), (self.strides, self.strides),
+                                 use_bias=False, name='conv_proj')(residual)
+            residual = self.norm(name='bn_proj')(residual)
+        return self.act(residual + y)
+
+
+class BasicBlock(nn.Module):
+    filters: int
+    strides: int = 1
+    conv: ModuleDef = nn.Conv
+    norm: ModuleDef = nn.BatchNorm
+    act: Callable = nn.relu
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (3, 3), (self.strides, self.strides),
+                      use_bias=False, name='conv1')(x)
+        y = self.norm(name='bn1')(y)
+        y = self.act(y)
+        y = self.conv(self.filters, (3, 3), use_bias=False, name='conv2')(y)
+        y = self.norm(scale_init=nn.initializers.zeros_init(), name='bn2')(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters, (1, 1), (self.strides, self.strides),
+                                 use_bias=False, name='conv_proj')(residual)
+            residual = self.norm(name='bn_proj')(residual)
+        return self.act(residual + y)
+
+
+class ResNet(nn.Module):
+    """:param stage_sizes: blocks per stage, e.g. [3, 4, 6, 3] for ResNet-50
+    :param block_cls: BottleneckBlock or BasicBlock
+    :param num_classes: classifier width
+    :param dtype: compute dtype (bfloat16 recommended on TPU; norms and the
+        final logits run in float32 regardless)
+    """
+
+    stage_sizes: Sequence[int]
+    block_cls: ModuleDef
+    num_classes: int = 1000
+    num_filters: int = 64
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train=True):
+        conv = partial(nn.Conv, dtype=self.dtype)
+        # compute in self.dtype; statistics/params stay float32 (param_dtype default)
+        norm = partial(nn.BatchNorm, use_running_average=not train, momentum=0.9,
+                       epsilon=1e-5, dtype=self.dtype)
+        x = x.astype(self.dtype)
+        x = conv(self.num_filters, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
+                 use_bias=False, name='conv_init')(x)
+        x = norm(name='bn_init')(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        for i, block_count in enumerate(self.stage_sizes):
+            for j in range(block_count):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = self.block_cls(self.num_filters * 2 ** i, strides=strides,
+                                   conv=conv, norm=norm,
+                                   name='stage{}_block{}'.format(i + 1, j))(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name='head')(x)
+        return x
+
+
+resnet18 = partial(ResNet, stage_sizes=[2, 2, 2, 2], block_cls=BasicBlock)
+resnet50 = partial(ResNet, stage_sizes=[3, 4, 6, 3], block_cls=BottleneckBlock)

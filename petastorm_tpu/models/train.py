@@ -1,0 +1,111 @@
+"""Mesh-sharded training steps for the reference models.
+
+Scaling design (per the standard JAX recipe: pick a mesh, annotate shardings,
+let XLA insert collectives): the batch shards over the ``data`` axis, parameters
+replicate except where a rule maps them onto the ``model`` axis (the classifier
+head by default — the only big matmul in ResNet worth TP at this scale). The
+gradient all-reduce over ``data`` and the head all-gather over ``model`` are
+inserted by XLA from the sharding annotations; nothing is hand-written.
+
+The reference has no model-side code at all (SURVEY.md §2.9) — this module is
+the TPU-native bridge from its data capabilities to actual training.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax.training import train_state
+
+
+class TrainState(train_state.TrainState):
+    batch_stats: Any = None
+
+
+def create_train_state(model, rng, sample_input, tx=None, learning_rate=0.1):
+    """Initialize model variables and the optimizer state."""
+    variables = model.init(rng, sample_input, train=False)
+    if tx is None:
+        tx = optax.sgd(learning_rate, momentum=0.9)
+    return TrainState.create(
+        apply_fn=model.apply,
+        params=variables['params'],
+        batch_stats=variables.get('batch_stats'),
+        tx=tx)
+
+
+def _spec_for_path(path, mesh_axis_names):
+    """Default TP rules: classifier head kernel shards its output dim on
+    'model'; its bias shards on 'model'; everything else replicates."""
+    from jax.sharding import PartitionSpec as P
+    if 'model' not in mesh_axis_names:
+        return P()
+    if re.search(r'(^|/)head/kernel$', path):
+        return P(None, 'model')
+    if re.search(r'(^|/)head/bias$', path):
+        return P('model')
+    return P()
+
+
+def state_shardings(state, mesh):
+    """NamedSharding tree for a TrainState under ``mesh``."""
+    from jax.sharding import NamedSharding
+
+    def path_str(path):
+        return '/'.join(str(getattr(k, 'key', getattr(k, 'idx', k))) for k in path)
+
+    def assign(path, leaf):
+        return NamedSharding(mesh, _spec_for_path(path_str(path), mesh.axis_names))
+
+    return jax.tree_util.tree_map_with_path(assign, state)
+
+
+def shard_train_state(state, mesh):
+    """Place a host TrainState onto the mesh per the sharding rules."""
+    return jax.device_put(state, state_shardings(state, mesh))
+
+
+def cross_entropy_loss(logits, labels):
+    return optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
+
+
+def make_train_step(donate=True):
+    """Jitted (state, images, labels) -> (state, metrics). Sharding follows the
+    arguments' placement (shard the state with :func:`shard_train_state` and the
+    batch with a ``data`` NamedSharding); XLA inserts the collectives."""
+
+    def train_step(state, images, labels):
+        def loss_fn(params):
+            if state.batch_stats is not None:
+                logits, updates = state.apply_fn(
+                    {'params': params, 'batch_stats': state.batch_stats},
+                    images, train=True, mutable=['batch_stats'])
+            else:
+                logits = state.apply_fn({'params': params}, images, train=True)
+                updates = {}
+            return cross_entropy_loss(logits, labels), (logits, updates)
+
+        (loss, (logits, updates)), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
+        new_state = state.apply_gradients(grads=grads)
+        if state.batch_stats is not None:
+            new_state = new_state.replace(batch_stats=updates['batch_stats'])
+        accuracy = jnp.mean(jnp.argmax(logits, -1) == labels)
+        return new_state, {'loss': loss, 'accuracy': accuracy}
+
+    return jax.jit(train_step, donate_argnums=(0,) if donate else ())
+
+
+def make_eval_step():
+    def eval_step(state, images, labels):
+        variables = {'params': state.params}
+        if state.batch_stats is not None:
+            variables['batch_stats'] = state.batch_stats
+        logits = state.apply_fn(variables, images, train=False)
+        return {'loss': cross_entropy_loss(logits, labels),
+                'accuracy': jnp.mean(jnp.argmax(logits, -1) == labels)}
+
+    return jax.jit(eval_step)
