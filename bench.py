@@ -1,0 +1,67 @@
+#!/usr/bin/env python
+"""Headline benchmark: hello_world reader throughput vs the reference.
+
+Reproduces the reference's published benchmark configuration
+(docs/benchmarks_tutorial.rst:20-21 -> 709.84 samples/sec): the HelloWorld
+schema (README.rst:70-103 — int32 id + 128x256x3 png image + ragged uint8
+array), default 3 thread workers, pure-python read path, warmup then measured
+cycles. Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO_ROOT)
+
+CACHE_DIR = os.path.join(REPO_ROOT, '.bench_cache', 'hello_world')
+BASELINE_SAMPLES_PER_SEC = 709.84  # reference docs/benchmarks_tutorial.rst:20-21
+NUM_ROWS = 1000
+
+
+def _build_dataset(url):
+    import numpy as np
+
+    from petastorm_tpu.codecs import CompressedImageCodec, NdarrayCodec, ScalarCodec
+    from petastorm_tpu.etl.dataset_metadata import materialize_dataset
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+
+    schema = Unischema('HelloWorldSchema', [
+        UnischemaField('id', np.int32, (), ScalarCodec(), False),
+        UnischemaField('image1', np.uint8, (128, 256, 3), CompressedImageCodec('png'), False),
+        UnischemaField('array_4d', np.uint8, (None, 128, 30, None), NdarrayCodec(), False),
+    ])
+    rng = np.random.default_rng(42)
+    with materialize_dataset(url, schema, rows_per_row_group=100) as writer:
+        for i in range(NUM_ROWS):
+            writer.write({
+                'id': i,
+                'image1': rng.integers(0, 255, (128, 256, 3), dtype=np.uint8),
+                'array_4d': rng.integers(0, 255, (4, 128, 30, 3), dtype=np.uint8),
+            })
+
+
+def main():
+    url = 'file://' + CACHE_DIR
+    if not os.path.exists(os.path.join(CACHE_DIR, '_common_metadata')):
+        os.makedirs(CACHE_DIR, exist_ok=True)
+        _build_dataset(url)
+
+    from petastorm_tpu.tools.throughput import reader_throughput
+
+    result = reader_throughput(url, warmup_cycles=200, measure_cycles=2000,
+                               pool_type='thread', workers_count=3,
+                               shuffle_row_groups=True, read_method='python')
+    print(json.dumps({
+        'metric': 'hello_world_reader_throughput',
+        'value': round(result.samples_per_second, 2),
+        'unit': 'samples/sec',
+        'vs_baseline': round(result.samples_per_second / BASELINE_SAMPLES_PER_SEC, 3),
+    }))
+
+
+if __name__ == '__main__':
+    main()

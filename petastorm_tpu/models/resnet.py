@@ -73,8 +73,9 @@ class ResNet(nn.Module):
     """:param stage_sizes: blocks per stage, e.g. [3, 4, 6, 3] for ResNet-50
     :param block_cls: BottleneckBlock or BasicBlock
     :param num_classes: classifier width
-    :param dtype: compute dtype (bfloat16 recommended on TPU; norms and the
-        final logits run in float32 regardless)
+    :param dtype: compute dtype (bfloat16 recommended on TPU). Batch-norm
+        statistics/params and the final logits head stay float32; norm compute
+        follows ``dtype``.
     """
 
     stage_sizes: Sequence[int]

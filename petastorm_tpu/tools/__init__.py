@@ -1,0 +1,7 @@
+"""CLI tools: throughput benchmark, dataset copy, metadata generation/inspection.
+
+Parity: /root/reference/petastorm/tools/ and petastorm/benchmark/ (console
+scripts petastorm-throughput.py, petastorm-copy-dataset.py,
+petastorm-generate-metadata.py, setup.py:89-95). Run as modules:
+``python -m petastorm_tpu.tools.throughput <url>`` etc.
+"""

@@ -1,0 +1,69 @@
+"""Copy a dataset with optional column subsetting and not-null filtering.
+
+Parity: /root/reference/petastorm/tools/copy_dataset.py (regex column subset,
+not-null row filter, row-group size override :35-92; CLI :95-151) — without the
+Spark session; the copy streams through a reader into a local writer.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from petastorm_tpu import make_reader
+from petastorm_tpu.etl.dataset_metadata import materialize_dataset
+from petastorm_tpu.predicates import in_lambda
+from petastorm_tpu.unischema import Unischema
+
+
+def copy_dataset(source_url, target_url, field_regex=None, not_null_fields=None,
+                 rows_per_row_group=None, row_group_size_mb=None, rows_per_file=None,
+                 workers_count=5):
+    """Stream-copy ``source_url`` to ``target_url``.
+
+    :param field_regex: list of regexes selecting the columns to copy
+    :param not_null_fields: rows where any of these fields is null are skipped
+    :param rows_per_row_group / row_group_size_mb / rows_per_file: output layout
+    """
+    predicate = None
+    if not_null_fields:
+        predicate = in_lambda(list(not_null_fields),
+                              lambda v: all(v[f] is not None for f in not_null_fields))
+    with make_reader(source_url, schema_fields=field_regex, predicate=predicate,
+                     reader_pool_type='thread', workers_count=workers_count,
+                     shuffle_row_groups=False) as reader:
+        out_schema = Unischema('CopiedSchema', list(reader.transformed_schema.fields.values()))
+        with materialize_dataset(target_url, out_schema,
+                                 rows_per_row_group=rows_per_row_group,
+                                 row_group_size_mb=row_group_size_mb,
+                                 rows_per_file=rows_per_file) as writer:
+            count = 0
+            for row in reader:
+                writer.write(row._asdict())
+                count += 1
+    return count
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description='Copy a petastorm_tpu dataset '
+                                     '(reference petastorm-copy-dataset.py parity).')
+    parser.add_argument('source_url')
+    parser.add_argument('target_url')
+    parser.add_argument('--field-regex', nargs='+', default=None)
+    parser.add_argument('--not-null-fields', nargs='+', default=None)
+    parser.add_argument('--rows-per-row-group', type=int, default=None)
+    parser.add_argument('--row-group-size-mb', type=int, default=None)
+    parser.add_argument('--rows-per-file', type=int, default=None)
+    parser.add_argument('-w', '--workers-count', type=int, default=5)
+    args = parser.parse_args(argv)
+    count = copy_dataset(args.source_url, args.target_url, field_regex=args.field_regex,
+                         not_null_fields=args.not_null_fields,
+                         rows_per_row_group=args.rows_per_row_group,
+                         row_group_size_mb=args.row_group_size_mb,
+                         rows_per_file=args.rows_per_file, workers_count=args.workers_count)
+    print('Copied {} rows'.format(count))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -1,0 +1,71 @@
+"""Retrofit petastorm_tpu metadata onto an existing Parquet store.
+
+Parity: /root/reference/petastorm/etl/petastorm_generate_metadata.py (:48-110)
+— regenerates the unischema + row-group-count keys in ``_common_metadata`` for
+a dataset whose metadata was lost, or for a store written by another tool.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import json
+import sys
+
+from petastorm_tpu.etl import dataset_metadata
+from petastorm_tpu.fs import FilesystemResolver
+from petastorm_tpu.unischema import Unischema
+
+
+def _load_schema_object(dotted):
+    """'pkg.module.SCHEMA_ATTR' -> Unischema object."""
+    module_name, _, attr = dotted.rpartition('.')
+    if not module_name:
+        raise ValueError('--unischema-class must be a dotted path like mypkg.schema.MySchema')
+    module = importlib.import_module(module_name)
+    schema = getattr(module, attr)
+    if not isinstance(schema, Unischema):
+        raise TypeError('{} is not a Unischema (got {})'.format(dotted, type(schema)))
+    return schema
+
+
+def generate_metadata(dataset_url, unischema_class=None, use_footer_counts=True):
+    """Write/overwrite the dataset's ``_common_metadata``.
+
+    :param unischema_class: dotted path to a Unischema object; when omitted the
+        existing stored schema is reused, else inferred from the Arrow schema
+        (codec information cannot be recovered by inference — pass the class for
+        petastorm-written datasets whose metadata was lost).
+    """
+    if unischema_class is not None:
+        schema = _load_schema_object(unischema_class)
+    else:
+        schema = dataset_metadata.infer_or_load_unischema(dataset_url)
+
+    # row-group counts from the file footers (the ground truth)
+    pieces = dataset_metadata.load_row_groups(dataset_url, schema=schema)
+    resolver = FilesystemResolver(dataset_url)
+    root = resolver.get_dataset_path()
+    counts = {}
+    import os
+    for piece in pieces:
+        rel = os.path.relpath(piece.path, root).replace(os.sep, '/')
+        counts.setdefault(rel, []).append(piece.num_rows)
+    dataset_metadata._write_dataset_metadata(dataset_url, schema, counts)
+    return schema, sum(len(v) for v in counts.values())
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description='(Re)generate petastorm_tpu metadata '
+                                     '(reference petastorm-generate-metadata.py parity).')
+    parser.add_argument('dataset_url')
+    parser.add_argument('--unischema-class', default=None,
+                        help='dotted path to the Unischema object, e.g. examples.hello_world.schema.HelloWorldSchema')
+    args = parser.parse_args(argv)
+    schema, n_row_groups = generate_metadata(args.dataset_url, args.unischema_class)
+    print('Wrote metadata: schema={} fields, {} row groups'.format(len(schema), n_row_groups))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

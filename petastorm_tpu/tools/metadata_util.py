@@ -1,0 +1,44 @@
+"""Inspect dataset metadata: schema and row-group indexes.
+
+Parity: /root/reference/petastorm/etl/metadata_util.py (:24-70).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from petastorm_tpu.etl import dataset_metadata
+from petastorm_tpu.etl.rowgroup_indexing import get_row_group_indexes
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description='Inspect petastorm_tpu dataset metadata.')
+    parser.add_argument('dataset_url')
+    parser.add_argument('--schema', action='store_true', help='print the unischema')
+    parser.add_argument('--index', action='store_true', help='print row-group index summaries')
+    parser.add_argument('--skip-index-values', action='store_true',
+                        help='with --index: omit the indexed values listing')
+    parser.add_argument('--pieces', action='store_true', help='print row-group pieces')
+    args = parser.parse_args(argv)
+
+    if args.schema:
+        schema = dataset_metadata.get_schema(args.dataset_url)
+        print(repr(schema))
+    if args.index:
+        indexes = get_row_group_indexes(args.dataset_url)
+        for name, indexer in sorted(indexes.items()):
+            print('index {!r} on columns {}:'.format(name, indexer.column_names))
+            values = indexer.indexed_values
+            print('  {} indexed values'.format(len(values)))
+            if not args.skip_index_values:
+                for value in values:
+                    print('   {!r} -> {}'.format(value, sorted(indexer.get_row_group_indexes(value))))
+    if args.pieces:
+        for i, piece in enumerate(dataset_metadata.load_row_groups(args.dataset_url)):
+            print('{:4d}: {}'.format(i, piece))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

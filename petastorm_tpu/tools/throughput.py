@@ -1,0 +1,169 @@
+"""Reader throughput benchmark.
+
+Parity: /root/reference/petastorm/benchmark/throughput.py (warmup + measured
+cycles, samples/sec, RSS, CPU% via psutil :113-174) and benchmark/cli.py.
+
+TPU-first addition: ``--read-method jax`` measures the full device-feed
+pipeline and reports **input-stall fraction** — the share of wall time the
+consumer spent waiting on the host pipeline vs. consuming — which is the
+BASELINE.md north-star metric (>=95% duty cycle == <=5% stall).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class BenchmarkResult:
+    samples_per_second: float
+    duration_s: float
+    samples: int
+    memory_rss_mb: float = 0.0
+    cpu_percent: float = 0.0
+    input_stall_fraction: float = None
+    extra: dict = field(default_factory=dict)
+
+    def __str__(self):
+        s = '{:.2f} samples/sec; {:.2f} MB RSS; {:.2f}% CPU'.format(
+            self.samples_per_second, self.memory_rss_mb, self.cpu_percent)
+        if self.input_stall_fraction is not None:
+            s += '; {:.2f}% input stall'.format(100 * self.input_stall_fraction)
+        return s
+
+
+def _process_stats():
+    import psutil
+    proc = psutil.Process()
+    return proc.memory_info().rss / (1 << 20), proc.cpu_percent(interval=None)
+
+
+def reader_throughput(dataset_url, field_regex=None, warmup_cycles=200, measure_cycles=1000,
+                      pool_type='thread', workers_count=3, shuffle_row_groups=True,
+                      read_method='python', batch_size=64, make_reader_fn=None):
+    """Measure read throughput in samples/sec.
+
+    :param read_method: 'python' — iterate raw reader rows (reference parity);
+        'jax' — JaxDataLoader + device staging with stall accounting.
+    """
+    from petastorm_tpu import make_reader
+
+    make_reader_fn = make_reader_fn or make_reader
+    reader = make_reader_fn(dataset_url,
+                            schema_fields=field_regex,
+                            reader_pool_type=pool_type,
+                            workers_count=workers_count,
+                            shuffle_row_groups=shuffle_row_groups,
+                            num_epochs=None)
+    try:
+        import psutil
+        psutil.Process().cpu_percent(interval=None)  # prime the counter
+        if read_method == 'python':
+            it = iter(reader)
+            for _ in range(warmup_cycles):
+                next(it)
+            t0 = time.perf_counter()
+            for _ in range(measure_cycles):
+                next(it)
+            duration = time.perf_counter() - t0
+            samples = measure_cycles
+            stall = None
+        elif read_method == 'jax':
+            import jax
+            from petastorm_tpu.jax import JaxDataLoader, prefetch_to_device
+            loader = prefetch_to_device(JaxDataLoader(reader, batch_size=batch_size),
+                                        jax.devices()[0], size=2)
+            warmup_batches = max(1, warmup_cycles // batch_size)
+            measure_batches = max(1, measure_cycles // batch_size)
+            it = iter(loader)
+            for _ in range(warmup_batches):
+                jax.block_until_ready(next(it))
+            wait_time = 0.0
+            t0 = time.perf_counter()
+            for _ in range(measure_batches):
+                w0 = time.perf_counter()
+                batch = next(it)
+                jax.block_until_ready(batch)
+                wait_time += time.perf_counter() - w0
+            duration = time.perf_counter() - t0
+            samples = measure_batches * batch_size
+            stall = wait_time / duration if duration > 0 else 0.0
+        else:
+            raise ValueError('Unknown read_method {!r}'.format(read_method))
+        rss_mb, cpu = _process_stats()
+        return BenchmarkResult(samples_per_second=samples / duration, duration_s=duration,
+                               samples=samples, memory_rss_mb=rss_mb, cpu_percent=cpu,
+                               input_stall_fraction=stall)
+    finally:
+        reader.stop()
+        reader.join()
+
+
+def pipeline_duty_cycle(dataset_url, step_fn, batch_to_args, batch_size=64, steps=50,
+                        warmup_steps=5, loader_kwargs=None, reader_kwargs=None):
+    """Measure input-stall % while running an actual jitted training step: the
+    BASELINE configuration. ``step_fn(*batch_to_args(batch))`` is executed per
+    batch; stall = time blocked waiting for data / total wall time."""
+    import jax
+
+    from petastorm_tpu import make_reader
+    from petastorm_tpu.jax import JaxDataLoader, prefetch_to_device
+
+    reader = make_reader(dataset_url, num_epochs=None, **(reader_kwargs or {}))
+    try:
+        loader = prefetch_to_device(
+            JaxDataLoader(reader, batch_size=batch_size, **(loader_kwargs or {})),
+            jax.devices()[0], size=2)
+        it = iter(loader)
+        out = None
+        for _ in range(warmup_steps):
+            out = step_fn(*batch_to_args(next(it)))
+        jax.block_until_ready(out)
+        wait = 0.0
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            w0 = time.perf_counter()
+            batch = next(it)
+            wait += time.perf_counter() - w0
+            out = step_fn(*batch_to_args(batch))
+        jax.block_until_ready(out)
+        duration = time.perf_counter() - t0
+        return BenchmarkResult(
+            samples_per_second=steps * batch_size / duration, duration_s=duration,
+            samples=steps * batch_size, input_stall_fraction=wait / duration,
+            extra={'steps': steps})
+    finally:
+        reader.stop()
+        reader.join()
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description='Measure reader throughput (reference petastorm-throughput.py parity).')
+    parser.add_argument('dataset_url')
+    parser.add_argument('-f', '--field-regex', nargs='+', default=None,
+                        help='only read fields matching these regexes')
+    parser.add_argument('-m', '--warmup-cycles', type=int, default=200)
+    parser.add_argument('-n', '--measure-cycles', type=int, default=1000)
+    parser.add_argument('-p', '--pool-type', choices=('thread', 'process', 'dummy'),
+                        default='thread')
+    parser.add_argument('-w', '--workers-count', type=int, default=3)
+    parser.add_argument('-d', '--read-method', choices=('python', 'jax'), default='python')
+    parser.add_argument('--batch-size', type=int, default=64)
+    parser.add_argument('--no-shuffle', action='store_true')
+    args = parser.parse_args(argv)
+
+    result = reader_throughput(
+        args.dataset_url, field_regex=args.field_regex, warmup_cycles=args.warmup_cycles,
+        measure_cycles=args.measure_cycles, pool_type=args.pool_type,
+        workers_count=args.workers_count, shuffle_row_groups=not args.no_shuffle,
+        read_method=args.read_method, batch_size=args.batch_size)
+    print(result)
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

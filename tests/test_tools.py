@@ -1,0 +1,131 @@
+"""CLI tools tests (modeled on reference tests/test_copy_dataset.py,
+tests/test_generate_metadata.py, benchmark smoke)."""
+
+import numpy as np
+import pytest
+
+from petastorm_tpu import make_reader
+from petastorm_tpu.etl.dataset_metadata import get_schema, load_row_groups
+from petastorm_tpu.fs import path_to_url
+from petastorm_tpu.tools.copy_dataset import copy_dataset, main as copy_main
+from petastorm_tpu.tools.generate_metadata import generate_metadata
+from petastorm_tpu.tools.metadata_util import main as metadata_main
+from petastorm_tpu.tools.throughput import main as throughput_main, reader_throughput
+
+
+class TestCopyDataset:
+    def test_full_copy(self, synthetic_dataset, tmp_path):
+        target = path_to_url(tmp_path / 'copy')
+        count = copy_dataset(synthetic_dataset.url, target, rows_per_row_group=25)
+        assert count == 100
+        with make_reader(target, reader_pool_type='dummy', shuffle_row_groups=False) as r:
+            ids = sorted(row.id for row in r)
+        assert ids == list(range(100))
+        assert len(load_row_groups(target)) == 4
+
+    def test_column_subset(self, synthetic_dataset, tmp_path):
+        target = path_to_url(tmp_path / 'copy')
+        copy_dataset(synthetic_dataset.url, target, field_regex=['id', 'matrix'],
+                     rows_per_row_group=50)
+        schema = get_schema(target)
+        assert set(schema.fields) == {'id', 'matrix'}
+
+    def test_not_null_filter(self, synthetic_dataset, tmp_path):
+        target = path_to_url(tmp_path / 'copy')
+        count = copy_dataset(synthetic_dataset.url, target,
+                             field_regex=['id', 'matrix_nullable'],
+                             not_null_fields=['matrix_nullable'],
+                             rows_per_row_group=50)
+        # matrix_nullable is null when id % 5 == 0
+        assert count == 80
+
+    def test_cli(self, synthetic_dataset, tmp_path, capsys):
+        target = path_to_url(tmp_path / 'copy')
+        assert copy_main([synthetic_dataset.url, target, '--field-regex', 'id',
+                          '--rows-per-row-group', '100']) == 0
+        assert 'Copied 100 rows' in capsys.readouterr().out
+
+
+class TestGenerateMetadata:
+    def test_regenerate_after_loss(self, tmp_path):
+        from petastorm_tpu.test_util.dataset_utils import create_test_dataset
+        url = path_to_url(tmp_path / 'ds')
+        create_test_dataset(url, num_rows=30, rows_per_row_group=10, build_indexes=False)
+        (tmp_path / 'ds' / '_common_metadata').unlink()
+        schema, n_rg = generate_metadata(
+            url, unischema_class='petastorm_tpu.test_util.dataset_utils.TestSchema')
+        assert n_rg == 3
+        # reader works again, with codecs intact
+        with make_reader(url, reader_pool_type='dummy', schema_fields=['id', 'image_png'],
+                         shuffle_row_groups=False) as r:
+            row = next(r)
+        assert row.image_png.shape == (128, 256, 3)
+
+    def test_infer_for_plain_store(self, scalar_dataset, tmp_path):
+        # copy the plain store path, then add metadata by inference
+        schema, n_rg = generate_metadata(scalar_dataset.url)
+        assert 'id' in schema.fields
+        assert n_rg == 10
+        assert get_schema(scalar_dataset.url) is not None
+
+    def test_bad_class_path(self, scalar_dataset):
+        with pytest.raises(ValueError):
+            generate_metadata(scalar_dataset.url, unischema_class='NotDotted')
+
+
+class TestThroughput:
+    def test_python_read(self, synthetic_dataset):
+        result = reader_throughput(synthetic_dataset.url, field_regex=['id'],
+                                   warmup_cycles=10, measure_cycles=50,
+                                   pool_type='dummy', workers_count=1)
+        assert result.samples_per_second > 0
+        assert result.samples == 50
+
+    def test_jax_read_with_stall(self, synthetic_dataset):
+        result = reader_throughput(synthetic_dataset.url, field_regex=['id', 'matrix'],
+                                   warmup_cycles=16, measure_cycles=64,
+                                   pool_type='thread', workers_count=2,
+                                   read_method='jax', batch_size=16)
+        assert result.samples_per_second > 0
+        assert 0.0 <= result.input_stall_fraction <= 1.0
+
+    def test_cli(self, synthetic_dataset, capsys):
+        assert throughput_main([synthetic_dataset.url, '-f', 'id', '-m', '5', '-n', '20',
+                                '-p', 'dummy', '-w', '1']) == 0
+        assert 'samples/sec' in capsys.readouterr().out
+
+
+class TestMetadataUtil:
+    def test_print_schema_and_pieces(self, synthetic_dataset, capsys):
+        assert metadata_main([synthetic_dataset.url, '--schema', '--pieces']) == 0
+        out = capsys.readouterr().out
+        assert 'image_png' in out
+        assert 'rg=' in out
+
+    def test_print_index(self, synthetic_dataset, capsys):
+        assert metadata_main([synthetic_dataset.url, '--index',
+                              '--skip-index-values']) == 0
+        out = capsys.readouterr().out
+        assert 'id_index' in out
+
+
+def test_duty_cycle_measurement(synthetic_dataset):
+    import jax
+    import jax.numpy as jnp
+    from petastorm_tpu.tools.throughput import pipeline_duty_cycle
+    from petastorm_tpu import TransformSpec
+
+    def to_sample(row):
+        return {'x': row['matrix'], 'label': np.int64(row['id'] % 4)}
+
+    spec = TransformSpec(to_sample, edit_fields=[('x', np.float32, (32, 16, 3), False),
+                                                 ('label', np.int64, (), False)],
+                         selected_fields=['x', 'label'])
+    step = jax.jit(lambda x, y: (jnp.mean(x), jnp.sum(y)))
+    result = pipeline_duty_cycle(
+        synthetic_dataset.url, step, lambda b: (b['x'], b['label']),
+        batch_size=16, steps=10, warmup_steps=2,
+        reader_kwargs={'schema_fields': ['id', 'matrix'], 'transform_spec': spec,
+                       'reader_pool_type': 'thread', 'workers_count': 2})
+    assert result.samples == 160
+    assert 0.0 <= result.input_stall_fraction <= 1.0
