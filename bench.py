@@ -26,7 +26,7 @@ def _build_dataset(url):
     import numpy as np
 
     from petastorm_tpu.codecs import CompressedImageCodec, NdarrayCodec, ScalarCodec
-    from petastorm_tpu.etl.dataset_metadata import materialize_dataset
+    from petastorm_tpu.etl.dataset_metadata import write_petastorm_dataset
     from petastorm_tpu.unischema import Unischema, UnischemaField
 
     schema = Unischema('HelloWorldSchema', [
@@ -35,13 +35,11 @@ def _build_dataset(url):
         UnischemaField('array_4d', np.uint8, (None, 128, 30, None), NdarrayCodec(), False),
     ])
     rng = np.random.default_rng(42)
-    with materialize_dataset(url, schema, rows_per_row_group=100) as writer:
-        for i in range(NUM_ROWS):
-            writer.write({
-                'id': i,
-                'image1': rng.integers(0, 255, (128, 256, 3), dtype=np.uint8),
-                'array_4d': rng.integers(0, 255, (4, 128, 30, 3), dtype=np.uint8),
-            })
+    write_petastorm_dataset(url, schema, ({
+        'id': i,
+        'image1': rng.integers(0, 255, (128, 256, 3), dtype=np.uint8),
+        'array_4d': rng.integers(0, 255, (4, 128, 30, 3), dtype=np.uint8),
+    } for i in range(NUM_ROWS)), rows_per_row_group=100)
 
 
 def main():

@@ -1,0 +1,35 @@
+"""Read a HelloWorld dataset into device-resident ``jax.Array`` batches.
+
+This replaces the reference's tensorflow_hello_world.py as the native ingestion
+path: the loader collates rows into fixed-size batches and stages them onto the
+default JAX device.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import jax
+
+from petastorm_tpu import make_reader
+from petastorm_tpu.jax import JaxDataLoader
+
+
+def jax_hello_world(dataset_url='file:///tmp/hello_world_dataset'):
+    with make_reader(dataset_url, schema_fields=['id', 'image1']) as reader:
+        loader = JaxDataLoader(reader, batch_size=4, drop_last=False,
+                               to_device=jax.devices()[0])
+        for batch in loader:
+            print('id batch:', batch['id'], 'image1:', batch['image1'].shape,
+                  'on', batch['image1'].device)
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('--dataset-url', default='file:///tmp/hello_world_dataset')
+    args = parser.parse_args()
+    jax_hello_world(args.dataset_url)
+
+
+if __name__ == '__main__':
+    main()

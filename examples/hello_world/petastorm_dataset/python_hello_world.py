@@ -1,0 +1,28 @@
+"""Minimal plain-Python read of a HelloWorld dataset.
+
+Parity: reference examples/hello_world/petastorm_dataset/python_hello_world.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from petastorm_tpu import make_reader
+
+
+def python_hello_world(dataset_url='file:///tmp/hello_world_dataset'):
+    with make_reader(dataset_url) as reader:
+        for sample in reader:
+            print(sample.id)
+            print(sample.image1.shape)
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('--dataset-url', default='file:///tmp/hello_world_dataset')
+    args = parser.parse_args()
+    python_hello_world(args.dataset_url)
+
+
+if __name__ == '__main__':
+    main()

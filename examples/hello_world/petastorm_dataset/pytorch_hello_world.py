@@ -1,0 +1,28 @@
+"""Read a HelloWorld dataset through the torch DataLoader adapter.
+
+Parity: reference examples/hello_world/petastorm_dataset/pytorch_hello_world.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from petastorm_tpu import make_reader
+from petastorm_tpu.torch_utils import DataLoader
+
+
+def pytorch_hello_world(dataset_url='file:///tmp/hello_world_dataset'):
+    with DataLoader(make_reader(dataset_url)) as train_loader:
+        sample = next(iter(train_loader))
+        print(sample['id'])
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('--dataset-url', default='file:///tmp/hello_world_dataset')
+    args = parser.parse_args()
+    pytorch_hello_world(args.dataset_url)
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,80 @@
+"""Materialize an ImageNet directory tree (or synthetic stand-in) as a dataset.
+
+Parity: reference examples/imagenet/generate_petastorm_imagenet.py — walks
+``<root>/<noun_id>/*.jpg``, writing one row per image with the synset noun id and
+text. Without a source tree (this environment has no ImageNet), ``--synthetic``
+writes deterministic random images for a configurable number of synthetic
+synsets, preserving schema and layout so downstream training examples run.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+import numpy as np
+
+from examples.imagenet.schema import ImagenetSchema
+from petastorm_tpu.etl.dataset_metadata import write_petastorm_dataset
+
+
+def _iter_imagenet_dir(imagenet_root, noun_id_to_text=None):
+    import cv2
+    for noun_id in sorted(os.listdir(imagenet_root)):
+        synset_dir = os.path.join(imagenet_root, noun_id)
+        if not os.path.isdir(synset_dir):
+            continue
+        text = (noun_id_to_text or {}).get(noun_id, noun_id)
+        for name in sorted(os.listdir(synset_dir)):
+            if not name.lower().endswith(('.jpg', '.jpeg', '.png')):
+                continue
+            image = cv2.imread(os.path.join(synset_dir, name), cv2.IMREAD_COLOR)
+            if image is None:
+                continue
+            yield {'noun_id': noun_id, 'text': text,
+                   'image': cv2.cvtColor(image, cv2.COLOR_BGR2RGB)}
+
+
+def _iter_synthetic(num_synsets, images_per_synset, seed=0):
+    rng = np.random.default_rng(seed)
+    for s in range(num_synsets):
+        noun_id = 'n{:08d}'.format(s)
+        for _ in range(images_per_synset):
+            h, w = int(rng.integers(64, 160)), int(rng.integers(64, 160))
+            yield {'noun_id': noun_id, 'text': 'synthetic synset {}'.format(s),
+                   'image': rng.integers(0, 255, (h, w, 3), dtype=np.uint8)}
+
+
+def imagenet_directory_to_petastorm_dataset(imagenet_path, output_url,
+                                            row_group_size_mb=256,
+                                            noun_id_to_text=None):
+    write_petastorm_dataset(output_url, ImagenetSchema,
+                            _iter_imagenet_dir(imagenet_path, noun_id_to_text),
+                            row_group_size_mb=row_group_size_mb)
+
+
+def generate_synthetic_imagenet(output_url, num_synsets=4, images_per_synset=8,
+                                rows_per_row_group=16):
+    write_petastorm_dataset(output_url, ImagenetSchema,
+                            _iter_synthetic(num_synsets, images_per_synset),
+                            rows_per_row_group=rows_per_row_group)
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('--imagenet-path', default=None,
+                        help='root of an ImageNet directory tree (<root>/<noun_id>/*.jpg)')
+    parser.add_argument('--output-url', default='file:///tmp/imagenet_dataset')
+    parser.add_argument('--synthetic', action='store_true',
+                        help='write synthetic images instead of reading --imagenet-path')
+    parser.add_argument('--num-synsets', type=int, default=4)
+    parser.add_argument('--images-per-synset', type=int, default=8)
+    args = parser.parse_args()
+    if args.synthetic or not args.imagenet_path:
+        generate_synthetic_imagenet(args.output_url, args.num_synsets, args.images_per_synset)
+    else:
+        imagenet_directory_to_petastorm_dataset(args.imagenet_path, args.output_url)
+
+
+if __name__ == '__main__':
+    main()

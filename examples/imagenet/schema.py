@@ -1,0 +1,18 @@
+"""ImageNet dataset schema.
+
+Parity: reference examples/imagenet/schema.py:21-25 — WordNet noun id, synset
+text, and a variable-size RGB image stored png-compressed.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from petastorm_tpu.codecs import CompressedImageCodec, ScalarCodec
+from petastorm_tpu.unischema import Unischema, UnischemaField
+
+ImagenetSchema = Unischema('ImagenetSchema', [
+    UnischemaField('noun_id', np.str_, (), ScalarCodec(), False),
+    UnischemaField('text', np.str_, (), ScalarCodec(), False),
+    UnischemaField('image', np.uint8, (None, None, 3), CompressedImageCodec('png'), False),
+])

@@ -1,0 +1,95 @@
+"""Train a torch model on a petastorm_tpu MNIST dataset.
+
+Parity: reference examples/mnist/pytorch_example.py — same DataLoader +
+TransformSpec pattern, feeding the framework's reader into a torch training loop.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+from examples.mnist.schema import MnistSchema  # noqa: F401
+from petastorm_tpu import TransformSpec, make_reader
+from petastorm_tpu.torch_utils import DataLoader
+from petastorm_tpu.unischema import UnischemaField
+
+
+def _transform_row(row):
+    image = (row['image'].astype(np.float32) / 255.0 - 0.1307) / 0.3081
+    return {'image': image, 'digit': row['digit']}
+
+
+TRANSFORM = TransformSpec(
+    _transform_row,
+    edit_fields=[UnischemaField('image', np.float32, (28, 28), None, False)],
+    removed_fields=['idx'])
+
+
+def train_and_test(dataset_url, batch_size=32, epochs=1, lr=0.01, seed=0):
+    import torch
+    import torch.nn as nn
+    import torch.nn.functional as F
+
+    torch.manual_seed(seed)
+
+    class Net(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.conv1 = nn.Conv2d(1, 10, kernel_size=5)
+            self.conv2 = nn.Conv2d(10, 20, kernel_size=5)
+            self.fc1 = nn.Linear(320, 50)
+            self.fc2 = nn.Linear(50, 10)
+
+        def forward(self, x):
+            x = F.relu(F.max_pool2d(self.conv1(x), 2))
+            x = F.relu(F.max_pool2d(self.conv2(x), 2))
+            x = x.view(-1, 320)
+            x = F.relu(self.fc1(x))
+            return F.log_softmax(self.fc2(x), dim=1)
+
+    model = Net()
+    optimizer = torch.optim.SGD(model.parameters(), lr=lr, momentum=0.5)
+
+    for epoch in range(epochs):
+        model.train()
+        with DataLoader(make_reader(dataset_url + '/train', num_epochs=1, seed=seed,
+                                         transform_spec=TRANSFORM),
+                             batch_size=batch_size) as train_loader:
+            for step, batch in enumerate(train_loader):
+                data = batch['image'].unsqueeze(1)
+                optimizer.zero_grad()
+                loss = F.nll_loss(model(data), batch['digit'])
+                loss.backward()
+                optimizer.step()
+                if step % 20 == 0:
+                    print('epoch {} step {}: loss={:.4f}'.format(epoch, step, loss.item()))
+
+        model.eval()
+        correct = total = 0
+        with DataLoader(make_reader(dataset_url + '/test', num_epochs=1,
+                                         transform_spec=TRANSFORM),
+                             batch_size=batch_size) as test_loader:
+            with torch.no_grad():
+                for batch in test_loader:
+                    pred = model(batch['image'].unsqueeze(1)).argmax(dim=1)
+                    correct += int((pred == batch['digit']).sum())
+                    total += int(batch['digit'].shape[0])
+        print('epoch {}: test accuracy {}/{} = {:.3f}'.format(
+            epoch, correct, total, correct / max(total, 1)))
+    return model
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('--dataset-url', default='file:///tmp/mnist_dataset')
+    parser.add_argument('--batch-size', type=int, default=32)
+    parser.add_argument('--epochs', type=int, default=1)
+    parser.add_argument('--lr', type=float, default=0.01)
+    args = parser.parse_args()
+    train_and_test(args.dataset_url, args.batch_size, args.epochs, args.lr)
+
+
+if __name__ == '__main__':
+    main()

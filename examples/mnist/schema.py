@@ -1,0 +1,19 @@
+"""MNIST dataset schema.
+
+Parity: reference examples/mnist/schema.py — a 28x28 uint8 image stored via
+NdarrayCodec (as the reference does) plus an int64 label. The png image path is
+exercised by the hello_world and imagenet examples.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from petastorm_tpu.codecs import NdarrayCodec, ScalarCodec
+from petastorm_tpu.unischema import Unischema, UnischemaField
+
+MnistSchema = Unischema('MnistSchema', [
+    UnischemaField('idx', np.int64, (), ScalarCodec(), False),
+    UnischemaField('digit', np.int64, (), ScalarCodec(), False),
+    UnischemaField('image', np.uint8, (28, 28), NdarrayCodec(), False),
+])

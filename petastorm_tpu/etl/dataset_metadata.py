@@ -364,13 +364,18 @@ def _partition_keys_from_relpath(relpath, schema=None):
     return keys
 
 
-def load_row_groups(dataset_url, schema=None, max_footer_read_threads=10):
+def load_row_groups(dataset_url, schema=None, max_footer_read_threads=10,
+                    use_cached_metadata=True):
     """List all row-group pieces of the dataset with the reference's three-way
     fallback (etl/dataset_metadata.py:231-336):
 
     1. our ``num_row_groups_per_file`` metadata key (fast path, no footer reads)
     2. a ``_metadata`` summary file
     3. parallel footer reads over all data files
+
+    ``use_cached_metadata=False`` skips paths 1 and 2 and always reads the data
+    file footers — the ground truth when stored metadata may be stale (e.g. the
+    generate-metadata tool retrofitting a store rewritten by another tool).
     """
     resolver = FilesystemResolver(dataset_url)
     fs, root = resolver.filesystem(), resolver.get_dataset_path()
@@ -380,7 +385,7 @@ def load_row_groups(dataset_url, schema=None, max_footer_read_threads=10):
         schema = Unischema.from_json(
             json.loads(arrow_meta_schema.metadata[UNISCHEMA_KEY].decode('utf-8')))
 
-    if arrow_meta_schema is not None and arrow_meta_schema.metadata and \
+    if use_cached_metadata and arrow_meta_schema is not None and arrow_meta_schema.metadata and \
             ROW_GROUPS_PER_FILE_KEY in arrow_meta_schema.metadata:
         counts = json.loads(arrow_meta_schema.metadata[ROW_GROUPS_PER_FILE_KEY].decode('utf-8'))
         pieces = []
@@ -397,7 +402,7 @@ def load_row_groups(dataset_url, schema=None, max_footer_read_threads=10):
         return pieces
 
     summary_path = posixpath.join(root, _SUMMARY_METADATA)
-    if fs.get_file_info([summary_path])[0].type == pafs.FileType.File:
+    if use_cached_metadata and fs.get_file_info([summary_path])[0].type == pafs.FileType.File:
         with fs.open_input_file(summary_path) as f:
             file_meta = pq.read_metadata(f)
         per_file = {}

@@ -42,8 +42,10 @@ def generate_metadata(dataset_url, unischema_class=None, use_footer_counts=True)
     else:
         schema = dataset_metadata.infer_or_load_unischema(dataset_url)
 
-    # row-group counts from the file footers (the ground truth)
-    pieces = dataset_metadata.load_row_groups(dataset_url, schema=schema)
+    # row-group counts from the file footers (the ground truth) — never trust
+    # a stale _common_metadata / _metadata left behind by a previous write
+    pieces = dataset_metadata.load_row_groups(dataset_url, schema=schema,
+                                              use_cached_metadata=False)
     resolver = FilesystemResolver(dataset_url)
     root = resolver.get_dataset_path()
     counts = {}

@@ -112,7 +112,7 @@ def pipeline_duty_cycle(dataset_url, step_fn, batch_to_args, batch_size=64, step
     from petastorm_tpu import make_reader
     from petastorm_tpu.jax import JaxDataLoader, prefetch_to_device
 
-    reader = make_reader(dataset_url, num_epochs=None, **(reader_kwargs or {}))
+    reader = make_reader(dataset_url, **{'num_epochs': None, **(reader_kwargs or {})})
     try:
         loader = prefetch_to_device(
             JaxDataLoader(reader, batch_size=batch_size, **(loader_kwargs or {})),

@@ -1,0 +1,109 @@
+"""End-to-end runs of the examples tree (reference examples/*/tests)."""
+
+
+import numpy as np
+import pytest
+
+from petastorm_tpu import make_batch_reader, make_reader
+from petastorm_tpu.jax import JaxDataLoader
+
+
+@pytest.fixture(scope='module')
+def hello_world_url(tmp_path_factory):
+    from examples.hello_world.petastorm_dataset.generate_petastorm_dataset import \
+        generate_petastorm_dataset
+    path = tmp_path_factory.mktemp('hello_world_ds')
+    url = 'file://' + str(path)
+    generate_petastorm_dataset(url, rows_count=10)
+    return url
+
+
+def test_hello_world_python_read(hello_world_url):
+    with make_reader(hello_world_url) as reader:
+        rows = list(reader)
+    assert sorted(r.id for r in rows) == list(range(10))
+    assert rows[0].image1.shape == (128, 256, 3)
+    assert rows[0].array_4d.shape[1:3] == (128, 30)
+
+
+def test_hello_world_jax_read(hello_world_url):
+    import jax
+    with make_reader(hello_world_url, schema_fields=['id', 'image1']) as reader:
+        loader = JaxDataLoader(reader, batch_size=4, drop_last=False,
+                               to_device=jax.devices()[0])
+        batches = list(loader)
+    assert sum(b['id'].shape[0] for b in batches) == 10
+    assert batches[0]['image1'].shape[1:] == (128, 256, 3)
+
+
+def test_hello_world_pytorch_read(hello_world_url):
+    from examples.hello_world.petastorm_dataset.pytorch_hello_world import \
+        pytorch_hello_world
+    pytorch_hello_world(hello_world_url)
+
+
+def test_external_dataset_roundtrip(tmp_path):
+    from examples.hello_world.external_dataset.generate_external_dataset import \
+        generate_external_dataset
+    url = 'file://' + str(tmp_path / 'ext')
+    generate_external_dataset(url, rows_count=50)
+    with make_batch_reader(url) as reader:
+        ids = np.concatenate([batch.id for batch in reader])
+    assert sorted(ids.tolist()) == list(range(50))
+
+
+@pytest.fixture(scope='module')
+def mnist_url(tmp_path_factory):
+    from examples.mnist.generate_petastorm_mnist import mnist_data_to_petastorm_dataset
+    path = tmp_path_factory.mktemp('mnist_ds')
+    url = 'file://' + str(path)
+    mnist_data_to_petastorm_dataset(url, train_rows=96, test_rows=32,
+                                    rows_per_row_group=32)
+    return url
+
+
+def test_mnist_jax_training(mnist_url):
+    from examples.mnist.jax_example import train_and_test
+    state = train_and_test(mnist_url, batch_size=16, epochs=1, lr=0.05)
+    assert state.step > 0
+
+
+def test_mnist_pytorch_training(mnist_url):
+    from examples.mnist.pytorch_example import train_and_test
+    train_and_test(mnist_url, batch_size=16, epochs=1)
+
+
+def test_imagenet_synthetic_generate_and_read(tmp_path):
+    from examples.imagenet.generate_petastorm_imagenet import generate_synthetic_imagenet
+    from examples.imagenet.jax_resnet_example import make_transform
+    url = 'file://' + str(tmp_path / 'imagenet')
+    generate_synthetic_imagenet(url, num_synsets=2, images_per_synset=4)
+    with make_reader(url, transform_spec=make_transform(32, 16), num_epochs=1) as reader:
+        loader = JaxDataLoader(reader, batch_size=4, drop_last=False)
+        batches = list(loader)
+    total = sum(b['image'].shape[0] for b in batches)
+    assert total == 8
+    assert batches[0]['image'].shape[1:] == (32, 32, 3)
+    assert batches[0]['image'].dtype == np.float32
+    assert all(0 <= l < 16 for b in batches for l in np.atleast_1d(b['label']))
+
+
+def test_imagenet_directory_ingest(tmp_path):
+    import cv2
+    from examples.imagenet.generate_petastorm_imagenet import \
+        imagenet_directory_to_petastorm_dataset
+    root = tmp_path / 'raw'
+    rng = np.random.default_rng(0)
+    for synset in ('n001', 'n002'):
+        d = root / synset
+        d.mkdir(parents=True)
+        for i in range(3):
+            img = rng.integers(0, 255, (40, 50, 3), dtype=np.uint8)
+            cv2.imwrite(str(d / 'img_{}.png'.format(i)), img)
+    url = 'file://' + str(tmp_path / 'imagenet_real')
+    imagenet_directory_to_petastorm_dataset(str(root), url)
+    with make_reader(url, num_epochs=1) as reader:
+        rows = list(reader)
+    assert len(rows) == 6
+    assert {r.noun_id for r in rows} == {'n001', 'n002'}
+    assert rows[0].image.shape == (40, 50, 3)
