@@ -50,7 +50,7 @@ class FilesystemResolver(object):
             self._filesystem = pafs.S3FileSystem()
             self._path = parsed.netloc + parsed.path
         elif parsed.scheme == 'hdfs':
-            self._filesystem, self._path = pafs.FileSystem.from_uri(dataset_url)
+            self._filesystem, self._path = _resolve_hdfs(dataset_url)
         else:
             raise PetastormTpuError('Unsupported URL scheme {!r} in {}'.format(parsed.scheme, dataset_url))
 
@@ -75,6 +75,21 @@ class FilesystemResolver(object):
 
     def __setstate__(self, state):
         self.__init__(state['url'])
+
+
+def _resolve_hdfs(dataset_url):
+    """hdfs:// URL -> (filesystem, path). When the URL's netloc is a configured
+    HA nameservice (or empty -> fs.defaultFS), returns an HA-failover client
+    wrapped as a genuine pyarrow filesystem; otherwise falls back to Arrow's
+    own URI handling (libhdfs 'default' filesystem, direct host connects)."""
+    from petastorm_tpu.hdfs import namenode as nn
+
+    try:
+        return nn.resolve_and_connect(dataset_url, pyarrow_wrap=True)
+    except (RuntimeError, IOError):
+        # no/incomplete Hadoop config: let Arrow's own URI handling try —
+        # libhdfs reads CLASSPATH config itself and understands hdfs:///
+        return pafs.FileSystem.from_uri(dataset_url)
 
 
 class _FilesystemFactory(object):

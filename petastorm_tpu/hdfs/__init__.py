@@ -1,0 +1,6 @@
+"""High-availability HDFS support (reference: petastorm/hdfs/)."""
+
+from petastorm_tpu.hdfs.namenode import (HadoopConfiguration,  # noqa: F401
+                                         HAHdfsClient, HdfsConnector,
+                                         HdfsNamenodeResolver, MaxFailoversExceeded,
+                                         as_pyarrow_filesystem, namenode_failover)

@@ -1,0 +1,325 @@
+"""HA-HDFS machinery tested with zero Hadoop, mirroring the reference's mock
+strategy (hdfs/tests/test_hdfs_namenode.py:43-341): a fake Hadoop configuration,
+a fake filesystem that fails its first N operations, and a connector that counts
+connection attempts."""
+
+import pickle
+
+import pytest
+
+from petastorm_tpu.hdfs.namenode import (HadoopConfiguration, HAHdfsClient,
+                                         HdfsConnectError, HdfsConnector,
+                                         HdfsNamenodeResolver, MaxFailoversExceeded,
+                                         resolve_and_connect)
+
+HDFS_SITE = """<?xml version="1.0"?>
+<configuration>
+  <property><name>dfs.ha.namenodes.nameservice1</name><value>nn1,nn2</value></property>
+  <property><name>dfs.namenode.rpc-address.nameservice1.nn1</name><value>host1:8020</value></property>
+  <property><name>dfs.namenode.rpc-address.nameservice1.nn2</name><value>host2:8020</value></property>
+</configuration>
+"""
+
+CORE_SITE = """<?xml version="1.0"?>
+<configuration>
+  <property><name>fs.defaultFS</name><value>hdfs://nameservice1</value></property>
+</configuration>
+"""
+
+
+@pytest.fixture
+def hadoop_conf(tmp_path):
+    (tmp_path / 'hdfs-site.xml').write_text(HDFS_SITE)
+    (tmp_path / 'core-site.xml').write_text(CORE_SITE)
+    conf = HadoopConfiguration()
+    conf.load_site_xml(str(tmp_path / 'hdfs-site.xml'))
+    conf.load_site_xml(str(tmp_path / 'core-site.xml'))
+    return conf
+
+
+class MockHdfs(object):
+    """Filesystem stub failing its first ``n_failures`` operations
+    (reference MockHdfs, hdfs/tests/test_hdfs_namenode.py:250-292)."""
+
+    def __init__(self, n_failures=0, namenode=None):
+        self._n_failures = n_failures
+        self.namenode = namenode
+        self.calls = 0
+
+    def ls(self, path):
+        self.calls += 1
+        if self._n_failures > 0:
+            self._n_failures -= 1
+            raise OSError('namenode is in standby state')
+        return ['{}/{}'.format(path, 'part-0.parquet')]
+
+    def bad_method(self):
+        raise ValueError('not an IO error')
+
+
+class MockHdfsConnector(HdfsConnector):
+    """Counts connections; serves preprogrammed MockHdfs instances per namenode
+    (reference MockHdfsConnector, hdfs/tests/test_hdfs_namenode.py:294-341)."""
+
+    connect_attempts = {}
+    fail_n_next_connects = 0
+    instances = {}
+
+    @classmethod
+    def reset(cls):
+        cls.connect_attempts = {}
+        cls.fail_n_next_connects = 0
+        cls.instances = {}
+
+    @classmethod
+    def set_fs(cls, namenode, fs):
+        cls.instances[namenode] = fs
+
+    @classmethod
+    def hdfs_connect_namenode(cls, url_or_address, user=None):
+        cls.connect_attempts[url_or_address] = cls.connect_attempts.get(url_or_address, 0) + 1
+        if cls.fail_n_next_connects > 0:
+            cls.fail_n_next_connects -= 1
+            raise OSError('connection refused: {}'.format(url_or_address))
+        return cls.instances.get(url_or_address, MockHdfs(namenode=url_or_address))
+
+
+@pytest.fixture(autouse=True)
+def _reset_connector():
+    MockHdfsConnector.reset()
+    yield
+    MockHdfsConnector.reset()
+
+
+# -- configuration & resolution ------------------------------------------------
+
+def test_site_xml_parsing(hadoop_conf):
+    assert hadoop_conf['dfs.ha.namenodes.nameservice1'] == 'nn1,nn2'
+    assert hadoop_conf['fs.defaultFS'] == 'hdfs://nameservice1'
+
+
+def test_site_xml_parse_error_is_nonfatal(tmp_path):
+    bad = tmp_path / 'broken.xml'
+    bad.write_text('<configuration><property>')
+    conf = HadoopConfiguration()
+    conf.load_site_xml(str(bad))  # logs, does not raise
+    assert conf == {}
+
+
+def test_resolve_nameservice(hadoop_conf):
+    resolver = HdfsNamenodeResolver(hadoop_conf)
+    assert resolver.resolve_hdfs_name_service('nameservice1') == ['host1:8020', 'host2:8020']
+
+
+def test_resolve_unknown_nameservice_returns_none(hadoop_conf):
+    assert HdfsNamenodeResolver(hadoop_conf).resolve_hdfs_name_service('some-host') is None
+
+
+def test_resolve_inconsistent_config_raises(hadoop_conf):
+    del hadoop_conf['dfs.namenode.rpc-address.nameservice1.nn2']
+    with pytest.raises(RuntimeError, match='nn2'):
+        HdfsNamenodeResolver(hadoop_conf).resolve_hdfs_name_service('nameservice1')
+
+
+def test_resolve_default_service(hadoop_conf):
+    nameservice, namenodes = HdfsNamenodeResolver(hadoop_conf).resolve_default_hdfs_service()
+    assert nameservice == 'nameservice1'
+    assert namenodes == ['host1:8020', 'host2:8020']
+
+
+def test_resolve_default_service_without_config():
+    with pytest.raises(RuntimeError, match='fs.defaultFS'):
+        HdfsNamenodeResolver(HadoopConfiguration()).resolve_default_hdfs_service()
+
+
+# -- connector -----------------------------------------------------------------
+
+def test_connect_to_either_namenode_prefers_first():
+    fs = MockHdfsConnector.connect_to_either_namenode(['host1:8020', 'host2:8020'])
+    assert fs.namenode == 'host1:8020'
+    assert MockHdfsConnector.connect_attempts == {'host1:8020': 1}
+
+
+def test_connect_to_either_namenode_fails_over():
+    MockHdfsConnector.fail_n_next_connects = 1
+    fs = MockHdfsConnector.connect_to_either_namenode(['host1:8020', 'host2:8020'])
+    assert fs.namenode == 'host2:8020'
+    assert MockHdfsConnector.connect_attempts == {'host1:8020': 1, 'host2:8020': 1}
+
+
+def test_connect_to_either_namenode_all_down():
+    MockHdfsConnector.fail_n_next_connects = 2
+    with pytest.raises(HdfsConnectError):
+        MockHdfsConnector.connect_to_either_namenode(['host1:8020', 'host2:8020'])
+
+
+# -- HA client failover --------------------------------------------------------
+
+def _ha_client(n_failures):
+    # one shared filesystem stub failing the first N operations wherever they
+    # land, as in the reference's MockHdfs (test_hdfs_namenode.py:250-292)
+    shared = MockHdfs(n_failures=n_failures, namenode='host1:8020')
+    MockHdfsConnector.set_fs('host1:8020', shared)
+    MockHdfsConnector.set_fs('host2:8020', shared)
+    return HAHdfsClient(MockHdfsConnector, ['host1:8020', 'host2:8020'])
+
+
+def test_ha_client_no_failure():
+    client = _ha_client(0)
+    assert client.ls('/data') == ['/data/part-0.parquet']
+    assert MockHdfsConnector.connect_attempts == {'host1:8020': 1}
+
+
+@pytest.mark.parametrize('n_failures', [1, 2])
+def test_ha_client_recovers_within_failover_budget(n_failures):
+    client = _ha_client(n_failures)
+    assert client.ls('/data') == ['/data/part-0.parquet']
+    # every failure reconnects round-robin to the next namenode
+    assert sum(MockHdfsConnector.connect_attempts.values()) == 1 + n_failures
+
+
+def test_ha_client_exceeds_failover_budget():
+    # 3 failures > MAX_FAILOVER_ATTEMPTS=2: round-robin returns to the (still
+    # broken) first namenode and gives up
+    MockHdfsConnector.set_fs('host1:8020', MockHdfs(n_failures=5, namenode='host1:8020'))
+    MockHdfsConnector.set_fs('host2:8020', MockHdfs(n_failures=5, namenode='host2:8020'))
+    client = HAHdfsClient(MockHdfsConnector, ['host1:8020', 'host2:8020'])
+    with pytest.raises(MaxFailoversExceeded) as exc_info:
+        client.ls('/data')
+    assert len(exc_info.value.failed_exceptions) == 3
+    assert exc_info.value.__name__ == 'ls'
+
+
+def test_ha_client_non_io_error_propagates_immediately():
+    client = _ha_client(0)
+    with pytest.raises(ValueError, match='not an IO error'):
+        client.bad_method()
+    assert sum(MockHdfsConnector.connect_attempts.values()) == 1  # no failover
+
+
+def test_ha_client_non_callable_attribute_proxy():
+    client = _ha_client(0)
+    assert client.namenode == 'host1:8020'
+
+
+def test_ha_client_failure_names_failed_operation():
+    MockHdfsConnector.set_fs('host1:8020', MockHdfs(n_failures=5))
+    MockHdfsConnector.set_fs('host2:8020', MockHdfs(n_failures=5))
+    client = HAHdfsClient(MockHdfsConnector, ['host1:8020', 'host2:8020'])
+    with pytest.raises(MaxFailoversExceeded) as exc_info:
+        client.ls('/data')
+    assert exc_info.value.__name__ == 'ls'
+
+
+def test_ha_client_requires_namenodes():
+    with pytest.raises(HdfsConnectError):
+        HAHdfsClient(MockHdfsConnector, [])
+
+
+def test_ha_client_pickle_reconnects():
+    client = _ha_client(0)
+    restored = pickle.loads(pickle.dumps(client))
+    assert restored.ls('/d') == ['/d/part-0.parquet']
+
+
+# -- URL resolution ------------------------------------------------------------
+
+def test_resolve_and_connect_nameservice(hadoop_conf):
+    fs, path = resolve_and_connect('hdfs://nameservice1/datasets/d1',
+                                   hadoop_configuration=hadoop_conf,
+                                   connector=MockHdfsConnector)
+    assert isinstance(fs, HAHdfsClient)
+    assert path == '/datasets/d1'
+    assert fs.ls('/datasets/d1')
+
+
+def test_resolve_and_connect_default_service(hadoop_conf):
+    fs, path = resolve_and_connect('hdfs:///datasets/d1',
+                                   hadoop_configuration=hadoop_conf,
+                                   connector=MockHdfsConnector)
+    assert isinstance(fs, HAHdfsClient)
+    assert path == '/datasets/d1'
+
+
+def test_resolve_and_connect_direct_host(hadoop_conf):
+    fs, path = resolve_and_connect('hdfs://some-host:8020/datasets/d1',
+                                   hadoop_configuration=hadoop_conf,
+                                   connector=MockHdfsConnector)
+    assert not isinstance(fs, HAHdfsClient)
+    assert fs.namenode == 'some-host:8020'
+    assert path == '/datasets/d1'
+
+
+def test_resolve_and_connect_rejects_non_hdfs():
+    with pytest.raises(ValueError):
+        resolve_and_connect('file:///tmp/x')
+
+
+def test_ha_client_initial_connect_skips_down_namenode():
+    # first-listed namenode refuses connections: the client must come up on
+    # the standby instead of failing resolution outright
+    MockHdfsConnector.fail_n_next_connects = 1
+    client = HAHdfsClient(MockHdfsConnector, ['host1:8020', 'host2:8020'])
+    assert client.ls('/x') == ['/x/part-0.parquet']
+    assert MockHdfsConnector.connect_attempts == {'host1:8020': 1, 'host2:8020': 1}
+
+
+def test_ha_client_reconnect_failure_terminal_when_ring_down():
+    from petastorm_tpu.hdfs.namenode import HdfsConnectError as ConnErr
+    # operation fails, and during failover every namenode refuses connections
+    MockHdfsConnector.set_fs('host1:8020', MockHdfs(n_failures=5))
+    MockHdfsConnector.set_fs('host2:8020', MockHdfs(n_failures=5))
+    client = HAHdfsClient(MockHdfsConnector, ['host1:8020', 'host2:8020'])
+    MockHdfsConnector.fail_n_next_connects = 10
+    with pytest.raises(ConnErr):
+        client.ls('/x')
+
+
+def test_resolve_and_connect_userinfo(hadoop_conf):
+    fs, _ = resolve_and_connect('hdfs://alice@nameservice1/data',
+                                hadoop_configuration=hadoop_conf,
+                                connector=MockHdfsConnector)
+    assert fs._user == 'alice'
+
+
+def test_connector_parses_userinfo():
+    captured = {}
+
+    class RecordingConnector(MockHdfsConnector):
+        @classmethod
+        def hdfs_connect_namenode(cls, url_or_address, user=None):
+            from urllib.parse import urlparse
+            parsed = urlparse('hdfs://' + url_or_address)
+            captured['user'] = user or parsed.username
+            return MockHdfs(namenode=url_or_address)
+
+    RecordingConnector.hdfs_connect_namenode('bob@host1:8020')
+    assert captured['user'] == 'bob'
+
+
+def test_as_pyarrow_filesystem_accepted_by_pyarrow(tmp_path):
+    """The HA wrapper must be a *real* pyarrow FileSystem so strict pyarrow
+    APIs (pq.write_to_dataset/_ensure_filesystem) accept it."""
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.fs as pafs
+    import pyarrow.parquet as pq
+
+    from petastorm_tpu.hdfs.namenode import as_pyarrow_filesystem
+
+    class LocalBackedConnector(HdfsConnector):
+        @classmethod
+        def hdfs_connect_namenode(cls, url_or_address, user=None):
+            return pafs.LocalFileSystem()
+
+    client = HAHdfsClient(LocalBackedConnector, ['host1:8020', 'host2:8020'])
+    fs = as_pyarrow_filesystem(client)
+    assert isinstance(fs, pafs.FileSystem)
+
+    table = pa.table({'id': np.arange(10)})
+    out = str(tmp_path / 'ha_out')
+    pq.write_to_dataset(table, out, filesystem=fs)
+    files = [f.path for f in fs.get_file_info(pafs.FileSelector(out, recursive=True))
+             if f.type == pafs.FileType.File]
+    assert files
+    assert pq.read_table(files[0], filesystem=fs)['id'].to_pylist() == list(range(10))
