@@ -86,6 +86,10 @@ def _resolve_hdfs(dataset_url):
 
     try:
         return nn.resolve_and_connect(dataset_url, pyarrow_wrap=True)
+    except nn.HdfsConnectError:
+        # resolution succeeded but every namenode refused: that diagnosis
+        # (per-namenode errors) is the actionable one — don't mask it
+        raise
     except (RuntimeError, IOError):
         # no/incomplete Hadoop config: let Arrow's own URI handling try —
         # libhdfs reads CLASSPATH config itself and understands hdfs:///

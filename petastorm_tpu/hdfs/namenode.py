@@ -345,7 +345,9 @@ def resolve_and_connect(dataset_url, hadoop_configuration=None, connector=HdfsCo
     if parsed.scheme != 'hdfs':
         raise ValueError('Not an hdfs:// URL: {}'.format(dataset_url))
     resolver = HdfsNamenodeResolver(hadoop_configuration)
-    nameservice = parsed.hostname or ''
+    # case-preserving host extraction: parsed.hostname lowercases, but Hadoop
+    # nameservice config keys are case-sensitive
+    nameservice = parsed.netloc.rpartition('@')[2].partition(':')[0]
     if not parsed.netloc:
         _, namenodes = resolver.resolve_default_hdfs_service()
     else:

@@ -1,0 +1,7 @@
+"""DataFrame -> dataset converter (reference: petastorm/spark/)."""
+
+from petastorm_tpu.spark.dataset_converter import (DatasetConverter,  # noqa: F401
+                                                   SparkDatasetConverter,
+                                                   make_converter,
+                                                   make_spark_converter,
+                                                   register_delete_dir_handler)

@@ -1,0 +1,50 @@
+"""PySpark ingestion helpers (reference: petastorm/spark_utils.py:23-52).
+
+pyspark is an optional dependency: these helpers import it lazily and raise a
+clear error when absent. The local analog — reading a dataset into a pandas
+DataFrame — needs no Spark and is provided as :func:`dataset_as_dataframe`.
+"""
+
+from __future__ import annotations
+
+
+def dataset_as_rdd(dataset_url, spark_session, schema_fields=None):
+    """Dataset -> RDD of decoded row namedtuples (reference spark_utils.py:23-52).
+
+    Each Spark partition opens its own reader over one shard of the dataset
+    (share-nothing, matching the reader's ``cur_shard`` arithmetic).
+    """
+    try:
+        import pyspark  # noqa: F401
+    except ImportError:
+        raise ImportError('dataset_as_rdd requires pyspark, which is not installed. '
+                          'Use dataset_as_dataframe (pandas) or make_reader directly.')
+
+    from petastorm_tpu.etl.dataset_metadata import get_schema_from_dataset_url
+
+    schema = get_schema_from_dataset_url(dataset_url)
+    fields = schema_fields if schema_fields is not None else list(schema.fields)
+    num_partitions = spark_session.sparkContext.defaultParallelism
+
+    def _read_shard(shard_index):
+        from petastorm_tpu import make_reader
+        with make_reader(dataset_url, schema_fields=fields, reader_pool_type='dummy',
+                         cur_shard=shard_index, shard_count=num_partitions,
+                         num_epochs=1) as reader:
+            return list(reader)
+
+    return spark_session.sparkContext \
+        .parallelize(range(num_partitions), num_partitions) \
+        .flatMap(_read_shard)
+
+
+def dataset_as_dataframe(dataset_url, schema_fields=None):
+    """Dataset -> pandas DataFrame (decoded rows). The Spark-free analog of
+    :func:`dataset_as_rdd` for local workflows."""
+    import pandas as pd
+
+    from petastorm_tpu import make_reader
+
+    with make_reader(dataset_url, schema_fields=schema_fields, num_epochs=1) as reader:
+        rows = [row._asdict() for row in reader]
+    return pd.DataFrame(rows)

@@ -37,6 +37,8 @@ def _sanitize_field_value(value):
             return value.astype(np.int32)
         if value.dtype in (np.uint32,):
             return value.astype(np.int64)
+        if np.issubdtype(value.dtype, np.datetime64):
+            return value.astype('datetime64[ns]').astype(np.int64)
         if value.dtype == object and value.size and isinstance(value.flat[0], Decimal):
             return value.astype(str)
     if isinstance(value, np.generic):
@@ -86,3 +88,36 @@ def make_petastorm_dataset(reader):
     dataset = tf.data.Dataset.from_generator(generator, output_signature=tuple(signature))
     namedtuple_type = schema.namedtuple
     return dataset.map(lambda *args: namedtuple_type(*args))
+
+
+class make_tf_dataset_context(object):
+    """Context manager: fixed-``batch_size`` ``tf.data.Dataset`` over a batched
+    reader, closing the reader on exit (the converter's
+    ``SparkDatasetConverter.make_tf_dataset`` surface, reference
+    spark/spark_dataset_converter.py:142-172,224-274)."""
+
+    def __init__(self, reader, batch_size=32, prefetch=None):
+        self._reader = reader
+        self._batch_size = batch_size
+        self._prefetch = prefetch
+
+    def __enter__(self):
+        try:
+            tf = _tf()
+            dataset = make_petastorm_dataset(self._reader)
+            if self._reader.batched_output:
+                # row-group batches -> fixed-size batches
+                dataset = dataset.unbatch()
+            dataset = dataset.batch(self._batch_size)
+            if self._prefetch != 0:
+                dataset = dataset.prefetch(self._prefetch or tf.data.AUTOTUNE)
+            return dataset
+        except Exception:
+            # __exit__ never runs when __enter__ raises: don't leak the pool
+            self._reader.stop()
+            self._reader.join()
+            raise
+
+    def __exit__(self, exc_type, exc_value, tb):
+        self._reader.stop()
+        self._reader.join()

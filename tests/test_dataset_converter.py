@@ -1,0 +1,186 @@
+"""DataFrame converter tests (reference petastorm/tests/test_spark_dataset_converter.py,
+re-targeted at the backend-neutral pandas/Arrow core — no Spark required)."""
+
+import os
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from petastorm_tpu.spark import (DatasetConverter, make_converter, make_spark_converter,
+                                 register_delete_dir_handler)
+from petastorm_tpu.spark import dataset_converter as dc
+from petastorm_tpu.spark_utils import dataset_as_dataframe
+
+
+@pytest.fixture
+def cache_dir(tmp_path):
+    d = tmp_path / 'converter_cache'
+    d.mkdir()
+    return 'file://' + str(d)
+
+
+@pytest.fixture(autouse=True)
+def _clear_cache_registry():
+    with dc._cache_lock:
+        dc._cache_entries.clear()
+    yield
+    with dc._cache_lock:
+        dc._cache_entries.clear()
+
+
+def _df(n=100):
+    rng = np.random.default_rng(0)
+    return pd.DataFrame({
+        'id': np.arange(n, dtype=np.int64),
+        'value': rng.random(n),  # float64 on purpose (precision test)
+        'label': (np.arange(n) % 3).astype(np.int32),
+    })
+
+
+def test_converter_roundtrip_jax(cache_dir):
+    conv = make_converter(_df(), parent_cache_dir_url=cache_dir)
+    assert len(conv) == 100
+    with conv.make_jax_loader(batch_size=10, num_epochs=1) as loader:
+        batches = list(loader)
+    assert sum(b['id'].shape[0] for b in batches) == 100
+    assert batches[0]['value'].dtype == np.float32  # default precision
+
+
+def test_converter_precision_float64(cache_dir):
+    conv = make_converter(_df(), parent_cache_dir_url=cache_dir, precision='float64')
+    with conv.make_jax_loader(batch_size=50, num_epochs=1) as loader:
+        batch = next(iter(loader))
+    assert batch['value'].dtype == np.float64
+
+
+def test_converter_invalid_precision(cache_dir):
+    with pytest.raises(ValueError, match='precision'):
+        make_converter(_df(), parent_cache_dir_url=cache_dir, precision='float16')
+
+
+def test_converter_dedups_same_content(cache_dir):
+    conv1 = make_converter(_df(), parent_cache_dir_url=cache_dir)
+    conv2 = make_converter(_df(), parent_cache_dir_url=cache_dir)  # re-created, equal
+    assert conv1.cache_dir_url == conv2.cache_dir_url
+
+
+def test_converter_distinct_content_not_deduped(cache_dir):
+    conv1 = make_converter(_df(), parent_cache_dir_url=cache_dir)
+    df2 = _df()
+    df2['value'] = df2['value'] + 1.0
+    conv2 = make_converter(df2, parent_cache_dir_url=cache_dir)
+    assert conv1.cache_dir_url != conv2.cache_dir_url
+
+
+def test_converter_distinct_options_not_deduped(cache_dir):
+    conv1 = make_converter(_df(), parent_cache_dir_url=cache_dir)
+    conv2 = make_converter(_df(), parent_cache_dir_url=cache_dir, precision='float64')
+    assert conv1.cache_dir_url != conv2.cache_dir_url
+
+
+def test_converter_accepts_arrow_table(cache_dir):
+    table = pa.table({'id': np.arange(10, dtype=np.int64),
+                      'x': np.linspace(0, 1, 10)})
+    conv = make_converter(table, parent_cache_dir_url=cache_dir)
+    assert len(conv) == 10
+    with conv.make_jax_loader(batch_size=5, num_epochs=1) as loader:
+        batch = next(iter(loader))
+    assert batch['x'].dtype == np.float32
+
+
+def test_converter_rejects_unsupported_type(cache_dir):
+    with pytest.raises(TypeError):
+        make_converter([1, 2, 3], parent_cache_dir_url=cache_dir)
+
+
+def test_converter_requires_cache_dir(monkeypatch):
+    monkeypatch.delenv(dc.CACHE_DIR_ENV_VAR, raising=False)
+    with pytest.raises(ValueError, match='cache dir'):
+        make_converter(_df())
+
+
+def test_converter_env_var_cache_dir(tmp_path, monkeypatch):
+    monkeypatch.setenv(dc.CACHE_DIR_ENV_VAR, 'file://' + str(tmp_path))
+    conv = make_converter(_df())
+    assert conv.cache_dir_url.startswith('file://' + str(tmp_path))
+
+
+def test_converter_delete(cache_dir):
+    conv = make_converter(_df(), parent_cache_dir_url=cache_dir)
+    local_path = conv.cache_dir_url[len('file://'):]
+    assert os.path.exists(local_path)
+    conv.delete()
+    assert not os.path.exists(local_path)
+    # deleting removed it from the dedup registry: converting again rematerializes
+    conv2 = make_converter(_df(), parent_cache_dir_url=cache_dir)
+    assert conv2.cache_dir_url != conv.cache_dir_url
+
+
+def test_register_delete_dir_handler(cache_dir):
+    calls = []
+    register_delete_dir_handler(lambda url: calls.append(url))
+    try:
+        conv = make_converter(_df(), parent_cache_dir_url=cache_dir)
+        conv.delete()
+        assert calls == [conv.cache_dir_url]
+    finally:
+        register_delete_dir_handler(None)
+
+
+def test_converter_pickle(cache_dir):
+    import pickle
+    conv = make_converter(_df(), parent_cache_dir_url=cache_dir)
+    restored = pickle.loads(pickle.dumps(conv))
+    assert restored.cache_dir_url == conv.cache_dir_url
+    assert len(restored) == len(conv)
+
+
+def test_converter_torch_dataloader(cache_dir):
+    conv = make_converter(_df(), parent_cache_dir_url=cache_dir)
+    with conv.make_torch_dataloader(batch_size=20, num_epochs=1) as loader:
+        total = sum(batch['id'].shape[0] for batch in loader)
+    assert total == 100
+
+
+def test_converter_tf_dataset(cache_dir):
+    tf = pytest.importorskip('tensorflow')
+    conv = make_converter(_df(), parent_cache_dir_url=cache_dir)
+    with conv.make_tf_dataset(batch_size=25, num_epochs=1) as dataset:
+        batches = list(dataset)
+    assert sum(int(b.id.shape[0]) for b in batches) == 100
+    assert batches[0].value.dtype == tf.float32
+
+
+def test_converter_sharded_loaders(cache_dir):
+    conv = make_converter(_df(), parent_cache_dir_url=cache_dir,
+                          parquet_row_group_size_bytes=1024)
+    seen = []
+    for shard in range(2):
+        with conv.make_jax_loader(batch_size=10, num_epochs=1, drop_last=False,
+                                  cur_shard=shard, shard_count=2) as loader:
+            for b in loader:
+                seen.extend(b['id'].tolist())
+    assert sorted(seen) == list(range(100))
+
+
+def test_make_spark_converter_alias():
+    assert make_spark_converter is make_converter
+    assert DatasetConverter is dc.SparkDatasetConverter
+
+
+def test_dataset_as_dataframe(tmp_path):
+    from petastorm_tpu.codecs import ScalarCodec
+    from petastorm_tpu.etl.dataset_metadata import write_petastorm_dataset
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+    schema = Unischema('S', [UnischemaField('id', np.int64, (), ScalarCodec(), False)])
+    url = 'file://' + str(tmp_path / 'ds')
+    write_petastorm_dataset(url, schema, ({'id': i} for i in range(30)),
+                            rows_per_row_group=10)
+    frame = dataset_as_dataframe(url)
+    assert sorted(frame['id'].tolist()) == list(range(30))
+
+
+def test_dataset_as_rdd_requires_pyspark(tmp_path):
+    pytest.importorskip('pyspark', reason='pyspark not installed')

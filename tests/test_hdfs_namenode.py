@@ -275,6 +275,18 @@ def test_ha_client_reconnect_failure_terminal_when_ring_down():
         client.ls('/x')
 
 
+def test_resolve_and_connect_mixed_case_nameservice(hadoop_conf):
+    # Hadoop config keys are case-sensitive; urlparse().hostname lowercases —
+    # the resolver must use the case-preserved netloc host
+    hadoop_conf['dfs.ha.namenodes.NameService1'] = 'nn1,nn2'
+    hadoop_conf['dfs.namenode.rpc-address.NameService1.nn1'] = 'host1:8020'
+    hadoop_conf['dfs.namenode.rpc-address.NameService1.nn2'] = 'host2:8020'
+    fs, path = resolve_and_connect('hdfs://NameService1/data',
+                                   hadoop_configuration=hadoop_conf,
+                                   connector=MockHdfsConnector)
+    assert isinstance(fs, HAHdfsClient)
+
+
 def test_resolve_and_connect_userinfo(hadoop_conf):
     fs, _ = resolve_and_connect('hdfs://alice@nameservice1/data',
                                 hadoop_configuration=hadoop_conf,
