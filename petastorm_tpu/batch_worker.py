@@ -20,6 +20,7 @@ import pyarrow as pa
 import pyarrow.parquet as pq
 
 from petastorm_tpu.row_worker import _cache_key, select_row_drop_indices
+from petastorm_tpu.native import open_parquet
 from petastorm_tpu.workers.worker_base import WorkerBase
 
 
@@ -68,7 +69,7 @@ class ArrowBatchWorker(WorkerBase):
             if len(self._open_files) > 8:
                 _, old = self._open_files.popitem()
                 old.close()
-            self._open_files[path] = pq.ParquetFile(self._fs.open_input_file(path))
+            self._open_files[path] = open_parquet(path, self._fs)
         return self._open_files[path]
 
     def shutdown(self):

@@ -346,8 +346,13 @@ def resolve_and_connect(dataset_url, hadoop_configuration=None, connector=HdfsCo
         raise ValueError('Not an hdfs:// URL: {}'.format(dataset_url))
     resolver = HdfsNamenodeResolver(hadoop_configuration)
     # case-preserving host extraction: parsed.hostname lowercases, but Hadoop
-    # nameservice config keys are case-sensitive
-    nameservice = parsed.netloc.rpartition('@')[2].partition(':')[0]
+    # nameservice config keys are case-sensitive; bracketed IPv6 literals keep
+    # their colons
+    host_port = parsed.netloc.rpartition('@')[2]
+    if host_port.startswith('['):
+        nameservice = host_port[1:host_port.index(']')] if ']' in host_port else host_port
+    else:
+        nameservice = host_port.partition(':')[0]
     if not parsed.netloc:
         _, namenodes = resolver.resolve_default_hdfs_service()
     else:

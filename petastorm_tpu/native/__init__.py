@@ -1,0 +1,194 @@
+"""Native (C++) Parquet row-group reader with transparent pyarrow fallback.
+
+The hot loop of every worker is "read selected columns of one row group"
+(reference py_dict_reader_worker.py:254-258). Here that loop runs in first-party
+C++ (``rowgroup_reader.cpp``): Arrow C++ decodes the columns off the GIL and the
+result crosses into Python zero-copy via the Arrow C Data Interface.
+
+``open_parquet(path, filesystem)`` picks the native kernel for local files when
+the compiled library is available, else a ``pyarrow.parquet.ParquetFile``-backed
+shim with an identical surface:
+
+* ``read_row_group(i, columns=None)`` -> ``pyarrow.Table``
+* ``metadata.row_group(i).num_rows``
+* ``close()``
+
+Set ``PETASTORM_TPU_DISABLE_NATIVE=1`` to force the pyarrow path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import threading
+
+logger = logging.getLogger(__name__)
+
+_lib = None
+_lib_lock = threading.Lock()
+_load_failed = False
+
+
+def _load_library():
+    """Load (building if needed) the native kernel; None when unavailable."""
+    global _lib, _load_failed
+    if _lib is not None or _load_failed:
+        return _lib
+    with _lib_lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        if os.environ.get('PETASTORM_TPU_DISABLE_NATIVE'):
+            _load_failed = True
+            return None
+        try:
+            from petastorm_tpu.native.build import build
+            so_path = build(quiet=True)
+            lib = ctypes.CDLL(so_path)
+        except Exception as e:  # noqa: BLE001 - any failure => pyarrow fallback
+            logger.info('native kernel unavailable (%s); using pyarrow fallback', e)
+            _load_failed = True
+            return None
+        lib.pstpu_open.restype = ctypes.c_void_p
+        lib.pstpu_open.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_longlong]
+        lib.pstpu_close.argtypes = [ctypes.c_void_p]
+        lib.pstpu_last_error.restype = ctypes.c_char_p
+        lib.pstpu_num_row_groups.argtypes = [ctypes.c_void_p]
+        lib.pstpu_num_rows.argtypes = [ctypes.c_void_p]
+        lib.pstpu_num_rows.restype = ctypes.c_longlong
+        lib.pstpu_row_group_num_rows.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.pstpu_row_group_num_rows.restype = ctypes.c_longlong
+        lib.pstpu_num_columns.argtypes = [ctypes.c_void_p]
+        lib.pstpu_column_name.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                          ctypes.c_char_p, ctypes.c_int]
+        lib.pstpu_read_row_group.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                             ctypes.POINTER(ctypes.c_int),
+                                             ctypes.c_int, ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def is_available():
+    return _load_library() is not None
+
+
+def _last_error(lib):
+    return lib.pstpu_last_error().decode('utf-8', 'replace')
+
+
+class _RowGroupMeta(object):
+    def __init__(self, num_rows):
+        self.num_rows = num_rows
+
+
+class _MetadataShim(object):
+    """Duck-type of the ``pq.ParquetFile.metadata`` subset workers use."""
+
+    def __init__(self, native_file):
+        self._file = native_file
+        self.num_row_groups = native_file.num_row_groups
+        self.num_rows = native_file.num_rows
+
+    def row_group(self, i):
+        return _RowGroupMeta(self._file.row_group_num_rows(i))
+
+
+class NativeParquetFile(object):
+    """C++-backed Parquet file. One instance per worker thread (concurrent
+    reads of a shared instance are serialized by the kernel's handle mutex)."""
+
+    def __init__(self, path, use_threads=True, buffer_size=0):
+        lib = _load_library()
+        if lib is None:
+            raise RuntimeError('native kernel not available')
+        self._lib = lib
+        self._handle = lib.pstpu_open(path.encode(), 1 if use_threads else 0,
+                                      buffer_size)
+        if not self._handle:
+            raise IOError('pstpu_open({}): {}'.format(path, _last_error(lib)))
+        self.path = path
+        self.num_row_groups = lib.pstpu_num_row_groups(self._handle)
+        self.num_rows = lib.pstpu_num_rows(self._handle)
+        # map a requested column name (top-level field, or a full dotted leaf
+        # path) to the parquet *leaf* indices it covers: nested fields (lists,
+        # structs) span multiple leaves like "col.list.element"
+        self._leaf_indices = {}
+        buf = ctypes.create_string_buffer(4096)
+        for i in range(lib.pstpu_num_columns(self._handle)):
+            if lib.pstpu_column_name(self._handle, i, buf, len(buf)) >= 0:
+                dotted = buf.value.decode()
+                top = dotted.split('.', 1)[0]
+                self._leaf_indices.setdefault(top, []).append(i)
+                if dotted != top:
+                    self._leaf_indices.setdefault(dotted, []).append(i)
+        self.metadata = _MetadataShim(self)
+
+    def row_group_num_rows(self, i):
+        n = self._lib.pstpu_row_group_num_rows(self._handle, i)
+        if n < 0:
+            raise IndexError(_last_error(self._lib))
+        return n
+
+    def read_row_group(self, i, columns=None):
+        """Read one row group as a ``pyarrow.Table`` (decode on C++ threads,
+        zero-copy import through the Arrow C Data Interface)."""
+        import pyarrow as pa
+
+        if columns is not None:
+            indices = []
+            for c in columns:
+                try:
+                    indices.extend(self._leaf_indices[c])
+                except KeyError:
+                    raise KeyError('column {!r} not in file {} (has: {})'.format(
+                        c, self.path, sorted(self._leaf_indices)))
+            arr = (ctypes.c_int * len(indices))(*indices)
+            n = len(indices)
+        else:
+            arr, n = None, -1
+
+        # ArrowArrayStream is 4 pointers + private fields; 256 bytes is ample
+        stream_buf = ctypes.create_string_buffer(256)
+        rc = self._lib.pstpu_read_row_group(self._handle, i, arr, n,
+                                            ctypes.byref(stream_buf))
+        if rc != 0:
+            raise IOError('pstpu_read_row_group({}, rg={}): {}'.format(
+                self.path, i, _last_error(self._lib)))
+        reader = pa.RecordBatchReader._import_from_c(
+            ctypes.addressof(stream_buf))
+        return reader.read_all()
+
+    def close(self):
+        if self._handle:
+            self._lib.pstpu_close(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 - interpreter teardown
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc_value, tb):
+        self.close()
+
+
+def open_parquet(path, filesystem=None, use_threads=True, buffer_size=0):
+    """Open ``path`` with the native kernel when possible (local file, kernel
+    built), else fall back to ``pq.ParquetFile`` over the given filesystem."""
+    import pyarrow.fs as pafs
+    import pyarrow.parquet as pq
+
+    local = filesystem is None or isinstance(filesystem, pafs.LocalFileSystem)
+    if local and is_available():
+        try:
+            return NativeParquetFile(path, use_threads=use_threads,
+                                     buffer_size=buffer_size)
+        except IOError as e:
+            logger.warning('native open failed for %s (%s); pyarrow fallback', path, e)
+    if filesystem is None:
+        return pq.ParquetFile(path)
+    return pq.ParquetFile(filesystem.open_input_file(path))

@@ -1,0 +1,83 @@
+"""Build the native row-group reader kernel.
+
+Compiles ``rowgroup_reader.cpp`` against the Arrow/Parquet C++ libraries
+bundled inside the installed pyarrow wheel — no system Arrow needed. Invoked
+explicitly (``python -m petastorm_tpu.native.build``) or automatically on first
+import of :mod:`petastorm_tpu.native` (with a graceful pure-pyarrow fallback
+when no toolchain is available).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import subprocess
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+SOURCE = os.path.join(_HERE, 'rowgroup_reader.cpp')
+OUTPUT = os.path.join(_HERE, 'libpstpu.so')
+
+
+def _arrow_paths():
+    import pyarrow
+    include = pyarrow.get_include()
+    libdirs = pyarrow.get_library_dirs()
+    # wheel ships versioned sonames only (libarrow.so.2500); link by exact name
+    arrow_lib = parquet_lib = None
+    for d in libdirs:
+        for so in glob.glob(os.path.join(d, 'libarrow.so*')):
+            arrow_lib = os.path.basename(so)
+        for so in glob.glob(os.path.join(d, 'libparquet.so*')):
+            parquet_lib = os.path.basename(so)
+    if not arrow_lib or not parquet_lib:
+        raise RuntimeError('pyarrow wheel does not bundle libarrow/libparquet '
+                           '(searched {})'.format(libdirs))
+    return include, libdirs, arrow_lib, parquet_lib
+
+
+def _is_fresh():
+    return os.path.exists(OUTPUT) and \
+        os.path.getmtime(OUTPUT) >= os.path.getmtime(SOURCE)
+
+
+def build(force=False, quiet=False):
+    """Compile the kernel if missing or stale. Returns the .so path.
+
+    Safe under concurrency (spawned worker processes may all trigger the first
+    build): compilation goes to a per-pid temp file that is atomically renamed
+    into place — a process that already dlopen'ed the old .so keeps its mapped
+    inode — and an flock serializes the g++ runs so only one compiles."""
+    if not force and _is_fresh():
+        return OUTPUT
+    import fcntl
+    lock_path = OUTPUT + '.lock'
+    with open(lock_path, 'w') as lock_file:
+        fcntl.flock(lock_file, fcntl.LOCK_EX)
+        try:
+            if not force and _is_fresh():  # another process built while we waited
+                return OUTPUT
+            include, libdirs, arrow_lib, parquet_lib = _arrow_paths()
+            tmp_out = '{}.tmp.{}'.format(OUTPUT, os.getpid())
+            cmd = ['g++', '-O2', '-std=c++20', '-shared', '-fPIC', SOURCE,
+                   '-I{}'.format(include)]
+            for d in libdirs:
+                cmd += ['-L{}'.format(d), '-Wl,-rpath,{}'.format(d)]
+            cmd += ['-l:{}'.format(arrow_lib), '-l:{}'.format(parquet_lib),
+                    '-o', tmp_out]
+            if not quiet:
+                print('building native kernel:', ' '.join(cmd))
+            result = subprocess.run(cmd, capture_output=True, text=True)
+            if result.returncode != 0:
+                if os.path.exists(tmp_out):
+                    os.unlink(tmp_out)
+                raise RuntimeError('native kernel build failed:\n' + result.stderr)
+            os.replace(tmp_out, OUTPUT)
+            return OUTPUT
+        finally:
+            fcntl.flock(lock_file, fcntl.LOCK_UN)
+
+
+if __name__ == '__main__':
+    build(force='--force' in sys.argv)
+    print('built', OUTPUT)

@@ -1,0 +1,176 @@
+// Native Parquet row-group reader kernel.
+//
+// The reference delegates all Parquet decode to pyarrow (Arrow C++) through
+// Python (reference py_dict_reader_worker.py:254-258, arrow_reader_worker.py).
+// This kernel is the framework's first-party native component (SURVEY.md
+// §2.10): it opens a Parquet file, reads selected columns of one row group on
+// C++ threads (no GIL), and hands the decoded Arrow table back to Python
+// zero-copy through the Arrow C Data Interface (ArrowArrayStream).
+//
+// C ABI only — bound from Python with ctypes (no pybind11 in this image).
+//
+// Build: python -m petastorm_tpu.native.build  (links pyarrow's bundled
+// libarrow/libparquet; C++20 for std::span in Arrow 25 headers).
+
+#include <arrow/api.h>
+#include <arrow/c/bridge.h>
+#include <arrow/io/file.h>
+#include <parquet/arrow/reader.h>
+#include <parquet/file_reader.h>
+#include <parquet/metadata.h>
+#include <parquet/properties.h>
+
+#include <cstring>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+thread_local std::string g_last_error;
+
+void set_error(const std::string& msg) { g_last_error = msg; }
+
+struct FileHandle {
+  std::unique_ptr<parquet::arrow::FileReader> reader;
+  std::shared_ptr<parquet::FileMetaData> metadata;
+  // parquet::arrow::FileReader is not thread-safe for concurrent reads of the
+  // same handle; worker threads each own a handle, but guard anyway so a
+  // shared handle degrades to serialized reads instead of corruption.
+  std::mutex mutex;
+};
+
+}  // namespace
+
+extern "C" {
+
+const char* pstpu_last_error() { return g_last_error.c_str(); }
+
+// Open a local Parquet file. use_threads!=0 enables Arrow-internal parallel
+// column decode; buffer_size>0 enables read coalescing into buffers of that
+// size (useful on high-latency storage; 0 = plain reads).
+void* pstpu_open(const char* path, int use_threads, long long buffer_size) {
+  auto maybe_file = arrow::io::ReadableFile::Open(path);
+  if (!maybe_file.ok()) {
+    set_error(maybe_file.status().ToString());
+    return nullptr;
+  }
+  parquet::ReaderProperties props = parquet::default_reader_properties();
+  if (buffer_size > 0) {
+    props.enable_buffered_stream();
+    props.set_buffer_size(buffer_size);
+  }
+  std::unique_ptr<parquet::ParquetFileReader> pq_reader;
+  try {
+    pq_reader = parquet::ParquetFileReader::Open(*maybe_file, props);
+  } catch (const std::exception& e) {
+    set_error(e.what());
+    return nullptr;
+  }
+  auto handle = std::make_unique<FileHandle>();
+  handle->metadata = pq_reader->metadata();
+  parquet::ArrowReaderProperties arrow_props;
+  arrow_props.set_use_threads(use_threads != 0);
+  auto maybe_reader = parquet::arrow::FileReader::Make(
+      arrow::default_memory_pool(), std::move(pq_reader), arrow_props);
+  if (!maybe_reader.ok()) {
+    set_error(maybe_reader.status().ToString());
+    return nullptr;
+  }
+  handle->reader = std::move(*maybe_reader);
+  return handle.release();
+}
+
+void pstpu_close(void* h) { delete static_cast<FileHandle*>(h); }
+
+int pstpu_num_row_groups(void* h) {
+  return static_cast<FileHandle*>(h)->metadata->num_row_groups();
+}
+
+long long pstpu_num_rows(void* h) {
+  return static_cast<FileHandle*>(h)->metadata->num_rows();
+}
+
+long long pstpu_row_group_num_rows(void* h, int row_group) {
+  auto* handle = static_cast<FileHandle*>(h);
+  if (row_group < 0 || row_group >= handle->metadata->num_row_groups()) {
+    set_error("row group index out of range");
+    return -1;
+  }
+  return handle->metadata->RowGroup(row_group)->num_rows();
+}
+
+// Number of leaf (physical) parquet columns.
+int pstpu_num_columns(void* h) {
+  return static_cast<FileHandle*>(h)->metadata->num_columns();
+}
+
+// Write the dot-joined path of leaf column `i` into buf; returns length or -1.
+int pstpu_column_name(void* h, int i, char* buf, int buf_len) {
+  auto* handle = static_cast<FileHandle*>(h);
+  if (i < 0 || i >= handle->metadata->num_columns()) {
+    set_error("column index out of range");
+    return -1;
+  }
+  const std::string name =
+      handle->metadata->schema()->Column(i)->path()->ToDotString();
+  if (static_cast<int>(name.size()) + 1 > buf_len) {
+    set_error("column name buffer too small");
+    return -1;
+  }
+  std::memcpy(buf, name.c_str(), name.size() + 1);
+  return static_cast<int>(name.size());
+}
+
+// Read one row group (optionally a subset of leaf columns) into an
+// ArrowArrayStream. Decode runs on Arrow C++ threads; the stream is consumed
+// zero-copy by pyarrow on the Python side.
+int pstpu_read_row_group(void* h, int row_group, const int* columns,
+                         int n_columns, struct ArrowArrayStream* out) {
+  auto* handle = static_cast<FileHandle*>(h);
+  std::lock_guard<std::mutex> lock(handle->mutex);
+  if (row_group < 0 || row_group >= handle->metadata->num_row_groups()) {
+    set_error("row group index out of range");
+    return -1;
+  }
+  arrow::Result<std::shared_ptr<arrow::Table>> maybe_table =
+      (columns != nullptr && n_columns >= 0)
+          ? handle->reader->ReadRowGroup(row_group,
+                                         std::vector<int>(columns, columns + n_columns))
+          : handle->reader->ReadRowGroup(row_group);
+  if (!maybe_table.ok()) {
+    set_error(maybe_table.status().ToString());
+    return -1;
+  }
+  std::shared_ptr<arrow::Table> table = *maybe_table;
+  // hand ownership of the decoded batches to the stream
+  arrow::TableBatchReader batch_reader(*table);
+  std::vector<std::shared_ptr<arrow::RecordBatch>> batches;
+  while (true) {
+    std::shared_ptr<arrow::RecordBatch> batch;
+    auto st = batch_reader.ReadNext(&batch);
+    if (!st.ok()) {
+      set_error(st.ToString());
+      return -1;
+    }
+    if (batch == nullptr) break;
+    batches.push_back(std::move(batch));
+  }
+  auto maybe_reader =
+      arrow::RecordBatchReader::Make(std::move(batches), table->schema());
+  if (!maybe_reader.ok()) {
+    set_error(maybe_reader.status().ToString());
+    return -1;
+  }
+  auto st = arrow::ExportRecordBatchReader(*maybe_reader, out);
+  if (!st.ok()) {
+    set_error(st.ToString());
+    return -1;
+  }
+  return 0;
+}
+
+int pstpu_abi_version() { return 1; }
+
+}  // extern "C"

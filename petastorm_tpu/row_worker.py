@@ -20,6 +20,7 @@ from collections import deque
 import numpy as np
 import pyarrow.parquet as pq
 
+from petastorm_tpu.native import open_parquet
 from petastorm_tpu.workers.worker_base import EmptyResultError, WorkerBase
 
 
@@ -66,7 +67,7 @@ class RowGroupDecoderWorker(WorkerBase):
             if len(self._open_files) > 8:  # bound per-worker open handles
                 _, old = self._open_files.popitem()
                 old.close()
-            self._open_files[path] = pq.ParquetFile(self._fs.open_input_file(path))
+            self._open_files[path] = open_parquet(path, self._fs)
         return self._open_files[path]
 
     def shutdown(self):

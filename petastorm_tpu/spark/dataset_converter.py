@@ -111,24 +111,51 @@ def _to_arrow_table(df, precision):
                     'pyarrow.Table, or pyspark DataFrame)'.format(type(df)))
 
 
-def _fingerprint(df, row_group_size, compression, precision):
-    """Cache key. Spark: logical plan (like the reference); local frames:
-    content hash — O(rows) but exact, and stable across re-created frames."""
-    suffix = '|rg={}|cc={}|p={}'.format(row_group_size, compression, precision)
+class _HashSink(object):
+    """File-like sink feeding an Arrow IPC stream straight into a hash."""
+
+    def __init__(self, digest):
+        self._digest = digest
+
+    def write(self, data):
+        self._digest.update(data)
+        return len(data)
+
+    def flush(self):
+        pass
+
+    def close(self):
+        pass
+
+    @property
+    def closed(self):
+        return False
+
+
+def _fingerprint(df, parent_cache_dir_url, row_group_size, compression, precision):
+    """Cache key. Spark: logical plan (like the reference); local frames: a
+    content hash of the Arrow IPC stream — O(rows) but exact (handles list/
+    tensor columns that pandas hashing cannot), and stable across re-created
+    frames. The parent cache dir is part of the key so switching dirs
+    rematerializes instead of pointing at the old location."""
+    suffix = '|dir={}|rg={}|cc={}|p={}'.format(parent_cache_dir_url, row_group_size,
+                                               compression, precision)
     if _is_spark_df(df):
         plan = df._jdf.queryExecution().analyzed().toString()
         return 'spark:' + hashlib.sha1(plan.encode()).hexdigest() + suffix
     import pandas as pd
     import pyarrow as pa
     if isinstance(df, pa.Table):
-        frame = df.to_pandas()
+        table = df
     elif isinstance(df, pd.DataFrame):
-        frame = df
+        table = pa.Table.from_pandas(df, preserve_index=False)
     else:
-        raise TypeError('Unsupported dataframe type: {}'.format(type(df)))
+        raise TypeError('Unsupported dataframe type: {} (expected pandas.DataFrame, '
+                        'pyarrow.Table, or pyspark DataFrame)'.format(type(df)))
     digest = hashlib.sha1()
-    digest.update(str(list(frame.dtypes)).encode())
-    digest.update(pd.util.hash_pandas_object(frame, index=False).values.tobytes())
+    digest.update(str(table.schema).encode())
+    with pa.ipc.new_stream(_HashSink(digest), table.schema) as writer:
+        writer.write_table(table)
     return 'local:' + digest.hexdigest() + suffix
 
 
@@ -263,7 +290,7 @@ def make_converter(df, parent_cache_dir_url=None,
         raise ValueError("precision {} is not supported. Use 'float32' or "
                          "'float64'".format(precision))
     parent = _resolve_parent_cache_dir(parent_cache_dir_url)
-    key = _fingerprint(df, parquet_row_group_size_bytes, compression_codec, precision)
+    key = _fingerprint(df, parent, parquet_row_group_size_bytes, compression_codec, precision)
     with _cache_lock:
         for meta in _cache_entries:
             if meta.fingerprint == key:

@@ -80,6 +80,33 @@ def test_converter_distinct_options_not_deduped(cache_dir):
     assert conv1.cache_dir_url != conv2.cache_dir_url
 
 
+def test_converter_array_columns(cache_dir):
+    # tensor/feature-vector columns are the core use case: the fingerprint and
+    # materialization must both handle ndarray cells
+    df = pd.DataFrame({
+        'id': np.arange(4, dtype=np.int64),
+        'feat': [np.full(3, float(i), dtype=np.float32) for i in range(4)],
+    })
+    conv = make_converter(df, parent_cache_dir_url=cache_dir)
+    conv2 = make_converter(df.copy(), parent_cache_dir_url=cache_dir)
+    assert conv.cache_dir_url == conv2.cache_dir_url  # dedup still works
+    with conv.make_jax_loader(batch_size=4, num_epochs=1) as loader:
+        batch = next(iter(loader))
+    assert batch['feat'].shape == (4, 3)
+    assert batch['feat'][2][0] == 2.0
+
+
+def test_converter_new_parent_dir_rematerializes(tmp_path):
+    dir_a = 'file://' + str(tmp_path / 'a')
+    dir_b = 'file://' + str(tmp_path / 'b')
+    (tmp_path / 'a').mkdir()
+    (tmp_path / 'b').mkdir()
+    conv_a = make_converter(_df(), parent_cache_dir_url=dir_a)
+    conv_b = make_converter(_df(), parent_cache_dir_url=dir_b)
+    assert conv_a.cache_dir_url.startswith(dir_a)
+    assert conv_b.cache_dir_url.startswith(dir_b)
+
+
 def test_converter_accepts_arrow_table(cache_dir):
     table = pa.table({'id': np.arange(10, dtype=np.int64),
                       'x': np.linspace(0, 1, 10)})

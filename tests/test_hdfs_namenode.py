@@ -287,6 +287,15 @@ def test_resolve_and_connect_mixed_case_nameservice(hadoop_conf):
     assert isinstance(fs, HAHdfsClient)
 
 
+def test_resolve_and_connect_ipv6_literal(hadoop_conf):
+    # bracketed IPv6 netloc must resolve as a direct host, not nameservice '['
+    fs, path = resolve_and_connect('hdfs://[::1]:8020/data',
+                                   hadoop_configuration=hadoop_conf,
+                                   connector=MockHdfsConnector)
+    assert not isinstance(fs, HAHdfsClient)
+    assert path == '/data'
+
+
 def test_resolve_and_connect_userinfo(hadoop_conf):
     fs, _ = resolve_and_connect('hdfs://alice@nameservice1/data',
                                 hadoop_configuration=hadoop_conf,

@@ -1,0 +1,121 @@
+"""Native C++ row-group reader kernel tests (SURVEY.md §2.10 component)."""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from petastorm_tpu import native
+
+
+pytestmark = pytest.mark.skipif(not native.is_available(),
+                                reason='native kernel not built/available')
+
+
+@pytest.fixture(scope='module')
+def parquet_file(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp('native') / 'data.parquet')
+    rng = np.random.default_rng(7)
+    table = pa.table({
+        'id': pa.array(np.arange(1000, dtype=np.int64)),
+        'value': pa.array(rng.random(1000)),
+        'name': pa.array(['row_{}'.format(i) for i in range(1000)]),
+        'blob': pa.array([bytes([i % 256] * 10) for i in range(1000)], type=pa.binary()),
+        'tags': pa.array([[i, i + 1] for i in range(1000)], type=pa.list_(pa.int64())),
+    })
+    pq.write_table(table, path, row_group_size=100)
+    return path
+
+
+def test_metadata(parquet_file):
+    with native.NativeParquetFile(parquet_file) as f:
+        assert f.num_rows == 1000
+        assert f.num_row_groups == 10
+        assert f.metadata.num_row_groups == 10
+        assert f.metadata.row_group(3).num_rows == 100
+
+
+def test_read_full_row_group_matches_pyarrow(parquet_file):
+    with native.NativeParquetFile(parquet_file) as f:
+        table = f.read_row_group(2)
+    expected = pq.ParquetFile(parquet_file).read_row_group(2)
+    assert table.num_rows == 100
+    assert table.column_names == expected.column_names
+    assert table.equals(expected)
+
+
+def test_read_column_subset(parquet_file):
+    with native.NativeParquetFile(parquet_file) as f:
+        table = f.read_row_group(0, columns=['value', 'id'])
+    assert set(table.column_names) == {'id', 'value'}
+    assert table['id'].to_pylist() == list(range(100))
+
+
+def test_read_nested_list_column(parquet_file):
+    with native.NativeParquetFile(parquet_file) as f:
+        table = f.read_row_group(1, columns=['tags'])
+    assert table.column_names == ['tags']
+    assert table['tags'][0].as_py() == [100, 101]
+
+
+def test_unknown_column_raises(parquet_file):
+    with native.NativeParquetFile(parquet_file) as f:
+        with pytest.raises(KeyError, match='nope'):
+            f.read_row_group(0, columns=['nope'])
+
+
+def test_row_group_out_of_range(parquet_file):
+    with native.NativeParquetFile(parquet_file) as f:
+        with pytest.raises(IOError):
+            f.read_row_group(99)
+
+
+def test_open_missing_file_raises(tmp_path):
+    with pytest.raises(IOError):
+        native.NativeParquetFile(str(tmp_path / 'missing.parquet'))
+
+
+def test_open_parquet_dispatch_local(parquet_file):
+    import pyarrow.fs as pafs
+    f = native.open_parquet(parquet_file, pafs.LocalFileSystem())
+    assert isinstance(f, native.NativeParquetFile)
+    f.close()
+
+
+def test_open_parquet_disable_env(parquet_file, monkeypatch):
+    # the env check happens at library-load time which is cached; simulate
+    # by calling the fallback branch directly via a non-local filesystem
+    import pyarrow.fs as pafs
+
+    class FakeFs(pafs.SubTreeFileSystem):
+        pass
+
+    fs = FakeFs('/', pafs.LocalFileSystem())
+    f = native.open_parquet(parquet_file.lstrip('/'), fs)
+    assert isinstance(f, pq.ParquetFile)
+
+
+def test_reader_end_to_end_uses_native(synthetic_dataset):
+    """Full make_reader path over the native kernel (workers call open_parquet)."""
+    from petastorm_tpu import make_reader
+    with make_reader(synthetic_dataset.url, num_epochs=1,
+                     schema_fields=['id', 'matrix']) as reader:
+        rows = list(reader)
+    assert len(rows) == len(synthetic_dataset.data)
+    assert rows[0].matrix.shape == (32, 16, 3)
+
+
+def test_native_concurrent_reads(parquet_file):
+    """Shared handle: reads serialize on the handle mutex, no corruption."""
+    import threading
+    with native.NativeParquetFile(parquet_file) as f:
+        results = [None] * 8
+        def read(i):
+            results[i] = f.read_row_group(i % 10, columns=['id'])
+        threads = [threading.Thread(target=read, args=(i,)) for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    for i, table in enumerate(results):
+        assert table['id'].to_pylist() == list(range((i % 10) * 100, (i % 10) * 100 + 100))
