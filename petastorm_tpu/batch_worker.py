@@ -17,7 +17,6 @@ from collections import deque
 
 import numpy as np
 import pyarrow as pa
-import pyarrow.parquet as pq
 
 from petastorm_tpu.row_worker import _cache_key, select_row_drop_indices
 from petastorm_tpu.native import open_parquet

@@ -36,9 +36,22 @@ def _arrow_paths():
     return include, libdirs, arrow_lib, parquet_lib
 
 
+def _stamp():
+    # the .so links versioned Arrow sonames with an rpath into the wheel dir:
+    # a pyarrow upgrade invalidates it even though the source didn't change
+    import pyarrow
+    return '{}:{}'.format(pyarrow.__version__, sys.version_info[:2])
+
+
 def _is_fresh():
-    return os.path.exists(OUTPUT) and \
-        os.path.getmtime(OUTPUT) >= os.path.getmtime(SOURCE)
+    if not (os.path.exists(OUTPUT) and
+            os.path.getmtime(OUTPUT) >= os.path.getmtime(SOURCE)):
+        return False
+    try:
+        with open(OUTPUT + '.stamp') as f:
+            return f.read() == _stamp()
+    except OSError:
+        return False
 
 
 def build(force=False, quiet=False):
@@ -73,6 +86,8 @@ def build(force=False, quiet=False):
                     os.unlink(tmp_out)
                 raise RuntimeError('native kernel build failed:\n' + result.stderr)
             os.replace(tmp_out, OUTPUT)
+            with open(OUTPUT + '.stamp', 'w') as f:
+                f.write(_stamp())
             return OUTPUT
         finally:
             fcntl.flock(lock_file, fcntl.LOCK_UN)

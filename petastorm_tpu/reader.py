@@ -129,17 +129,31 @@ def make_batch_reader(dataset_url,
                       cur_shard=None, shard_count=None,
                       cache_type='null', cache_location=None, cache_size_limit=None,
                       cache_row_size_estimate=None,
-                      transform_spec=None):
+                      transform_spec=None,
+                      batch_size=None, drop_last=False):
     """Columnar reader for ANY Parquet store (reference reader.py:177-289):
     yields one namedtuple of numpy column arrays per row group
     (``batched_output=True``). Schema is inferred from the Arrow schema unless
-    petastorm metadata is present."""
+    petastorm metadata is present.
+
+    ``batch_size``: when given, output batches have exactly this many rows
+    instead of row-group-sized batches — constant shapes keep XLA compilation
+    caches warm (the reference built this re-chunking but never wired it in:
+    pyarrow_helpers/batching_table_queue.py:20-79, SURVEY.md §2.6). The final
+    short batch is emitted unless ``drop_last``.
+    """
     schema = dataset_metadata.infer_or_load_unischema(dataset_url)
     cache = _make_cache(cache_type, cache_location, cache_size_limit, cache_row_size_estimate)
     pool = _make_pool(reader_pool_type, workers_count, results_queue_size)
+    if batch_size is not None:
+        from petastorm_tpu.rebatch import RebatchingResultsQueueReader
+        results_queue_reader_factory = (
+            lambda schema: RebatchingResultsQueueReader(schema, batch_size, drop_last=drop_last))
+    else:
+        results_queue_reader_factory = BatchResultsQueueReader
     return Reader(dataset_url, schema,
                   worker_class=ArrowBatchWorker,
-                  results_queue_reader_factory=BatchResultsQueueReader,
+                  results_queue_reader_factory=results_queue_reader_factory,
                   pool=pool, schema_fields=schema_fields, seed=seed,
                   shuffle_row_groups=shuffle_row_groups,
                   shuffle_row_drop_partitions=shuffle_row_drop_partitions,

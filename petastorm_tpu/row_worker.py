@@ -18,7 +18,6 @@ import hashlib
 from collections import deque
 
 import numpy as np
-import pyarrow.parquet as pq
 
 from petastorm_tpu.native import open_parquet
 from petastorm_tpu.workers.worker_base import EmptyResultError, WorkerBase

@@ -290,6 +290,14 @@ def make_converter(df, parent_cache_dir_url=None,
         raise ValueError("precision {} is not supported. Use 'float32' or "
                          "'float64'".format(precision))
     parent = _resolve_parent_cache_dir(parent_cache_dir_url)
+    if not _is_spark_df(df):
+        import pandas as pd
+        if isinstance(df, pd.DataFrame):
+            # convert once up front: fingerprinting and materialization both
+            # need the Arrow table, and for multi-GB frames a second
+            # from_pandas doubles peak memory
+            import pyarrow as pa
+            df = pa.Table.from_pandas(df, preserve_index=False)
     key = _fingerprint(df, parent, parquet_row_group_size_bytes, compression_codec, precision)
     with _cache_lock:
         for meta in _cache_entries:

@@ -82,9 +82,8 @@ def test_open_parquet_dispatch_local(parquet_file):
     f.close()
 
 
-def test_open_parquet_disable_env(parquet_file, monkeypatch):
-    # the env check happens at library-load time which is cached; simulate
-    # by calling the fallback branch directly via a non-local filesystem
+def test_open_parquet_nonlocal_fs_falls_back(parquet_file):
+    # non-local filesystems dispatch to the pyarrow path
     import pyarrow.fs as pafs
 
     class FakeFs(pafs.SubTreeFileSystem):
@@ -92,6 +91,19 @@ def test_open_parquet_disable_env(parquet_file, monkeypatch):
 
     fs = FakeFs('/', pafs.LocalFileSystem())
     f = native.open_parquet(parquet_file.lstrip('/'), fs)
+    assert isinstance(f, pq.ParquetFile)
+
+
+def test_open_parquet_disable_env(parquet_file, monkeypatch):
+    # the kill switch must force the pyarrow path even on a local filesystem;
+    # reset the module-level load cache so the env check actually re-runs
+    import pyarrow.fs as pafs
+
+    monkeypatch.setenv('PETASTORM_TPU_DISABLE_NATIVE', '1')
+    # monkeypatch restores the cached handle/flag after the test
+    monkeypatch.setattr(native, '_lib', None)
+    monkeypatch.setattr(native, '_load_failed', False)
+    f = native.open_parquet(parquet_file, pafs.LocalFileSystem())
     assert isinstance(f, pq.ParquetFile)
 
 

@@ -1,0 +1,138 @@
+"""Fixed-size rebatching: BatchingColumnQueue unit tests (reference
+pyarrow_helpers/tests/test_batching_table_queue.py semantics, columnar) and
+make_batch_reader(batch_size=...) end-to-end."""
+
+import numpy as np
+import pytest
+
+from petastorm_tpu.rebatch import BatchingColumnQueue
+from petastorm_tpu.reader import make_batch_reader
+
+
+def _batch(start, n):
+    return {'id': np.arange(start, start + n),
+            'x': np.arange(start, start + n, dtype=np.float32) * 2.0}
+
+
+def test_queue_basic_rechunk():
+    q = BatchingColumnQueue(4)
+    assert q.empty()
+    q.put(_batch(0, 10))
+    assert not q.empty()
+    b1 = q.get()
+    np.testing.assert_array_equal(b1['id'], [0, 1, 2, 3])
+    b2 = q.get()
+    np.testing.assert_array_equal(b2['id'], [4, 5, 6, 7])
+    assert q.empty()  # only 2 rows left
+    assert len(q) == 2
+
+
+def test_queue_spans_segments_preserving_order():
+    q = BatchingColumnQueue(7)
+    q.put(_batch(0, 3))
+    assert q.empty()
+    q.put(_batch(3, 3))
+    q.put(_batch(6, 5))
+    b = q.get()
+    np.testing.assert_array_equal(b['id'], np.arange(7))
+    np.testing.assert_array_equal(b['x'], np.arange(7) * 2.0)
+    assert len(q) == 4
+
+
+def test_queue_drain_and_empty_put():
+    q = BatchingColumnQueue(4)
+    q.put(_batch(0, 0))  # no-op
+    assert q.drain() is None
+    q.put(_batch(0, 3))
+    d = q.drain()
+    np.testing.assert_array_equal(d['id'], [0, 1, 2])
+    assert len(q) == 0
+
+
+def test_queue_exact_multiple_leaves_nothing():
+    q = BatchingColumnQueue(5)
+    q.put(_batch(0, 10))
+    q.get()
+    q.get()
+    assert q.drain() is None
+
+
+def test_queue_ragged_batch_rejected():
+    q = BatchingColumnQueue(2)
+    with pytest.raises(ValueError, match='ragged'):
+        q.put({'a': np.arange(3), 'b': np.arange(4)})
+
+
+def test_queue_object_dtype_columns():
+    q = BatchingColumnQueue(3)
+    col = np.empty(4, dtype=object)
+    col[:] = [b'a', b'bb', None, b'dddd']
+    q.put({'s': col})
+    q.put({'s': col.copy()})
+    got = q.get()
+    assert list(got['s']) == [b'a', b'bb', None]
+
+
+def test_batch_reader_fixed_batch_size(scalar_dataset):
+    # 100 rows in 10-row groups; batch_size=32 -> 32,32,32,4
+    with make_batch_reader(scalar_dataset.url, batch_size=32, workers_count=3,
+                           shuffle_row_groups=False) as reader:
+        sizes = [len(b.id) for b in reader]
+    assert sizes == [32, 32, 32, 4]
+
+
+def test_batch_reader_fixed_batch_drop_last(scalar_dataset):
+    with make_batch_reader(scalar_dataset.url, batch_size=32, workers_count=3,
+                           shuffle_row_groups=False) as reader:
+        ids = np.concatenate([b.id for b in reader])
+    with make_batch_reader(scalar_dataset.url, batch_size=32, drop_last=True,
+                           workers_count=3, shuffle_row_groups=False) as reader:
+        sizes = [len(b.id) for b in reader]
+    assert sizes == [32, 32, 32]
+    assert sorted(ids.tolist()) == sorted(r['id'] for r in scalar_dataset.data)
+
+
+def test_batch_reader_rebatch_preserves_order_unshuffled(scalar_dataset):
+    with make_batch_reader(scalar_dataset.url, batch_size=16, workers_count=1,
+                           reader_pool_type='dummy', shuffle_row_groups=False) as reader:
+        ids = np.concatenate([b.id for b in reader])
+    assert ids.tolist() == sorted(r['id'] for r in scalar_dataset.data)
+
+
+def test_batch_reader_rebatch_multiple_epochs(scalar_dataset):
+    with make_batch_reader(scalar_dataset.url, batch_size=64, num_epochs=2,
+                           workers_count=3, shuffle_row_groups=False) as reader:
+        total = sum(len(b.id) for b in reader)
+    assert total == 200
+
+
+def test_batch_reader_drop_last_discards_tail_across_reset(scalar_dataset):
+    # leftover rows from pass 1 must not leak into pass 2's first batch
+    reader = make_batch_reader(scalar_dataset.url, batch_size=32, drop_last=True,
+                               reader_pool_type='dummy', shuffle_row_groups=False)
+    try:
+        first = [len(b.id) for b in reader]
+        reader.reset()
+        second_first_batch = next(iter(reader)).id
+        rest = [len(b.id) for b in reader]
+    finally:
+        reader.stop()
+        reader.join()
+    assert first == [32, 32, 32]
+    assert len(second_first_batch) == 32
+    # unshuffled: pass 2 must start from row 0 again, not from pass 1's tail
+    assert second_first_batch[0] == min(r['id'] for r in scalar_dataset.data)
+    assert rest == [32, 32]
+
+
+def test_batch_reader_rebatch_with_reset(scalar_dataset):
+    reader = make_batch_reader(scalar_dataset.url, batch_size=30, workers_count=2,
+                               shuffle_row_groups=False)
+    try:
+        first = sum(len(b.id) for b in reader)
+        reader.reset()
+        second = sum(len(b.id) for b in reader)
+    finally:
+        reader.stop()
+        reader.join()
+    assert first == second == 100
