@@ -1,0 +1,73 @@
+"""Random schema-conformant datapoint generation.
+
+Parity: reference /root/reference/petastorm/generator.py:21-47 (random datapoint
+from a Unischema) — here with a seedable RNG (the framework-wide determinism
+story, SURVEY.md §5) and coverage for string/bytes/Decimal/bool fields the
+reference's float-cast approach mishandles.
+"""
+
+from __future__ import annotations
+
+import string
+from decimal import Decimal
+
+import numpy as np
+
+#: dimension used for ``None`` (wildcard) shape entries
+LIST_SIZE = 13
+
+_ALPHABET = np.array(list(string.ascii_lowercase))
+
+
+def _random_value(field, rng, list_size):
+    dtype = field.numpy_dtype
+    shape = tuple(list_size if d is None else d for d in field.shape)
+    if dtype is Decimal:
+        return Decimal('{}.{:02d}'.format(int(rng.integers(0, 1000)),
+                                          int(rng.integers(0, 100))))
+    if dtype is np.str_ or dtype is str:
+        def word():
+            return ''.join(rng.choice(_ALPHABET, size=rng.integers(1, 12)))
+        if shape == ():
+            return word()
+        return np.asarray([word() for _ in range(int(np.prod(shape)))],
+                          dtype=np.str_).reshape(shape)
+    if dtype is np.bytes_ or dtype is bytes:
+        def token():
+            return ''.join(rng.choice(_ALPHABET, size=rng.integers(1, 12))).encode()
+        if shape == ():
+            return token()
+        return np.asarray([token() for _ in range(int(np.prod(shape)))],
+                          dtype=np.bytes_).reshape(shape)
+    np_dtype = np.dtype(dtype)
+    if np_dtype.kind == 'b':
+        value = rng.integers(0, 2, size=shape).astype(np.bool_)
+    elif np_dtype.kind in 'iu':
+        info = np.iinfo(np_dtype)
+        value = rng.integers(info.min, info.max, size=shape, dtype=np_dtype,
+                             endpoint=True)
+    elif np_dtype.kind == 'f':
+        value = rng.random(size=shape).astype(np_dtype)
+    elif np_dtype.kind == 'M':  # datetime64
+        value = (np.datetime64('2020-01-01') +
+                 rng.integers(0, 10**6, size=shape).astype('timedelta64[s]'))
+        value = value.astype(np_dtype)
+    else:
+        raise TypeError('generate_datapoint: unsupported dtype {} for field {}'.format(
+            np_dtype, field.name))
+    if shape == ():
+        return value[()] if isinstance(value, np.ndarray) else value
+    return value
+
+
+def generate_datapoint(schema, rng=None, list_size=LIST_SIZE):
+    """Generate one random row dict conforming to ``schema``
+    (reference generator.py:21-47).
+
+    :param schema: a :class:`~petastorm_tpu.unischema.Unischema`
+    :param rng: ``numpy.random.Generator`` (None = fresh nondeterministic one)
+    :param list_size: dimension substituted for ``None`` shape wildcards
+    """
+    rng = rng if rng is not None else np.random.default_rng()
+    return {name: _random_value(field, rng, list_size)
+            for name, field in schema.fields.items()}

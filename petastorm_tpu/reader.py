@@ -150,6 +150,9 @@ def make_batch_reader(dataset_url,
         results_queue_reader_factory = (
             lambda schema: RebatchingResultsQueueReader(schema, batch_size, drop_last=drop_last))
     else:
+        if drop_last:
+            raise ValueError('drop_last requires batch_size (without rebatching, batches are '
+                             'row-group-sized and there is no "last short batch" to drop)')
         results_queue_reader_factory = BatchResultsQueueReader
     return Reader(dataset_url, schema,
                   worker_class=ArrowBatchWorker,

@@ -80,7 +80,26 @@ class BatchingColumnQueue(object):
         self._buffered -= count
         if len(parts) == 1:
             return parts[0]
-        return {k: np.concatenate([p[k] for p in parts]) for k in parts[0]}
+        return {k: _concat_column([p[k] for p in parts]) for k in parts[0]}
+
+
+def _concat_column(parts):
+    """Concatenate per-segment column arrays. List-typed Parquet columns decode
+    to a 2-D array when a row group's lists are uniform-length but a 1-D object
+    array otherwise (batch_worker._column_to_numpy) — mixed segments of one
+    logical column must degrade to object rows instead of crashing concat."""
+    uniform = (len({p.ndim for p in parts}) == 1 and
+               len({p.shape[1:] for p in parts}) == 1 and
+               not any(p.dtype == object for p in parts))
+    if uniform:
+        return np.concatenate(parts)
+    rows = []
+    for p in parts:
+        rows.extend(p[i] for i in range(len(p)))
+    out = np.empty(len(rows), dtype=object)
+    for i, r in enumerate(rows):
+        out[i] = r
+    return out
 
 
 class RebatchingResultsQueueReader(object):

@@ -88,27 +88,18 @@ def _is_spark_df(df):
 
 
 def _to_arrow_table(df, precision):
-    """pandas.DataFrame | pyarrow.Table -> pyarrow.Table at the given float
-    precision (reference _convert_precision, :406-421)."""
-    import numpy as np
-    import pandas as pd
+    """pyarrow.Table cast to the given float precision (reference
+    _convert_precision, :406-421). pandas frames are converted to Tables once,
+    up front, in make_converter."""
     import pyarrow as pa
 
-    if isinstance(df, pd.DataFrame):
-        if precision == 'float32':
-            df = df.astype({c: np.float32 for c in df.columns
-                            if df[c].dtype == np.float64})
-        elif precision == 'float64':
-            df = df.astype({c: np.float64 for c in df.columns
-                            if df[c].dtype == np.float32})
-        return pa.Table.from_pandas(df, preserve_index=False)
-    if isinstance(df, pa.Table):
-        source, target = (pa.float64(), pa.float32()) if precision == 'float32' \
-            else (pa.float32(), pa.float64())
-        fields = [pa.field(f.name, target) if f.type == source else f for f in df.schema]
-        return df.cast(pa.schema(fields))
-    raise TypeError('Unsupported dataframe type: {} (expected pandas.DataFrame, '
-                    'pyarrow.Table, or pyspark DataFrame)'.format(type(df)))
+    if not isinstance(df, pa.Table):
+        raise TypeError('Unsupported dataframe type: {} (expected pyarrow.Table '
+                        'or pyspark DataFrame)'.format(type(df)))
+    source, target = (pa.float64(), pa.float32()) if precision == 'float32' \
+        else (pa.float32(), pa.float64())
+    fields = [pa.field(f.name, target) if f.type == source else f for f in df.schema]
+    return df.cast(pa.schema(fields))
 
 
 class _HashSink(object):
@@ -143,15 +134,12 @@ def _fingerprint(df, parent_cache_dir_url, row_group_size, compression, precisio
     if _is_spark_df(df):
         plan = df._jdf.queryExecution().analyzed().toString()
         return 'spark:' + hashlib.sha1(plan.encode()).hexdigest() + suffix
-    import pandas as pd
     import pyarrow as pa
-    if isinstance(df, pa.Table):
-        table = df
-    elif isinstance(df, pd.DataFrame):
-        table = pa.Table.from_pandas(df, preserve_index=False)
-    else:
-        raise TypeError('Unsupported dataframe type: {} (expected pandas.DataFrame, '
-                        'pyarrow.Table, or pyspark DataFrame)'.format(type(df)))
+    if not isinstance(df, pa.Table):
+        # make_converter converts pandas frames up front; direct callers must too
+        raise TypeError('Unsupported dataframe type: {} (expected pyarrow.Table '
+                        'or pyspark DataFrame)'.format(type(df)))
+    table = df
     digest = hashlib.sha1()
     digest.update(str(table.schema).encode())
     with pa.ipc.new_stream(_HashSink(digest), table.schema) as writer:

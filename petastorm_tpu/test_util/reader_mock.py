@@ -1,0 +1,85 @@
+"""A storage-less Reader stand-in for testing adapters in isolation.
+
+Parity: reference /root/reference/petastorm/test_util/reader_mock.py:19-82 —
+extended with ``batched_output`` (the reference mock only covered the
+row-oriented path) and a bounded ``num_rows`` so iteration can terminate, which
+the infinite reference mock could not.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from petastorm_tpu.generator import generate_datapoint
+
+
+def schema_data_generator_example(schema, rng=None):
+    """Random row dict for ``schema`` (reference reader_mock.py:67-82, but
+    random instead of zeros so correlation/shuffle tests are meaningful)."""
+    return generate_datapoint(schema, rng=rng)
+
+
+class ReaderMock(object):
+    """Yields schema-conformant synthetic rows with the Reader interface
+    (iteration, ``stop``/``join``, context manager, ``batched_output``,
+    ``reset``) and no storage underneath.
+
+    :param schema: a Unischema
+    :param schema_data_generator: ``f(schema) -> row dict`` (default: seeded
+        random rows via :func:`generate_datapoint`)
+    :param num_rows: rows (or batches when ``batch_size``) per epoch;
+        ``None`` = infinite, like the reference mock
+    :param batch_size: when set, emits namedtuples of stacked column arrays
+        with this many rows (``batched_output=True``)
+    """
+
+    def __init__(self, schema, schema_data_generator=None, ngram=None,
+                 num_rows=None, batch_size=None, seed=0):
+        if ngram is not None:
+            raise ValueError('NGram is not supported by ReaderMock')
+        self.schema = schema
+        self.ngram = None
+        self._rng = np.random.default_rng(seed)
+        self._generator = (schema_data_generator if schema_data_generator is not None
+                           else (lambda s: schema_data_generator_example(s, rng=self._rng)))
+        self._num_rows = num_rows
+        self._batch_size = batch_size
+        self._emitted = 0
+        self.batched_output = batch_size is not None
+        self.last_row_consumed = False
+
+    def fetch(self):
+        if self._batch_size is None:
+            return self.schema.make_namedtuple(**self._generator(self.schema))
+        rows = [self._generator(self.schema) for _ in range(self._batch_size)]
+        columns = {name: np.stack([np.asarray(r[name]) for r in rows])
+                   for name in self.schema.fields}
+        return self.schema.make_namedtuple(**columns)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._num_rows is not None and self._emitted >= self._num_rows:
+            self.last_row_consumed = True
+            raise StopIteration
+        self._emitted += 1
+        return self.fetch()
+
+    next = __next__
+
+    def reset(self):
+        self._emitted = 0
+        self.last_row_consumed = False
+
+    def stop(self):
+        pass
+
+    def join(self):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        self.stop()

@@ -73,6 +73,37 @@ def test_queue_object_dtype_columns():
     assert list(got['s']) == [b'a', b'bb', None]
 
 
+def test_queue_mixed_uniform_and_ragged_list_segments():
+    # batch_worker decodes list columns as 2-D when uniform-length, 1-D object
+    # otherwise; a batch spanning such segments must degrade to object rows
+    q = BatchingColumnQueue(5)
+    q.put({'v': np.arange(6, dtype=np.float32).reshape(3, 2)})
+    ragged = np.empty(3, dtype=object)
+    ragged[0] = np.asarray([1.0])
+    ragged[1] = np.asarray([2.0, 3.0, 4.0])
+    ragged[2] = None
+    q.put({'v': ragged})
+    b = q.get()
+    assert b['v'].dtype == object
+    np.testing.assert_array_equal(b['v'][0], [0.0, 1.0])
+    np.testing.assert_array_equal(b['v'][3], [1.0])
+    assert len(q) == 1
+
+
+def test_queue_mismatched_inner_width_segments():
+    q = BatchingColumnQueue(4)
+    q.put({'v': np.zeros((2, 3), dtype=np.float32)})
+    q.put({'v': np.ones((2, 5), dtype=np.float32)})
+    b = q.get()
+    assert b['v'].dtype == object
+    assert b['v'][0].shape == (3,) and b['v'][2].shape == (5,)
+
+
+def test_drop_last_without_batch_size_rejected(scalar_dataset):
+    with pytest.raises(ValueError, match='drop_last requires batch_size'):
+        make_batch_reader(scalar_dataset.url, drop_last=True)
+
+
 def test_batch_reader_fixed_batch_size(scalar_dataset):
     # 100 rows in 10-row groups; batch_size=32 -> 32,32,32,4
     with make_batch_reader(scalar_dataset.url, batch_size=32, workers_count=3,
