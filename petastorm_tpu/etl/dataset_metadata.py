@@ -304,12 +304,17 @@ def _read_common_metadata(fs, root):
 
 def read_metadata_value(dataset_url, key):
     """Read one KV metadata value from _common_metadata (bytes), or None."""
+    return read_metadata_dict(dataset_url).get(key)
+
+
+def read_metadata_dict(dataset_url):
+    """All KV metadata from _common_metadata as a dict (one footer fetch)."""
     resolver = FilesystemResolver(dataset_url)
     fs, root = resolver.filesystem(), resolver.get_dataset_path()
     arrow_schema = _read_common_metadata(fs, root)
     if arrow_schema is None or not arrow_schema.metadata:
-        return None
-    return arrow_schema.metadata.get(key)
+        return {}
+    return dict(arrow_schema.metadata)
 
 
 # ---------------------------------------------------------------------------
@@ -380,14 +385,19 @@ def load_row_groups(dataset_url, schema=None, max_footer_read_threads=10,
     resolver = FilesystemResolver(dataset_url)
     fs, root = resolver.filesystem(), resolver.get_dataset_path()
     arrow_meta_schema = _read_common_metadata(fs, root)  # single read serves schema + counts
-    if schema is None and arrow_meta_schema is not None and arrow_meta_schema.metadata and \
-            UNISCHEMA_KEY in arrow_meta_schema.metadata:
-        schema = Unischema.from_json(
-            json.loads(arrow_meta_schema.metadata[UNISCHEMA_KEY].decode('utf-8')))
+    meta = (arrow_meta_schema.metadata or {}) if arrow_meta_schema is not None else {}
+    if schema is None and UNISCHEMA_KEY in meta:
+        schema = Unischema.from_json(json.loads(meta[UNISCHEMA_KEY].decode('utf-8')))
 
-    if use_cached_metadata and arrow_meta_schema is not None and arrow_meta_schema.metadata and \
-            ROW_GROUPS_PER_FILE_KEY in arrow_meta_schema.metadata:
-        counts = json.loads(arrow_meta_schema.metadata[ROW_GROUPS_PER_FILE_KEY].decode('utf-8'))
+    counts = None
+    if use_cached_metadata and ROW_GROUPS_PER_FILE_KEY in meta:
+        counts = json.loads(meta[ROW_GROUPS_PER_FILE_KEY].decode('utf-8'))
+    elif use_cached_metadata:
+        from petastorm_tpu.etl import legacy
+        if legacy.REF_ROW_GROUPS_PER_FILE_KEY in meta:
+            # counts written by the original petastorm library (plain ints)
+            counts = legacy.load_legacy_row_group_counts(meta[legacy.REF_ROW_GROUPS_PER_FILE_KEY])
+    if counts is not None:
         pieces = []
         for relpath in sorted(counts):
             full = posixpath.join(root, relpath)
@@ -444,9 +454,15 @@ def load_row_groups(dataset_url, schema=None, max_footer_read_threads=10,
 
 def _try_get_schema(fs, root):
     arrow_schema = _read_common_metadata(fs, root)
-    if arrow_schema is None or not arrow_schema.metadata or UNISCHEMA_KEY not in arrow_schema.metadata:
+    if arrow_schema is None or not arrow_schema.metadata:
         return None
-    return Unischema.from_json(json.loads(arrow_schema.metadata[UNISCHEMA_KEY].decode('utf-8')))
+    if UNISCHEMA_KEY in arrow_schema.metadata:
+        return Unischema.from_json(json.loads(arrow_schema.metadata[UNISCHEMA_KEY].decode('utf-8')))
+    from petastorm_tpu.etl import legacy
+    if legacy.REF_UNISCHEMA_KEY in arrow_schema.metadata:
+        # dataset written by the original petastorm library
+        return legacy.load_legacy_unischema(arrow_schema.metadata[legacy.REF_UNISCHEMA_KEY])
+    return None
 
 
 def get_schema(dataset_url):

@@ -1,0 +1,265 @@
+"""Interop: read datasets written by the ORIGINAL petastorm library.
+
+The reference stores its Unischema pickled into ``_common_metadata`` under
+``dataset-toolkit.unischema.v1`` (reference etl/dataset_metadata.py:34-35), with
+per-file row-group counts and row-group indexes under sibling keys, and its
+``etl/legacy.py:22-47`` binary-patches even older package names inside the
+pickle stream. A user migrating from petastorm must be able to point
+``make_reader`` at an existing dataset — the row payload formats are already
+compatible (np.save / npz / png / typed scalars match our codecs byte-for-byte).
+
+This module decodes those pickles WITHOUT petastorm or pyspark installed and
+WITHOUT arbitrary code execution: a restricted unpickler maps the reference's
+class names (including its own legacy aliases) onto local shims, and anything
+outside the allowlist raises. The shims are then converted to petastorm_tpu
+schema/codec/indexer objects.
+"""
+
+from __future__ import annotations
+
+import io
+import logging
+import pickle
+from collections import OrderedDict
+from decimal import Decimal
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+#: metadata keys the reference writes (etl/dataset_metadata.py:34-35,
+#: etl/rowgroup_indexing.py:33)
+REF_UNISCHEMA_KEY = b'dataset-toolkit.unischema.v1'
+REF_ROW_GROUPS_PER_FILE_KEY = b'dataset-toolkit.num_row_groups_per_file.v1'
+REF_ROW_GROUP_INDEX_KEY = b'dataset-toolkit.rowgroups_index.v1'
+
+#: package aliases the reference itself migrates between (legacy.py:31)
+_SCHEMA_MODULES = ('petastorm', 'dataset_toolkit',
+                   'av.experimental.deepdrive.dataset_toolkit', 'av.ml.dataset_toolkit')
+
+
+class _Shim(object):
+    """Instance reconstructed from a foreign pickle: plain attribute bag.
+    Tolerates every pickle reconstruction path (NEWOBJ with or without args,
+    copyreg._reconstructor, BUILD with a state dict)."""
+
+    def __new__(cls, *args, **kwargs):
+        obj = object.__new__(cls)
+        obj._ctor_args = args
+        obj._ctor_kwargs = kwargs
+        return obj
+
+    def __init__(self, *args, **kwargs):
+        pass
+
+
+class _RefUnischema(_Shim):
+    pass
+
+
+class _RefUnischemaField(tuple):
+    """Reference UnischemaField is a namedtuple (name, numpy_dtype, shape,
+    codec, nullable): a tuple subclass survives both NEWOBJ (protocol >=2 via
+    __getnewargs__) and copyreg._reconstructor(cls, tuple, values)
+    (protocols 0/1) reconstruction."""
+
+    def __new__(cls, *args):
+        # NEWOBJ passes the 5 fields as positional args; _reconstructor passes
+        # one tuple containing them
+        if len(args) == 1 and isinstance(args[0], tuple):
+            args = args[0]
+        return tuple.__new__(cls, args)
+
+    @property
+    def _ctor_args(self):
+        return tuple(self)
+
+
+class _RefScalarCodec(_Shim):
+    pass
+
+
+class _RefNdarrayCodec(_Shim):
+    pass
+
+
+class _RefCompressedNdarrayCodec(_Shim):
+    pass
+
+
+class _RefCompressedImageCodec(_Shim):
+    pass
+
+
+class _RefSingleFieldIndexer(_Shim):
+    pass
+
+
+class _RefFieldNotNullIndexer(_Shim):
+    pass
+
+
+class _SparkTypeStub(_Shim):
+    """Stands in for any pyspark.sql.types.* instance (pyspark need not be
+    installed). The class name is what conversion logic looks at."""
+    spark_type_name = None
+
+
+_CODEC_SHIMS = {
+    'ScalarCodec': _RefScalarCodec,
+    'NdarrayCodec': _RefNdarrayCodec,
+    'CompressedNdarrayCodec': _RefCompressedNdarrayCodec,
+    'CompressedImageCodec': _RefCompressedImageCodec,
+}
+
+_NUMPY_ALLOWED = {
+    'bool_', 'int8', 'int16', 'int32', 'int64', 'uint8', 'uint16', 'uint32',
+    'uint64', 'float16', 'float32', 'float64', 'str_', 'unicode_', 'bytes_',
+    'string_', 'object_', 'datetime64', 'timedelta64', 'dtype', 'ndarray',
+}
+
+_spark_type_stubs = {}
+
+
+def _spark_type_stub(name):
+    if name not in _spark_type_stubs:
+        _spark_type_stubs[name] = type(name, (_SparkTypeStub,), {'spark_type_name': name})
+    return _spark_type_stubs[name]
+
+
+class _RestrictedUnpickler(pickle.Unpickler):
+    """Only reference schema/codec/indexer classes, pyspark type names, numpy
+    scalar types, and basic containers may appear in the stream."""
+
+    def find_class(self, module, name):
+        for pkg in _SCHEMA_MODULES:
+            if module == pkg + '.unischema' or module == pkg + '.sequence':
+                if name == 'Unischema':
+                    return _RefUnischema
+                if name == 'UnischemaField':
+                    return _RefUnischemaField
+            if module == pkg + '.codecs' and name in _CODEC_SHIMS:
+                return _CODEC_SHIMS[name]
+            if module in (pkg + '.etl.rowgroup_indexers', pkg + '.rowgroup_indexers'):
+                if name == 'SingleFieldIndexer':
+                    return _RefSingleFieldIndexer
+                if name == 'FieldNotNullIndexer':
+                    return _RefFieldNotNullIndexer
+        if module == 'pyspark.sql.types':
+            return _spark_type_stub(name)
+        if module == 'numpy' and name in _NUMPY_ALLOWED:
+            return getattr(np, name)
+        if module in ('numpy.core.multiarray', 'numpy._core.multiarray') and \
+                name in ('scalar', '_reconstruct'):
+            import importlib
+            try:
+                ma = importlib.import_module('numpy._core.multiarray')
+            except ImportError:
+                ma = importlib.import_module('numpy.core.multiarray')
+            return getattr(ma, name)
+        if module == 'collections' and name in ('OrderedDict', 'defaultdict'):
+            import collections
+            return getattr(collections, name)
+        if module == 'decimal' and name == 'Decimal':
+            return Decimal
+        if module in ('copy_reg', 'copyreg') and name == '_reconstructor':
+            import copyreg
+            return copyreg._reconstructor
+        if module in ('__builtin__', 'builtins') and name in ('object', 'set', 'frozenset'):
+            return {'object': object, 'set': set, 'frozenset': frozenset}[name]
+        raise pickle.UnpicklingError(
+            'Refusing to depickle {}.{} from legacy petastorm metadata'.format(module, name))
+
+
+def restricted_loads(data):
+    return _RestrictedUnpickler(io.BytesIO(data)).load()
+
+
+# -- shim -> petastorm_tpu conversion ------------------------------------------
+
+def _convert_codec(shim, field_shape):
+    from petastorm_tpu import codecs
+
+    if shim is None:
+        return None
+    state = shim.__dict__
+    if isinstance(shim, _RefScalarCodec):
+        return codecs.ScalarCodec()
+    if isinstance(shim, _RefNdarrayCodec):
+        return codecs.NdarrayCodec()
+    if isinstance(shim, _RefCompressedNdarrayCodec):
+        return codecs.CompressedNdarrayCodec()
+    if isinstance(shim, _RefCompressedImageCodec):
+        # reference stores '.png'/'.jpeg' with the leading dot (codecs.py:62)
+        fmt = state.get('_image_codec', '.png').lstrip('.')
+        return codecs.CompressedImageCodec(fmt, quality=state.get('_quality', 80))
+    raise pickle.UnpicklingError('Unknown legacy codec shim {!r}'.format(shim))
+
+
+def _convert_field(shim):
+    from petastorm_tpu.unischema import UnischemaField
+
+    name, numpy_dtype, shape, codec, nullable = (tuple(shim._ctor_args) + (None, False))[:5]
+    return UnischemaField(name, numpy_dtype, shape,
+                          codec=_convert_codec(codec, shape), nullable=nullable)
+
+
+def convert_unischema(shim):
+    """Reference Unischema shim -> :class:`petastorm_tpu.unischema.Unischema`."""
+    from petastorm_tpu.unischema import Unischema
+
+    state = shim.__dict__
+    fields = [f for f in state.get('_fields', {}).values()
+              if isinstance(f, _RefUnischemaField)]
+    return Unischema(state.get('_name', 'legacy'), [_convert_field(f) for f in fields])
+
+
+def load_legacy_unischema(pickled):
+    """Pickle bytes from ``dataset-toolkit.unischema.v1`` -> our Unischema."""
+    shim = restricted_loads(pickled)
+    if not isinstance(shim, _RefUnischema):
+        raise pickle.UnpicklingError(
+            'legacy unischema metadata did not contain a Unischema (got {!r})'.format(type(shim)))
+    schema = convert_unischema(shim)
+    logger.info('Loaded legacy petastorm unischema %r (%d fields)', schema.name, len(schema.fields))
+    return schema
+
+
+def load_legacy_row_group_counts(raw):
+    """Bytes from ``dataset-toolkit.num_row_groups_per_file.v1`` -> dict of
+    relative file path -> row-group count. Unlike the schema/index keys this
+    one is JSON in the reference (etl/dataset_metadata.py:226-228)."""
+    import json
+
+    counts = json.loads(raw.decode('utf-8'))
+    if not isinstance(counts, dict):
+        raise ValueError('legacy row-group counts were not a dict')
+    return {str(k): int(v) for k, v in counts.items()}
+
+
+def load_legacy_rowgroup_indexes(pickled):
+    """Pickle bytes from ``dataset-toolkit.rowgroups_index.v1`` -> dict of
+    index name -> petastorm_tpu indexer."""
+    from petastorm_tpu.etl.rowgroup_indexers import FieldNotNullIndexer, SingleFieldIndexer
+
+    raw = restricted_loads(pickled)
+    if not isinstance(raw, dict):
+        raise pickle.UnpicklingError('legacy rowgroup index metadata was not a dict')
+    from petastorm_tpu.etl.rowgroup_indexers import _json_key
+
+    out = {}
+    for name, shim in raw.items():
+        state = getattr(shim, '__dict__', {})
+        index_name = state.get('_index_name', name)
+        column = state.get('_column_name')
+        if isinstance(shim, _RefSingleFieldIndexer):
+            # reference keys values natively; ours uses JSON-stable string keys
+            data = {_json_key(k): set(v) for k, v in state.get('_index_data', {}).items()}
+            out[name] = SingleFieldIndexer(index_name, column, index_dict=data)
+        elif isinstance(shim, _RefFieldNotNullIndexer):
+            # reference _index_data is a plain set of piece indexes
+            out[name] = FieldNotNullIndexer(index_name, column,
+                                            piece_indexes=set(state.get('_index_data', ())))
+        else:
+            raise pickle.UnpicklingError('Unknown legacy indexer type {!r}'.format(type(shim)))
+    return out

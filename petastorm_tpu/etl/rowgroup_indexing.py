@@ -69,8 +69,13 @@ def build_rowgroup_index(dataset_url, indexers, max_workers=10):
 def get_row_group_indexes(dataset_url):
     """Load the stored indexes: dict index_name -> indexer
     (reference rowgroup_indexing.py:138-160)."""
-    raw = dataset_metadata.read_metadata_value(dataset_url, dataset_metadata.ROW_GROUP_INDEX_KEY)
+    meta = dataset_metadata.read_metadata_dict(dataset_url)  # one footer fetch serves both keys
+    raw = meta.get(dataset_metadata.ROW_GROUP_INDEX_KEY)
     if raw is None:
+        from petastorm_tpu.etl import legacy
+        legacy_raw = meta.get(legacy.REF_ROW_GROUP_INDEX_KEY)
+        if legacy_raw is not None:
+            return legacy.load_legacy_rowgroup_indexes(legacy_raw)
         raise PetastormTpuError(
             'Dataset at {} has no row-group index. Run build_rowgroup_index first.'.format(dataset_url))
     spec = json.loads(raw.decode('utf-8'))

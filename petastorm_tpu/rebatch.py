@@ -88,9 +88,11 @@ def _concat_column(parts):
     to a 2-D array when a row group's lists are uniform-length but a 1-D object
     array otherwise (batch_worker._column_to_numpy) — mixed segments of one
     logical column must degrade to object rows instead of crashing concat."""
+    # same-rank, same-trailing-shape parts concatenate directly (including 1-D
+    # object arrays of bytes/decimals/ragged rows); only genuinely mixed
+    # layouts — 2-D uniform next to 1-D ragged, or differing widths — degrade
     uniform = (len({p.ndim for p in parts}) == 1 and
-               len({p.shape[1:] for p in parts}) == 1 and
-               not any(p.dtype == object for p in parts))
+               len({p.shape[1:] for p in parts}) == 1)
     if uniform:
         return np.concatenate(parts)
     rows = []

@@ -1,0 +1,261 @@
+"""Interop with datasets written by the ORIGINAL petastorm library.
+
+Strategy (no petastorm/pyspark installs): fake `petastorm.*` and
+`pyspark.sql.types` modules are synthesized with the reference's exact class
+layouts (unischema.py:46-80, codecs.py:54-231, etl/rowgroup_indexers.py:28-86),
+instances are pickled, the fakes are torn down, and our restricted unpickler
+must decode the bytes — then a real dataset whose _common_metadata carries only
+the reference's pickled keys must read end-to-end through make_reader.
+"""
+
+import pickle
+import sys
+import types
+from collections import OrderedDict, defaultdict
+from decimal import Decimal
+
+import numpy as np
+import pyarrow.parquet as pq
+import pytest
+
+from petastorm_tpu import codecs as our_codecs
+from petastorm_tpu.etl import legacy
+
+
+def _install_fake_reference_modules():
+    """Create sys.modules entries shaped like the reference's pickled classes."""
+    created = []
+
+    def module(name):
+        mod = types.ModuleType(name)
+        sys.modules[name] = mod
+        created.append(name)
+        return mod
+
+    pyspark = module('pyspark')
+    sql = module('pyspark.sql')
+    sql_types = module('pyspark.sql.types')
+    pyspark.sql = sql
+    sql.types = sql_types
+    for tname in ('ByteType', 'ShortType', 'IntegerType', 'LongType', 'FloatType',
+                  'DoubleType', 'BooleanType', 'StringType', 'BinaryType',
+                  'TimestampType', 'DateType'):
+        setattr(sql_types, tname, type(tname, (object,), {'__module__': 'pyspark.sql.types'}))
+
+    class DecimalType(object):
+        __module__ = 'pyspark.sql.types'
+
+        def __init__(self, precision=10, scale=0):
+            self.precision = precision
+            self.scale = scale
+    sql_types.DecimalType = DecimalType
+
+    petastorm = module('petastorm')
+    unischema_mod = module('petastorm.unischema')
+    codecs_mod = module('petastorm.codecs')
+    etl_mod = module('petastorm.etl')
+    indexers_mod = module('petastorm.etl.rowgroup_indexers')
+    petastorm.unischema = unischema_mod
+    petastorm.codecs = codecs_mod
+    petastorm.etl = etl_mod
+    etl_mod.rowgroup_indexers = indexers_mod
+
+    from collections import namedtuple
+
+    class UnischemaField(namedtuple('UnischemaField',
+                                    ['name', 'numpy_dtype', 'shape', 'codec', 'nullable'])):
+        __module__ = 'petastorm.unischema'
+    unischema_mod.UnischemaField = UnischemaField
+
+    class Unischema(object):
+        __module__ = 'petastorm.unischema'
+
+        def __init__(self, name, fields):
+            self._name = name
+            self._fields = OrderedDict((f.name, f) for f in sorted(fields, key=lambda t: t.name))
+            for f in fields:
+                if not hasattr(self, f.name):
+                    setattr(self, f.name, f)
+    unischema_mod.Unischema = Unischema
+
+    class ScalarCodec(object):
+        __module__ = 'petastorm.codecs'
+
+        def __init__(self, spark_type):
+            self._spark_type = spark_type
+
+    class NdarrayCodec(object):
+        __module__ = 'petastorm.codecs'
+
+    class CompressedNdarrayCodec(object):
+        __module__ = 'petastorm.codecs'
+
+    class CompressedImageCodec(object):
+        __module__ = 'petastorm.codecs'
+
+        def __init__(self, image_codec='png', quality=80):
+            self._image_codec = '.' + image_codec
+            self._quality = quality
+
+    codecs_mod.ScalarCodec = ScalarCodec
+    codecs_mod.NdarrayCodec = NdarrayCodec
+    codecs_mod.CompressedNdarrayCodec = CompressedNdarrayCodec
+    codecs_mod.CompressedImageCodec = CompressedImageCodec
+
+    class SingleFieldIndexer(object):
+        __module__ = 'petastorm.etl.rowgroup_indexers'
+
+        def __init__(self, index_name, index_field):
+            self._index_name = index_name
+            self._column_name = index_field
+            self._index_data = defaultdict(set)
+
+    class FieldNotNullIndexer(object):
+        __module__ = 'petastorm.etl.rowgroup_indexers'
+
+        def __init__(self, index_name, index_field):
+            self._index_name = index_name
+            self._column_name = index_field
+            self._index_data = set()
+
+    indexers_mod.SingleFieldIndexer = SingleFieldIndexer
+    indexers_mod.FieldNotNullIndexer = FieldNotNullIndexer
+
+    # classes are defined in a function: fix qualnames so pickle can resolve
+    # them through their (fake) modules
+    for cls in (UnischemaField, Unischema, ScalarCodec, NdarrayCodec,
+                CompressedNdarrayCodec, CompressedImageCodec,
+                SingleFieldIndexer, FieldNotNullIndexer, DecimalType):
+        cls.__qualname__ = cls.__name__
+
+    ns = dict(UnischemaField=UnischemaField, Unischema=Unischema,
+              ScalarCodec=ScalarCodec, NdarrayCodec=NdarrayCodec,
+              CompressedNdarrayCodec=CompressedNdarrayCodec,
+              CompressedImageCodec=CompressedImageCodec,
+              SingleFieldIndexer=SingleFieldIndexer,
+              FieldNotNullIndexer=FieldNotNullIndexer,
+              sql_types=sql_types)
+    return ns, created
+
+
+@pytest.fixture()
+def ref(request):
+    ns, created = _install_fake_reference_modules()
+
+    def teardown():
+        for name in created:
+            sys.modules.pop(name, None)
+    request.addfinalizer(teardown)
+    return types.SimpleNamespace(**ns)
+
+
+def _ref_schema_pickle(ref, protocol):
+    schema = ref.Unischema('LegacySchema', [
+        ref.UnischemaField('id', np.int64, (), ref.ScalarCodec(ref.sql_types.LongType()), False),
+        ref.UnischemaField('name', np.unicode_ if hasattr(np, 'unicode_') else np.str_, (),
+                           ref.ScalarCodec(ref.sql_types.StringType()), False),
+        ref.UnischemaField('image', np.uint8, (4, 6, 3), ref.CompressedImageCodec('jpeg', 55), False),
+        ref.UnischemaField('matrix', np.float32, (2, 3), ref.NdarrayCodec(), False),
+        ref.UnischemaField('packed', np.uint16, (None,), ref.CompressedNdarrayCodec(), True),
+        ref.UnischemaField('price', Decimal, (), ref.ScalarCodec(ref.sql_types.DecimalType(10, 2)), False),
+    ])
+    return pickle.dumps(schema, protocol=protocol)
+
+
+@pytest.mark.parametrize('protocol', [2, pickle.HIGHEST_PROTOCOL])
+def test_legacy_unischema_decodes(ref, protocol):
+    data = _ref_schema_pickle(ref, protocol)
+    schema = legacy.load_legacy_unischema(data)
+    assert schema.name == 'LegacySchema'
+    assert set(schema.fields) == {'id', 'name', 'image', 'matrix', 'packed', 'price'}
+    assert schema.fields['id'].numpy_dtype is np.int64
+    assert isinstance(schema.fields['id'].codec, our_codecs.ScalarCodec)
+    img = schema.fields['image'].codec
+    assert isinstance(img, our_codecs.CompressedImageCodec)
+    assert img._format == 'jpeg' and img._quality == 55
+    assert isinstance(schema.fields['matrix'].codec, our_codecs.NdarrayCodec)
+    assert isinstance(schema.fields['packed'].codec, our_codecs.CompressedNdarrayCodec)
+    assert schema.fields['packed'].nullable
+    assert schema.fields['packed'].shape == (None,)
+    assert schema.fields['price'].numpy_dtype is Decimal
+
+
+def test_legacy_row_group_counts(ref):
+    # the reference stores this key as JSON, not pickle (etl/dataset_metadata.py:226-228)
+    import json
+    data = json.dumps({'part-0.parquet': 3, 'part-1.parquet': 2}).encode('utf-8')
+    counts = legacy.load_legacy_row_group_counts(data)
+    assert counts == {'part-0.parquet': 3, 'part-1.parquet': 2}
+
+
+def test_legacy_rowgroup_indexes(ref):
+    single = ref.SingleFieldIndexer('by_name', 'name')
+    single._index_data['alice'].add(0)
+    single._index_data['bob'].update({1, 2})
+    notnull = ref.FieldNotNullIndexer('has_packed', 'packed')
+    notnull._index_data.update({0, 2})
+    data = pickle.dumps({'by_name': single, 'has_packed': notnull}, protocol=2)
+
+    indexes = legacy.load_legacy_rowgroup_indexes(data)
+    assert indexes['by_name'].get_row_group_indexes('alice') == {0}
+    assert indexes['by_name'].get_row_group_indexes('bob') == {1, 2}
+    assert set(indexes['has_packed'].get_row_group_indexes()) == {0, 2}
+
+
+def test_unpickler_refuses_arbitrary_classes(ref):
+    evil = pickle.dumps(types.SimpleNamespace(x=1), protocol=2)
+    with pytest.raises(pickle.UnpicklingError, match='Refusing to depickle'):
+        legacy.restricted_loads(evil)
+
+
+def test_unpickler_refuses_os_system():
+    # classic RCE payload shape: GLOBAL os.system + REDUCE
+    payload = b"cos\nsystem\np0\n(S'true'\np1\ntp2\nRp3\n."
+    with pytest.raises(pickle.UnpicklingError, match='Refusing to depickle'):
+        legacy.restricted_loads(payload)
+
+
+def test_legacy_dataset_reads_end_to_end(ref, tmp_path):
+    """A dataset carrying ONLY the reference's pickled metadata keys must read
+    through make_reader: schema from the legacy pickle, row-group counts from
+    the legacy counts dict, payloads via the wire-compatible codecs."""
+    from petastorm_tpu import make_reader
+    from petastorm_tpu.etl.dataset_metadata import write_petastorm_dataset
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+
+    # write payload files with our writer (byte-compatible formats)...
+    our_schema = Unischema('LegacySchema', [
+        UnischemaField('id', np.int64, (), our_codecs.ScalarCodec(), False),
+        UnischemaField('matrix', np.float32, (2, 3), our_codecs.NdarrayCodec(), False),
+    ])
+    url = 'file://' + str(tmp_path)
+    rows = [{'id': i, 'matrix': np.full((2, 3), i, dtype=np.float32)} for i in range(20)]
+    write_petastorm_dataset(url, our_schema, rows, rows_per_row_group=5)
+
+    # ...then REPLACE _common_metadata with reference-style pickled keys only
+    ref_schema_bytes = pickle.dumps(ref.Unischema('LegacySchema', [
+        ref.UnischemaField('id', np.int64, (), ref.ScalarCodec(ref.sql_types.LongType()), False),
+        ref.UnischemaField('matrix', np.float32, (2, 3), ref.NdarrayCodec(), False),
+    ]), protocol=2)
+    import pyarrow.fs as pafs
+    fs = pafs.LocalFileSystem()
+    files = [f.path for f in fs.get_file_info(pafs.FileSelector(str(tmp_path)))
+             if f.path.endswith('.parquet')]
+    counts = {}
+    for f in sorted(files):
+        counts[f.rsplit('/', 1)[1]] = pq.ParquetFile(f).metadata.num_row_groups
+    arrow_schema = pq.ParquetFile(sorted(files)[0]).schema_arrow
+    import json
+    arrow_schema = arrow_schema.with_metadata({
+        legacy.REF_UNISCHEMA_KEY: ref_schema_bytes,
+        # reference writes counts as JSON (etl/dataset_metadata.py:226-228)
+        legacy.REF_ROW_GROUPS_PER_FILE_KEY: json.dumps(counts).encode('utf-8'),
+    })
+    pq.write_metadata(arrow_schema, str(tmp_path / '_common_metadata'))
+
+    with make_reader(url, shuffle_row_groups=False, reader_pool_type='dummy') as reader:
+        out = list(reader)
+    assert len(out) == 20
+    ids = sorted(r.id for r in out)
+    assert ids == list(range(20))
+    np.testing.assert_array_equal(out[0].matrix, np.full((2, 3), out[0].id, dtype=np.float32))
