@@ -149,15 +149,27 @@ class ArrowBatchWorker(WorkerBase):
 
 class BatchResultsQueueReader(object):
     """Consumer-side: one namedtuple-of-arrays per published batch
-    (reference arrow_reader_worker.py:39-79, ``batched_output=True``)."""
+    (reference arrow_reader_worker.py:39-79, ``batched_output=True``).
+
+    Checkpoint support: a batch counts as delivered the moment ``read_next``
+    returns it (see row_worker.RowResultsQueueReader)."""
 
     def __init__(self, schema):
         self._schema = schema
+        self.delivered_callback = None
 
     @property
     def batched_output(self):
         return True
 
+    def on_item_done(self, seq):
+        # covers items that published nothing (e.g. fully predicate-filtered)
+        if self.delivered_callback is not None:
+            self.delivered_callback(seq)
+
     def read_next(self, pool):
         batch = pool.get_results()
+        seq = getattr(pool, 'last_result_seq', None)
+        if seq is not None and self.delivered_callback is not None:
+            self.delivered_callback(seq)
         return self._schema.make_namedtuple(**batch)

@@ -79,7 +79,8 @@ def make_reader(dataset_url,
                 cache_type='null', cache_location=None, cache_size_limit=None,
                 cache_row_size_estimate=None,
                 transform_spec=None,
-                ngram=None):
+                ngram=None,
+                resume_state=None):
     """Reader for datasets written by :func:`materialize_dataset` — rows decoded
     through the stored Unischema's codecs (reference reader.py:50-174).
 
@@ -97,6 +98,8 @@ def make_reader(dataset_url,
         ``index % shard_count == cur_shard``
     :param cache_type/...: 'null' or 'local-disk' row-group cache
     :param ngram: :class:`petastorm_tpu.ngram.NGram` for windowed sequence readout
+    :param resume_state: dict from :meth:`Reader.state_dict` — continue reading
+        from a checkpointed position (construct with otherwise-identical args)
     """
     try:
         schema = dataset_metadata.get_schema(dataset_url)
@@ -116,7 +119,8 @@ def make_reader(dataset_url,
                   shuffle_row_drop_partitions=shuffle_row_drop_partitions,
                   predicate=predicate, rowgroup_selector=rowgroup_selector,
                   num_epochs=num_epochs, cur_shard=cur_shard, shard_count=shard_count,
-                  cache=cache, transform_spec=transform_spec, ngram=ngram)
+                  cache=cache, transform_spec=transform_spec, ngram=ngram,
+                  resume_state=resume_state)
 
 
 def make_batch_reader(dataset_url,
@@ -130,7 +134,8 @@ def make_batch_reader(dataset_url,
                       cache_type='null', cache_location=None, cache_size_limit=None,
                       cache_row_size_estimate=None,
                       transform_spec=None,
-                      batch_size=None, drop_last=False):
+                      batch_size=None, drop_last=False,
+                      resume_state=None):
     """Columnar reader for ANY Parquet store (reference reader.py:177-289):
     yields one namedtuple of numpy column arrays per row group
     (``batched_output=True``). Schema is inferred from the Arrow schema unless
@@ -162,7 +167,8 @@ def make_batch_reader(dataset_url,
                   shuffle_row_drop_partitions=shuffle_row_drop_partitions,
                   predicate=predicate, rowgroup_selector=None,
                   num_epochs=num_epochs, cur_shard=cur_shard, shard_count=shard_count,
-                  cache=cache, transform_spec=transform_spec, ngram=None)
+                  cache=cache, transform_spec=transform_spec, ngram=None,
+                  resume_state=resume_state)
 
 
 class Reader(object):
@@ -173,7 +179,7 @@ class Reader(object):
                  schema_fields=None, seed=None, shuffle_row_groups=True,
                  shuffle_row_drop_partitions=1, predicate=None, rowgroup_selector=None,
                  num_epochs=1, cur_shard=None, shard_count=None, cache=None,
-                 transform_spec=None, ngram=None):
+                 transform_spec=None, ngram=None, resume_state=None):
         if (cur_shard is None) != (shard_count is None):
             raise ValueError('cur_shard and shard_count must be specified together')
         if cur_shard is not None and not 0 <= cur_shard < shard_count:
@@ -231,10 +237,14 @@ class Reader(object):
                 if shuffle_row_drop_partitions > 1:
                     item['shuffle_row_drop_partition'] = (drop_part, shuffle_row_drop_partitions)
                 items.append(item)
+        if resume_state is not None:
+            self._validate_resume_state(resume_state, dataset_url, len(pieces), len(items))
+        self._num_items = len(items)
         self._ventilator = ConcurrentVentilator(
             pool.ventilate, items, iterations=num_epochs,
             max_ventilation_queue_size=pool.workers_count + _VENTILATE_EXTRA_ROWGROUPS,
-            randomize_item_order=shuffle_row_groups, random_seed=seed)
+            randomize_item_order=shuffle_row_groups, random_seed=seed, tag_items=True,
+            resume_state=resume_state['ventilator'] if resume_state is not None else None)
 
         worker_args = {
             'dataset_path': self._dataset_path,
@@ -249,6 +259,14 @@ class Reader(object):
         }
         self._pool = pool
         self._results_queue_reader = results_queue_reader_factory(self.transformed_schema)
+        # checkpoint wiring (before pool.start — items may flow immediately):
+        # the results-queue reader marks items delivered as their last row is
+        # yielded; completion sentinels cover items that published no rows
+        rqr = self._results_queue_reader
+        if hasattr(rqr, 'delivered_callback'):
+            rqr.delivered_callback = self._ventilator.mark_delivered
+        if hasattr(rqr, 'on_item_done') and hasattr(pool, 'done_callback'):
+            pool.done_callback = rqr.on_item_done
         self.last_row_consumed = False
         self._stopped = False
         pool.start(worker_class, worker_args, ventilator=self._ventilator)
@@ -306,6 +324,46 @@ class Reader(object):
             raise StopIteration
 
     next = __next__
+
+    # -- checkpoint / resume ------------------------------------------------
+
+    @staticmethod
+    def _validate_resume_state(state, dataset_url, num_pieces, num_items):
+        if not isinstance(state, dict) or state.get('version') != 1:
+            raise ValueError('Unrecognized resume_state (expected a dict produced by '
+                             'Reader.state_dict())')
+        if state.get('num_pieces') != num_pieces or state.get('num_items') != num_items:
+            raise ValueError(
+                'resume_state does not match this reader: it was taken over {} pieces / {} work '
+                'items, but this reader selected {} / {}. Construct the resumed reader with the '
+                'same arguments (dataset, predicate, selector, sharding, '
+                'shuffle_row_drop_partitions) as the checkpointed one.'.format(
+                    state.get('num_pieces'), state.get('num_items'), num_pieces, num_items))
+        if state.get('dataset_url') != dataset_url:
+            warnings.warn('resume_state was taken from {} but this reader opens {}; resuming '
+                          'anyway since piece counts match (dataset may have moved)'.format(
+                              state.get('dataset_url'), dataset_url))
+
+    def state_dict(self):
+        """Snapshot the read position (picklable dict). Pass it as
+        ``resume_state=`` to :func:`make_reader`/:func:`make_batch_reader`
+        (called with otherwise-identical arguments) to continue reading where
+        this reader left off — a capability the reference lacks entirely
+        (SURVEY.md §5: "No checkpoint/resume of read state").
+
+        Granularity is one row group: groups whose rows were all yielded are
+        never re-read; groups in flight (including one partially yielded) are
+        re-read in full on resume. At an epoch boundary the resume is exact.
+        Remaining epochs re-shuffle from the checkpointed RNG state, so seeded
+        runs produce the same row-group order they would have without the
+        interruption."""
+        return {
+            'version': 1,
+            'dataset_url': self._dataset_url,
+            'num_pieces': len(self._pieces),
+            'num_items': self._num_items,
+            'ventilator': self._ventilator.state_dict(),
+        }
 
     def reset(self):
         """Re-read the dataset for another ``num_epochs`` pass. Only valid after

@@ -32,22 +32,32 @@ class BatchingColumnQueue(object):
         if batch_size < 1:
             raise ValueError('batch_size must be >= 1, got {}'.format(batch_size))
         self._batch_size = batch_size
-        self._segments = deque()  # dicts of column arrays
+        self._segments = deque()  # (dict of column arrays, tag)
         self._head = 0  # rows of the head segment already consumed
         self._buffered = 0
+        self._drained_tags = []  # tags of segments fully consumed by _take
 
     def __len__(self):
         return self._buffered
 
-    def put(self, batch):
+    def put(self, batch, tag=None):
+        """``tag``: opaque id returned via :meth:`pop_drained_tags` once every
+        row of this batch has left the queue (checkpoint bookkeeping)."""
         lengths = {len(v) for v in batch.values()}
         if len(lengths) != 1:
             raise ValueError('ragged batch: column lengths {}'.format(sorted(lengths)))
         n = lengths.pop()
         if n == 0:
+            if tag is not None:
+                self._drained_tags.append(tag)
             return
-        self._segments.append(batch)
+        self._segments.append((batch, tag))
         self._buffered += n
+
+    def pop_drained_tags(self):
+        """Tags of segments whose rows have all been taken since the last call."""
+        tags, self._drained_tags = self._drained_tags, []
+        return tags
 
     def empty(self):
         """True when a full ``batch_size`` batch cannot be produced yet."""
@@ -68,7 +78,7 @@ class BatchingColumnQueue(object):
         parts = []  # list of dict-of-views
         taken = 0
         while taken < count:
-            head = self._segments[0]
+            head, tag = self._segments[0]
             head_len = len(next(iter(head.values())))
             take = min(count - taken, head_len - self._head)
             parts.append({k: v[self._head:self._head + take] for k, v in head.items()})
@@ -77,6 +87,8 @@ class BatchingColumnQueue(object):
             if self._head == head_len:
                 self._segments.popleft()
                 self._head = 0
+                if tag is not None:
+                    self._drained_tags.append(tag)
         self._buffered -= count
         if len(parts) == 1:
             return parts[0]
@@ -117,10 +129,25 @@ class RebatchingResultsQueueReader(object):
         self._queue = BatchingColumnQueue(batch_size)
         self._drop_last = drop_last
         self._exhausted = False
+        self._open_seqs = set()  # items with rows still buffered in the queue
+        self.delivered_callback = None
 
     @property
     def batched_output(self):
         return True
+
+    def on_item_done(self, seq):
+        """An item whose rows are still buffered is delivered only when they
+        drain into a yielded batch; an item never seen (published no rows) is
+        delivered now."""
+        if seq not in self._open_seqs and self.delivered_callback is not None:
+            self.delivered_callback(seq)
+
+    def _mark_drained(self):
+        for seq in self._queue.pop_drained_tags():
+            self._open_seqs.discard(seq)
+            if self.delivered_callback is not None:
+                self.delivered_callback(seq)
 
     def read_next(self, pool):
         while self._queue.empty():
@@ -129,12 +156,24 @@ class RebatchingResultsQueueReader(object):
                 remainder = self._queue.drain()
                 if self._drop_last:
                     remainder = None  # discard, so reset() starts a clean pass
+                    # dropped rows are NOT delivered: a checkpoint taken now
+                    # re-reads their row groups on resume instead of losing them
+                    for tag in self._queue.pop_drained_tags():
+                        self._open_seqs.discard(tag)
+                else:
+                    self._mark_drained()
                 self._exhausted = False  # re-arm for reset()/next epoch
                 if remainder is None:
                     raise self._empty_result_error()
                 return self._schema.make_namedtuple(**remainder)
             try:
-                self._queue.put(pool.get_results())
+                batch = pool.get_results()
+                seq = getattr(pool, 'last_result_seq', None)
+                if seq is not None:
+                    self._open_seqs.add(seq)
+                self._queue.put(batch, tag=seq)
             except self._empty_result_error:
                 self._exhausted = True
-        return self._schema.make_namedtuple(**self._queue.get())
+        out = self._queue.get()
+        self._mark_drained()
+        return self._schema.make_namedtuple(**out)

@@ -194,22 +194,44 @@ class RowGroupDecoderWorker(WorkerBase):
 
 class RowResultsQueueReader(object):
     """Consumer-side: converts published row-dict chunks into schema namedtuples,
-    one row per ``read_next`` call (reference py_dict_reader_worker.py:64-97)."""
+    one row per ``read_next`` call (reference py_dict_reader_worker.py:64-97).
+
+    Checkpoint support: each buffered chunk remembers the seq of the item it
+    came from; when the chunk's last row is yielded, ``delivered_callback(seq)``
+    fires (→ ``ventilator.mark_delivered``), so a :meth:`Reader.state_dict`
+    snapshot never counts partially-yielded row groups as consumed."""
 
     def __init__(self, schema, ngram=None):
         self._schema = schema
         self._ngram = ngram
         self._buffer = deque()
+        self._spans = deque()  # [seq, rows_remaining] per buffered chunk
+        self.delivered_callback = None
 
     @property
     def batched_output(self):
         return False
 
+    def on_item_done(self, seq):
+        """Pool completion sentinel consumed for ``seq``. Sentinels are only
+        consumed when the buffer is empty (all prior rows yielded), so this can
+        only fire for items already drained — or items that produced no rows —
+        and marking delivered is safe in both cases."""
+        if self.delivered_callback is not None:
+            self.delivered_callback(seq)
+
     def read_next(self, pool):
         while not self._buffer:
             rows = pool.get_results()  # raises EmptyResultError at end of epoch
             self._buffer.extend(rows)
+            self._spans.append([getattr(pool, 'last_result_seq', None), len(rows)])
         row = self._buffer.popleft()
+        span = self._spans[0]
+        span[1] -= 1
+        if span[1] == 0:
+            self._spans.popleft()
+            if span[0] is not None and self.delivered_callback is not None:
+                self.delivered_callback(span[0])
         if self._ngram is not None:
             return self._ngram.make_namedtuple(self._schema, row)
         return self._schema.make_namedtuple(**row)

@@ -11,25 +11,36 @@ from collections import deque
 from petastorm_tpu.workers.worker_base import EmptyResultError
 
 
+_DATA, _DONE = 0, 1
+
+
 class DummyPool(object):
     def __init__(self, workers_count=1, results_queue_size=None):
-        self._results = deque()
+        self._results = deque()  # (_DATA, seq, payload) | (_DONE, seq, None)
         self._worker = None
         self._ventilator = None
         self._worker_error = None
+        self._current_seq = None
         self.workers_count = workers_count
+        # checkpoint plumbing (see thread_pool.py)
+        self.last_result_seq = None
+        self.done_callback = None
 
     def start(self, worker_class, worker_setup_args=None, ventilator=None):
         if self._worker is not None:
             raise RuntimeError('Pool already started')
-        self._worker = worker_class(0, self._results.append, worker_setup_args)
+        self._worker = worker_class(
+            0, lambda data: self._results.append((_DATA, self._current_seq, data)),
+            worker_setup_args)
         if ventilator is not None:
             self._ventilator = ventilator
             self._ventilator.start()
 
     def ventilate(self, *args, **kwargs):
+        self._current_seq = kwargs.pop('_seq', None)
         try:
             self._worker.process(*args, **kwargs)
+            self._results.append((_DONE, self._current_seq, None))
         except Exception as e:  # noqa: BLE001 - forwarded to the consumer, like
             # ThreadPool/ProcessPool do; without this a ventilator-thread failure
             # would leave get_results() spinning forever
@@ -41,24 +52,39 @@ class DummyPool(object):
             if self._ventilator is not None:
                 self._ventilator.processed_item()
 
+    def _pop_ready(self):
+        """Pop queued entries until a payload is found; process completion
+        sentinels on the way. Returns the payload or None."""
+        while self._results:
+            kind, seq, payload = self._results.popleft()
+            if kind == _DATA:
+                self.last_result_seq = seq
+                return payload
+            if seq is not None and self.done_callback is not None:
+                self.done_callback(seq)
+        return None
+
     def get_results(self):
         # give a lazy ventilator thread a chance to feed us before declaring empty
         import time
-        while not self._results:
+        while True:
+            payload = self._pop_ready()
+            if payload is not None:
+                return payload
             if self._worker_error is not None:
                 error, self._worker_error = self._worker_error, None
                 raise error
             if self._ventilator is None or self._ventilator.completed():
                 # re-check: the ventilator may have appended a result between the
                 # emptiness check and completed() flipping true
-                if self._results:
-                    break
+                payload = self._pop_ready()
+                if payload is not None:
+                    return payload
                 if self._worker_error is not None:
                     error, self._worker_error = self._worker_error, None
                     raise error
                 raise EmptyResultError()
             time.sleep(0.001)
-        return self._results.popleft()
 
     def stop(self):
         if self._ventilator is not None:

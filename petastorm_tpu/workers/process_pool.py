@@ -57,6 +57,9 @@ class ProcessPool(object):
         self._completed_items = 0
         self._stopped = False
         self._ipc_dir = None
+        # checkpoint plumbing (see thread_pool.py): messages carry the item seq
+        self.last_result_seq = None
+        self.done_callback = None
 
     @property
     def workers_count(self):
@@ -105,7 +108,7 @@ class ProcessPool(object):
                     'Only {} of {} workers started within {}s'.format(
                         started, self._workers_count, _WORKER_STARTUP_TIMEOUT_S))
             if self._results_receive.poll(100):
-                kind, _ = self._results_receive.recv_multipart()
+                kind = self._results_receive.recv_multipart()[0]
                 if kind == _STARTED:
                     started += 1
 
@@ -129,13 +132,17 @@ class ProcessPool(object):
                         'No results from worker processes in {}s; {} items in flight'.format(
                             timeout_s, self._ventilated_items - self._completed_items))
                 continue
-            kind, payload = self._results_receive.recv_multipart()
+            kind, seq_bytes, payload = self._results_receive.recv_multipart()
+            seq = int(seq_bytes) if seq_bytes else None
             if kind == _DATA:
+                self.last_result_seq = seq
                 return self._serializer.deserialize(payload)
             elif kind == _DONE:
                 self._completed_items += 1
                 if self._ventilator is not None:
                     self._ventilator.processed_item()
+                if seq is not None and self.done_callback is not None:
+                    self.done_callback(seq)
             elif kind == _ERROR:
                 raise pickle.loads(payload)
             # late _STARTED messages are ignored
@@ -215,11 +222,13 @@ def _worker_bootstrap(worker_id, main_pid, setup_blob, vent_addr, result_addr, c
     poller.register(vent_recv, zmq.POLLIN)
     poller.register(control_recv, zmq.POLLIN)
 
+    current = {'seq': b''}  # seq of the item being processed, for publish tagging
+
     def publish(data):
-        result_send.send_multipart([_DATA, serializer.serialize(data)])
+        result_send.send_multipart([_DATA, current['seq'], serializer.serialize(data)])
 
     worker = worker_class(worker_id, publish, worker_setup_args)
-    result_send.send_multipart([_STARTED, b''])
+    result_send.send_multipart([_STARTED, b'', b''])
 
     try:
         while True:
@@ -229,9 +238,11 @@ def _worker_bootstrap(worker_id, main_pid, setup_blob, vent_addr, result_addr, c
                     break
             if vent_recv in events:
                 args, kwargs = vent_recv.recv_pyobj()
+                seq = kwargs.pop('_seq', None)
+                current['seq'] = b'' if seq is None else str(seq).encode()
                 try:
                     worker.process(*args, **kwargs)
-                    result_send.send_multipart([_DONE, b''])
+                    result_send.send_multipart([_DONE, current['seq'], b''])
                 except Exception:  # noqa: BLE001 - forwarded to the main process
                     exc = sys.exc_info()[1]
                     logger.exception('Worker %d failed', worker_id)
@@ -239,8 +250,10 @@ def _worker_bootstrap(worker_id, main_pid, setup_blob, vent_addr, result_addr, c
                         blob = pickle.dumps(exc)
                     except Exception:  # unpicklable exception: forward a summary
                         blob = pickle.dumps(RuntimeError('{}: {}'.format(type(exc).__name__, exc)))
-                    result_send.send_multipart([_ERROR, blob])
-                    result_send.send_multipart([_DONE, b''])
+                    result_send.send_multipart([_ERROR, b'', blob])
+                    # seq-less sentinel: the failed item stays undelivered so a
+                    # checkpoint re-reads it (see thread_pool.py)
+                    result_send.send_multipart([_DONE, b'', b''])
     finally:
         worker.shutdown()
         for sock in (vent_recv, result_send, control_recv):

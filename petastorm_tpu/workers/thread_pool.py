@@ -38,6 +38,12 @@ class ThreadPool(object):
         self._ventilated_items = 0
         self._completed_items = 0
         self._counter_lock = threading.Lock()
+        self._tls = threading.local()  # per-worker-thread current item seq
+        # checkpoint plumbing: seq of the payload last returned by get_results,
+        # and an optional callback fired when an item's completion sentinel is
+        # consumed (used by results-queue readers to mark empty items delivered)
+        self.last_result_seq = None
+        self.done_callback = None
 
     @property
     def workers_count(self):
@@ -56,35 +62,39 @@ class ThreadPool(object):
             self._ventilator.start()
 
     def ventilate(self, *args, **kwargs):
+        seq = kwargs.pop('_seq', None)
         with self._counter_lock:
             self._ventilated_items += 1
-        self._task_queue.put((args, kwargs))
+        self._task_queue.put((seq, args, kwargs))
 
     def get_results(self):
         """Block until a result is available; raise :class:`EmptyResultError` when
         all ventilated items are processed and no more will be ventilated."""
         while True:
             try:
-                kind, payload = self._results_queue.get(block=False)
+                kind, seq, payload = self._results_queue.get(block=False)
             except queue.Empty:
                 if self._all_done():
                     raise EmptyResultError()
                 try:
-                    kind, payload = self._results_queue.get(timeout=0.05)
+                    kind, seq, payload = self._results_queue.get(timeout=0.05)
                 except queue.Empty:
                     continue
             if kind == _DATA:
+                self.last_result_seq = seq
                 return payload
             elif kind == _DONE:
-                self._count_completed()
+                self._count_completed(seq)
             else:  # _ERROR
                 raise payload
 
-    def _count_completed(self):
+    def _count_completed(self, seq=None):
         with self._counter_lock:
             self._completed_items += 1
         if self._ventilator is not None:
             self._ventilator.processed_item()
+        if seq is not None and self.done_callback is not None:
+            self.done_callback(seq)
 
     def _all_done(self):
         with self._counter_lock:
@@ -128,7 +138,7 @@ class ThreadPool(object):
     # -- worker side --------------------------------------------------------
 
     def _publish(self, data):
-        self._stop_aware_put((_DATA, data))
+        self._stop_aware_put((_DATA, getattr(self._tls, 'seq', None), data))
 
     def _stop_aware_put(self, item):
         """Bounded put that aborts when the pool is stopping, so workers never
@@ -149,9 +159,10 @@ class ThreadPool(object):
         try:
             while not self._stop_event.is_set():
                 try:
-                    args, kwargs = self._task_queue.get(timeout=0.05)
+                    seq, args, kwargs = self._task_queue.get(timeout=0.05)
                 except queue.Empty:
                     continue
+                self._tls.seq = seq
                 try:
                     if profiler is not None:
                         profiler.enable()
@@ -160,15 +171,17 @@ class ThreadPool(object):
                     finally:
                         if profiler is not None:
                             profiler.disable()
-                    self._stop_aware_put((_DONE, None))
+                    self._stop_aware_put((_DONE, seq, None))
                 except WorkerTerminationRequested:
                     return
                 except Exception:  # noqa: BLE001 - forwarded to consumer
                     exc = sys.exc_info()[1]
                     logger.exception('Worker %d failed processing an item', worker.worker_id)
                     try:
-                        self._stop_aware_put((_ERROR, exc))
-                        self._stop_aware_put((_DONE, None))
+                        self._stop_aware_put((_ERROR, None, exc))
+                        # seq-less sentinel: flow control counts the item but it is
+                        # NOT marked delivered — a checkpoint will re-read it
+                        self._stop_aware_put((_DONE, None, None))
                     except WorkerTerminationRequested:
                         return
         finally:

@@ -5,14 +5,22 @@ Parity: /root/reference/petastorm/workers_pool/ventilator.py:55-166
 via processed-item callbacks, per-epoch reshuffle, ``iterations=None`` infinite
 epochs, ``completed()``/``reset()``).
 
-Improvement over the reference (SURVEY.md §5 checkpoint gap): the reshuffle RNG
-is seedable, making epoch order reproducible when ``random_seed`` is given.
+Improvements over the reference (SURVEY.md §5 checkpoint/reproducibility gaps):
+  * the reshuffle RNG is seedable, making epoch order reproducible;
+  * read-position checkpointing: every ventilated item carries a ``_seq`` tag,
+    the ventilator keeps the set of items not yet *delivered* to the consumer
+    (the pool's results-queue reader calls :meth:`mark_delivered` when an item's
+    last row is yielded), and :meth:`state_dict`/``resume_state`` capture and
+    restore the exact read position — undelivered items plus the unventilated
+    tail of the current epoch replay first, then remaining epochs continue from
+    the saved RNG state.
 """
 
 from __future__ import annotations
 
 import logging
 import threading
+from collections import OrderedDict
 
 import numpy as np
 
@@ -44,26 +52,64 @@ class ConcurrentVentilator(VentilatorBase):
         items; defaults to ``len(items_to_ventilate)``
     :param randomize_item_order: reshuffle item order before each epoch
     :param random_seed: seed for the reshuffle RNG (``None`` = nondeterministic)
+    :param tag_items: ventilate items with a ``_seq`` kwarg and track delivery
+        for checkpointing. Requires ``ventilate_fn`` to understand ``_seq``
+        (the worker pools do; plain callables need not). Off by default so the
+        standalone ventilate protocol matches the reference's.
+    :param resume_state: a dict previously returned by :meth:`state_dict`.
+        When given, ``iterations`` is ignored: the saved replay item indices
+        are ventilated first (in their original order, no reshuffle), then the
+        saved number of remaining epochs run with the saved RNG state.
+        ``items_to_ventilate`` must be the same list the state was taken over.
     """
 
     def __init__(self, ventilate_fn, items_to_ventilate, iterations=1,
                  max_ventilation_queue_size=None, randomize_item_order=False,
-                 random_seed=None):
+                 random_seed=None, tag_items=False, resume_state=None):
         if iterations is not None and (not isinstance(iterations, int) or iterations < 1):
             raise ValueError('iterations must be a positive integer or None, got {!r}'.format(iterations))
         self._ventilate_fn = ventilate_fn
         self._items_to_ventilate = list(items_to_ventilate)
-        self._iterations_remaining = iterations
-        self._max_ventilation_queue_size = (max_ventilation_queue_size
-                                            if max_ventilation_queue_size is not None
-                                            else max(1, len(self._items_to_ventilate)))
+        self._requested_iterations = iterations
+        self._tag_items = tag_items
+        if resume_state is not None and not tag_items:
+            raise ValueError('resume_state requires tag_items=True')
         self._randomize_item_order = randomize_item_order
         self._rng = np.random.default_rng(random_seed)
 
+        if resume_state is not None:
+            self._replay_indices = list(resume_state['replay_indices'])
+            bad = [i for i in self._replay_indices
+                   if not 0 <= i < len(self._items_to_ventilate)]
+            if bad:
+                raise ValueError('resume_state replay indices {} out of range for {} work '
+                                 'items'.format(bad, len(self._items_to_ventilate)))
+            self._iterations_remaining = resume_state['iterations_remaining']
+            if resume_state.get('rng_state') is not None:
+                self._rng.bit_generator.state = resume_state['rng_state']
+        else:
+            self._replay_indices = None
+            self._iterations_remaining = iterations
+
+        self._max_ventilation_queue_size = (max_ventilation_queue_size
+                                            if max_ventilation_queue_size is not None
+                                            else max(1, len(self._items_to_ventilate)))
+
         self._in_flight = 0
         self._in_flight_cv = threading.Condition()
+        # checkpoint bookkeeping — all guarded by _in_flight_cv's lock. Items
+        # are tracked by their index into items_to_ventilate, so state dicts
+        # stay small and picklable regardless of item contents (predicates
+        # may hold lambdas).
+        self._seq = 0
+        self._undelivered = OrderedDict()  # seq -> item index (ventilated, not delivered)
+        self._epoch_indices = []           # current pass, post-shuffle item indices
+        self._epoch_pos = 0                # next position of _epoch_indices to ventilate
+        self._epochs_after_current = self._iterations_remaining
+
         self._stop_requested = False
-        self._completed = len(self._items_to_ventilate) == 0
+        self._completed = (len(self._items_to_ventilate) == 0
+                           and not self._replay_indices)
         self._thread = None
 
     def start(self):
@@ -81,6 +127,33 @@ class ConcurrentVentilator(VentilatorBase):
             self._in_flight -= 1
             self._in_flight_cv.notify()
 
+    def mark_delivered(self, seq):
+        """Called by the consumer when the item ventilated with ``_seq == seq``
+        has been fully delivered (its last row yielded to the user, or it
+        produced no rows). Idempotent; unknown/None seqs are ignored."""
+        if seq is None:
+            return
+        with self._in_flight_cv:
+            self._undelivered.pop(seq, None)
+
+    def state_dict(self):
+        """Snapshot of the read position, suitable for pickling. Resuming from
+        it re-ventilates every item not fully delivered at snapshot time (so
+        in-flight row groups are re-read in full), then the unventilated tail
+        of the current epoch, then the remaining epochs with the RNG state
+        restored (seeded runs continue their original shuffle stream)."""
+        if not self._tag_items:
+            raise RuntimeError('state_dict() requires tag_items=True (delivery is not tracked '
+                               'otherwise, so the read position is unknown)')
+        with self._in_flight_cv:
+            replay = list(self._undelivered.values())
+            replay += self._epoch_indices[self._epoch_pos:]
+            return {
+                'replay_indices': replay,
+                'iterations_remaining': self._epochs_after_current,
+                'rng_state': self._rng.bit_generator.state,
+            }
+
     def completed(self):
         """True when no more items will ever be ventilated."""
         return self._completed
@@ -93,11 +166,17 @@ class ConcurrentVentilator(VentilatorBase):
             raise RuntimeError('Cannot reset ventilator while ventilation is still in progress')
         if self._thread is not None:
             self._thread.join()
+        self._replay_indices = None
+        self._iterations_remaining = self._requested_iterations
         self._completed = len(self._items_to_ventilate) == 0
         self._stop_requested = False
         self._thread = None
         with self._in_flight_cv:
             self._in_flight = 0
+            self._undelivered.clear()
+            self._epoch_indices = []
+            self._epoch_pos = 0
+            self._epochs_after_current = self._requested_iterations
         self.start()
 
     def stop(self):
@@ -109,12 +188,30 @@ class ConcurrentVentilator(VentilatorBase):
         self._completed = True
 
     def _ventilate_loop(self):
-        items = list(self._items_to_ventilate)
+        first_pass = True
         while not self._stop_requested:
-            if self._randomize_item_order:
-                order = self._rng.permutation(len(items))
-                items = [items[i] for i in order]
-            for item in items:
+            with self._in_flight_cv:
+                if first_pass and self._replay_indices is not None:
+                    # resumed run: replay saved items verbatim; does not consume
+                    # an iteration (it is the remainder of an interrupted epoch)
+                    epoch_indices = list(self._replay_indices)
+                    counted = False
+                else:
+                    if self._iterations_remaining is not None and self._iterations_remaining <= 0:
+                        break
+                    epoch_indices = list(range(len(self._items_to_ventilate)))
+                    if self._randomize_item_order:
+                        epoch_indices = [int(i) for i in self._rng.permutation(len(epoch_indices))]
+                    counted = True
+                self._epoch_indices = epoch_indices
+                self._epoch_pos = 0
+                if counted and self._iterations_remaining is not None:
+                    self._epochs_after_current = self._iterations_remaining - 1
+                else:
+                    self._epochs_after_current = self._iterations_remaining
+            first_pass = False
+
+            for index in epoch_indices:
                 with self._in_flight_cv:
                     while (self._in_flight >= self._max_ventilation_queue_size
                            and not self._stop_requested):
@@ -122,9 +219,18 @@ class ConcurrentVentilator(VentilatorBase):
                     if self._stop_requested:
                         return
                     self._in_flight += 1
-                self._ventilate_fn(**item)
-            if self._iterations_remaining is not None:
-                self._iterations_remaining -= 1
-                if self._iterations_remaining <= 0:
-                    break
+                    self._epoch_pos += 1
+                    if self._tag_items:
+                        seq = self._seq
+                        self._seq += 1
+                        self._undelivered[seq] = index
+                item = self._items_to_ventilate[index]
+                if self._tag_items:
+                    self._ventilate_fn(**dict(item, _seq=seq))
+                else:
+                    self._ventilate_fn(**item)
+
+            with self._in_flight_cv:
+                if counted and self._iterations_remaining is not None:
+                    self._iterations_remaining -= 1
         self._completed = True
