@@ -19,6 +19,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from petastorm_tpu import TransformSpec, make_reader
+from petastorm_tpu import ops
 from petastorm_tpu.jax import JaxDataLoader
 from petastorm_tpu.models import resnet50
 from petastorm_tpu.models.train import (create_train_state, make_train_step,
@@ -27,7 +28,15 @@ from petastorm_tpu.parallel import data_sharding, make_mesh
 from petastorm_tpu.unischema import UnischemaField
 
 
+# per-channel ImageNet stats in 0-255 units (normalization happens on device)
+IMAGENET_MEAN = np.array([123.675, 116.28, 103.53], np.float32)
+IMAGENET_STD = np.array([58.395, 57.12, 57.375], np.float32)
+
+
 def make_transform(image_size, num_classes):
+    """Host side: resize only, output stays uint8 — 4x fewer bytes over PCIe
+    than the float path; cast/normalize/flip run on device inside the train
+    step (petastorm_tpu.ops)."""
     def _transform_row(row):
         import cv2
         image = cv2.resize(row['image'], (image_size, image_size),
@@ -35,14 +44,21 @@ def make_transform(image_size, num_classes):
         # crc32, not hash(): labels must agree across hosts/processes
         # (PYTHONHASHSEED randomizes hash() per interpreter)
         label = zlib.crc32(str(row['noun_id']).encode()) % num_classes
-        return {'image': image.astype(np.float32) / 255.0, 'label': label}
+        return {'image': image, 'label': label}
 
     return TransformSpec(
         _transform_row,
         edit_fields=[
-            UnischemaField('image', np.float32, (image_size, image_size, 3), None, False),
+            UnischemaField('image', np.uint8, (image_size, image_size, 3), None, False),
             UnischemaField('label', np.int64, (), None, False)],
         removed_fields=['noun_id', 'text'])
+
+
+def device_preprocess(images, rng):
+    """Fused on-device input ops: random flip + uint8->bf16 normalize."""
+    images = ops.random_flip(images, rng)
+    return ops.normalize_images(images, IMAGENET_MEAN, IMAGENET_STD,
+                                out_dtype=jnp.bfloat16)
 
 
 def train(dataset_url, batch_size=64, steps=100, image_size=160, num_classes=1000,
@@ -60,7 +76,8 @@ def train(dataset_url, batch_size=64, steps=100, image_size=160, num_classes=100
 
     with mesh:
         state = shard_train_state(state, mesh)
-        train_step = make_train_step()
+        train_step = make_train_step(preprocess_fn=device_preprocess,
+                                     preprocess_seed=seed)
         with make_reader(dataset_url, num_epochs=None, seed=seed,
                          shuffle_row_groups=True,
                          transform_spec=make_transform(image_size, num_classes),

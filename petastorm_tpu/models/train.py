@@ -73,12 +73,22 @@ def cross_entropy_loss(logits, labels):
     return optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
 
 
-def make_train_step(donate=True):
+def make_train_step(donate=True, preprocess_fn=None, preprocess_seed=0):
     """Jitted (state, images, labels) -> (state, metrics). Sharding follows the
     arguments' placement (shard the state with :func:`shard_train_state` and the
-    batch with a ``data`` NamedSharding); XLA inserts the collectives."""
+    batch with a ``data`` NamedSharding); XLA inserts the collectives.
+
+    ``preprocess_fn(images, rng) -> images`` runs INSIDE the jitted step —
+    device-side input ops (petastorm_tpu.ops normalize/augment) fuse with the
+    forward pass, so the host can ship compact uint8 batches. ``rng`` is folded
+    from ``preprocess_seed`` and the step counter: augmentation varies per step
+    but is reproducible."""
 
     def train_step(state, images, labels):
+        if preprocess_fn is not None:
+            rng = jax.random.fold_in(jax.random.key(preprocess_seed), state.step)
+            images = preprocess_fn(images, rng)
+
         def loss_fn(params):
             if state.batch_stats is not None:
                 logits, updates = state.apply_fn(

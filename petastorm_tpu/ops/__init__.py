@@ -1,0 +1,12 @@
+"""Device-side data-pipeline ops (JAX/Pallas).
+
+The reference does all preprocessing on the CPU host with OpenCV/numpy
+(codecs.py, TransformSpec) and ships float tensors to the accelerator. On TPU
+the bandwidth-efficient split is different: ship compact uint8 batches over
+PCIe, then cast/normalize/augment ON DEVICE, where the work is free relative
+to HBM bandwidth and overlaps with the training step. These ops are that
+device-side half of the input pipeline.
+"""
+
+from petastorm_tpu.ops.preprocess import normalize_images  # noqa: F401
+from petastorm_tpu.ops.augment import random_flip, random_crop  # noqa: F401

@@ -1,0 +1,49 @@
+"""On-device batch augmentation (random flip, random crop).
+
+The reference leaves augmentation to user TransformSpec functions running on
+CPU workers (reference transform.py:19-40, examples/mnist/pytorch_example.py).
+These equivalents run inside jit on the TPU: static output shapes, no Python
+control flow, per-image randomness from a single threaded `jax.random` key —
+so the augmentation is reproducible under the reader's seed and costs no host
+CPU.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def random_flip(images, key, prob=0.5):
+    """Per-image horizontal flip (width axis) with probability ``prob``.
+
+    :param images: ``(B, H, W, C)`` batch
+    :param key: ``jax.random`` key
+    """
+    if images.ndim != 4:
+        raise ValueError('images must be (B, H, W, C), got shape {}'.format(images.shape))
+    flip = jax.random.bernoulli(key, prob, (images.shape[0],))
+    flipped = images[:, :, ::-1, :]
+    return jnp.where(flip[:, None, None, None], flipped, images)
+
+
+def random_crop(images, key, crop_h, crop_w):
+    """Per-image random crop to ``(crop_h, crop_w)``.
+
+    Offsets are drawn uniformly per image; the gather is a vmapped
+    ``dynamic_slice``, so shapes stay static under jit.
+    """
+    if images.ndim != 4:
+        raise ValueError('images must be (B, H, W, C), got shape {}'.format(images.shape))
+    b, h, w, c = images.shape
+    if crop_h > h or crop_w > w:
+        raise ValueError('crop ({}, {}) larger than image ({}, {})'.format(
+            crop_h, crop_w, h, w))
+    ky, kx = jax.random.split(key)
+    ys = jax.random.randint(ky, (b,), 0, h - crop_h + 1)
+    xs = jax.random.randint(kx, (b,), 0, w - crop_w + 1)
+
+    def crop_one(img, y, x):
+        return jax.lax.dynamic_slice(img, (y, x, 0), (crop_h, crop_w, c))
+
+    return jax.vmap(crop_one)(images, ys, xs)

@@ -74,8 +74,10 @@ def test_mnist_pytorch_training(mnist_url):
 
 
 def test_imagenet_synthetic_generate_and_read(tmp_path):
+    import jax
+    import jax.numpy as jnp
     from examples.imagenet.generate_petastorm_imagenet import generate_synthetic_imagenet
-    from examples.imagenet.jax_resnet_example import make_transform
+    from examples.imagenet.jax_resnet_example import device_preprocess, make_transform
     url = 'file://' + str(tmp_path / 'imagenet')
     generate_synthetic_imagenet(url, num_synsets=2, images_per_synset=4)
     with make_reader(url, transform_spec=make_transform(32, 16), num_epochs=1) as reader:
@@ -84,7 +86,11 @@ def test_imagenet_synthetic_generate_and_read(tmp_path):
     total = sum(b['image'].shape[0] for b in batches)
     assert total == 8
     assert batches[0]['image'].shape[1:] == (32, 32, 3)
-    assert batches[0]['image'].dtype == np.float32
+    # host ships compact uint8; cast/normalize/augment happen on device
+    assert batches[0]['image'].dtype == np.uint8
+    processed = device_preprocess(batches[0]['image'], jax.random.key(0))
+    assert processed.dtype == jnp.bfloat16
+    assert processed.shape == batches[0]['image'].shape
     assert all(0 <= l < 16 for b in batches for l in np.atleast_1d(b['label']))
 
 

@@ -131,3 +131,23 @@ def test_pipeline_to_train_step(synthetic_dataset):
                 n_steps += 1
     assert n_steps == 6  # 100 rows / 16, drop_last
     assert np.isfinite(float(metrics['loss']))
+
+
+def test_train_step_with_device_preprocess():
+    # uint8 batch in, ops normalize/augment fused inside the jitted step
+    from petastorm_tpu import ops
+
+    def preprocess(images, rng):
+        images = ops.random_flip(images, rng)
+        return ops.normalize_images(images, 127.5, 127.5, out_dtype=jnp.float32,
+                                    use_pallas=False)
+
+    model = _tiny_resnet()
+    state = create_train_state(model, jax.random.PRNGKey(0), jnp.zeros((1, 16, 16, 3)))
+    step = make_train_step(donate=False, preprocess_fn=preprocess, preprocess_seed=3)
+    images = jnp.asarray(np.random.default_rng(0).integers(0, 256, (2, 16, 16, 3),
+                                                           dtype=np.uint8))
+    labels = jnp.array([0, 1])
+    new_state, metrics = step(state, images, labels)
+    assert np.isfinite(float(metrics['loss']))
+    assert int(new_state.step) == 1

@@ -1,0 +1,101 @@
+"""Device-side ops: fused normalize (Pallas kernel vs reference math), augment."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from petastorm_tpu.ops import normalize_images, random_crop, random_flip
+
+MEAN = np.array([123.675, 116.28, 103.53], np.float32)
+STD = np.array([58.395, 57.12, 57.375], np.float32)
+
+
+def _reference(images, mean, std):
+    return (images.astype(np.float32) - mean) / std
+
+
+@pytest.mark.parametrize('shape', [
+    (4, 32, 32, 3),     # W*C = 96 < one lane block (masked edge)
+    (2, 17, 224, 3),    # W*C = 672: non-divisible by 512 lanes, odd rows
+    (1, 8, 128, 1),     # single channel
+])
+def test_normalize_pallas_matches_reference(shape, rng):
+    images = rng.integers(0, 256, shape, dtype=np.uint8)
+    c = shape[-1]
+    mean, std = MEAN[:c], STD[:c]
+    out = normalize_images(jnp.asarray(images), mean, std, out_dtype=jnp.float32,
+                           interpret=True)
+    np.testing.assert_allclose(np.asarray(out), _reference(images, mean, std),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_normalize_jnp_fallback_matches_reference(rng):
+    images = rng.integers(0, 256, (3, 16, 24, 3), dtype=np.uint8)
+    out = normalize_images(jnp.asarray(images), MEAN, STD, out_dtype=jnp.float32,
+                           use_pallas=False)
+    np.testing.assert_allclose(np.asarray(out), _reference(images, MEAN, STD),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_normalize_bfloat16_output_and_scalar_stats(rng):
+    images = rng.integers(0, 256, (2, 8, 16, 3), dtype=np.uint8)
+    out = normalize_images(jnp.asarray(images), 127.5, 127.5, interpret=True)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               _reference(images, 127.5, 127.5), rtol=2e-2, atol=2e-2)
+
+
+def test_normalize_single_image_and_validation(rng):
+    img = rng.integers(0, 256, (8, 16, 3), dtype=np.uint8)
+    out = normalize_images(jnp.asarray(img), MEAN, STD, out_dtype=jnp.float32,
+                           use_pallas=False)
+    assert out.shape == (8, 16, 3)
+    with pytest.raises(ValueError, match='std must be non-zero'):
+        normalize_images(jnp.asarray(img), MEAN, 0.0)
+    with pytest.raises(ValueError, match='mean must be'):
+        normalize_images(jnp.asarray(img), np.ones(4), STD)
+
+
+def test_normalize_jits_inside_train_step(rng):
+    # the op must compose with jit (static shapes, no python control flow)
+    images = jnp.asarray(rng.integers(0, 256, (2, 8, 16, 3), dtype=np.uint8))
+
+    @jax.jit
+    def step(x):
+        return normalize_images(x, MEAN, STD, out_dtype=jnp.float32,
+                                use_pallas=False).sum()
+
+    assert np.isfinite(float(step(images)))
+
+
+def test_random_flip_values_and_determinism(rng):
+    images = jnp.asarray(rng.integers(0, 256, (8, 4, 6, 3), dtype=np.uint8))
+    key = jax.random.key(0)
+    out1 = random_flip(images, key)
+    out2 = random_flip(images, key)
+    np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+    # every output image is either the original or its horizontal mirror
+    img_np, out_np = np.asarray(images), np.asarray(out1)
+    n_flipped = 0
+    for i in range(img_np.shape[0]):
+        same = np.array_equal(out_np[i], img_np[i])
+        mirrored = np.array_equal(out_np[i], img_np[i, :, ::-1, :])
+        assert same or mirrored
+        n_flipped += int(mirrored and not same)
+    assert 0 < n_flipped < img_np.shape[0]  # prob=0.5 over 8 images
+
+
+def test_random_crop_shape_and_content(rng):
+    images = jnp.asarray(rng.integers(0, 256, (4, 10, 12, 3), dtype=np.uint8))
+    out = random_crop(images, jax.random.key(1), 6, 8)
+    assert out.shape == (4, 6, 8, 3)
+    # each crop must be a contiguous window of its source image
+    img_np, out_np = np.asarray(images), np.asarray(out)
+    for i in range(4):
+        found = any(
+            np.array_equal(out_np[i], img_np[i, y:y + 6, x:x + 8])
+            for y in range(5) for x in range(5))
+        assert found
+    with pytest.raises(ValueError, match='larger than image'):
+        random_crop(images, jax.random.key(2), 20, 8)
