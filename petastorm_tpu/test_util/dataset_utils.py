@@ -128,3 +128,22 @@ def create_scalar_dataset(dataset_url, num_rows=100, rows_per_row_group=10, seed
         with fs.open_output_stream(root + '/data-00000.parquet') as sink:
             pq.write_table(table, sink, row_group_size=rows_per_row_group)
     return rows, schema
+
+
+def create_many_columns_dataset(dataset_url, num_columns=1000, num_rows=10,
+                                rows_per_row_group=5):
+    """Plain parquet store with ``num_columns`` int64 columns named col_0..N
+    (reference tests/conftest.py:248-294 many_columns_non_petastorm_dataset):
+    exercises wide-schema inference and >255-field namedtuple handling."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    from petastorm_tpu.fs import FilesystemResolver
+    resolver = FilesystemResolver(dataset_url)
+    fs, root = resolver.filesystem(), resolver.get_dataset_path()
+    fs.create_dir(root, recursive=True)
+    names = ['col_{}'.format(i) for i in range(num_columns)]
+    table = pa.Table.from_pydict(
+        {name: list(range(num_rows)) for name in names})
+    with fs.open_output_stream(root + '/data-00000.parquet') as sink:
+        pq.write_table(table, sink, row_group_size=rows_per_row_group)
+    return names

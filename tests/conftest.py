@@ -57,3 +57,16 @@ def scalar_dataset(tmp_path_factory):
     ds = SyntheticDataset(url=url, data=data, path=str(path))
     ds.schema = schema
     return ds
+
+
+@pytest.fixture(scope='session')
+def many_columns_dataset(tmp_path_factory):
+    """1000-column plain parquet store (mirrors reference conftest.py:248-294)."""
+    from petastorm_tpu.test_util.dataset_utils import create_many_columns_dataset
+    path = tmp_path_factory.mktemp('many_columns')
+    url = 'file://' + str(path)
+    names = create_many_columns_dataset(url, num_columns=1000, num_rows=10,
+                                        rows_per_row_group=5)
+    ds = SyntheticDataset(url=url, data=None, path=str(path))
+    ds.column_names = names
+    return ds
