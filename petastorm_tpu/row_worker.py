@@ -18,9 +18,36 @@ import hashlib
 from collections import deque
 
 import numpy as np
+import pyarrow as pa
 
 from petastorm_tpu.native import open_parquet
 from petastorm_tpu.workers.worker_base import EmptyResultError, WorkerBase
+
+
+def _column_values(column):
+    """ChunkedArray -> list of python values. Binary columns skip ``to_pylist``
+    (which copies every cell into a bytes object) and hand out zero-copy
+    memoryview slices of the Arrow data buffer instead — the codecs
+    (np.frombuffer, cv2.imdecode) consume memoryviews directly, so the only
+    copy left in the decode path is the decode itself."""
+    t = column.type
+    if pa.types.is_binary(t) or pa.types.is_large_binary(t):
+        out = []
+        for chunk in column.chunks:
+            n = len(chunk)
+            if n == 0:
+                continue
+            if chunk.null_count:
+                out.extend(chunk.to_pylist())
+                continue
+            off_dtype = np.int64 if pa.types.is_large_binary(t) else np.int32
+            _, offsets_buf, data_buf = chunk.buffers()
+            offs = np.frombuffer(offsets_buf, dtype=off_dtype, count=n + 1,
+                                 offset=chunk.offset * np.dtype(off_dtype).itemsize).tolist()
+            mv = memoryview(data_buf)
+            out.extend(mv[offs[i]:offs[i + 1]] for i in range(n))
+        return out
+    return column.to_pylist()
 
 
 def _cache_key(dataset_path, piece, column_names):
@@ -127,7 +154,7 @@ class RowGroupDecoderWorker(WorkerBase):
         num_rows = table.num_rows
         if row_indices is not None:
             table = table.take(row_indices)
-        columns = {name: table.column(name).to_pylist() for name in physical}
+        columns = {name: _column_values(table.column(name)) for name in physical}
         n = table.num_rows
         for key, value in piece.partition_keys.items():
             if key in column_names:
@@ -234,4 +261,4 @@ class RowResultsQueueReader(object):
                 self.delivered_callback(span[0])
         if self._ngram is not None:
             return self._ngram.make_namedtuple(self._schema, row)
-        return self._schema.make_namedtuple(**row)
+        return self._schema.make_namedtuple_from_dict(row)

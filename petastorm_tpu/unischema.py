@@ -189,10 +189,11 @@ class Unischema(object):
 
     def make_namedtuple(self, **kwargs):
         """Build a row namedtuple from per-field kwargs."""
-        return self.namedtuple(**{f: kwargs[f] for f in self._fields})
+        return self.make_namedtuple_from_dict(kwargs)
 
     def make_namedtuple_from_dict(self, row_dict):
-        return self.namedtuple(**{f: row_dict[f] for f in self._fields})
+        # star-args construction is ~2x faster than **kwargs in the row hot loop
+        return self.namedtuple(*[row_dict[f] for f in self._fields])
 
     @property
     def namedtuple(self):

@@ -84,7 +84,7 @@ class DummyPool(object):
                     error, self._worker_error = self._worker_error, None
                     raise error
                 raise EmptyResultError()
-            time.sleep(0.001)
+            time.sleep(0.0001)
 
     def stop(self):
         if self._ventilator is not None:
