@@ -45,11 +45,10 @@ def collate_rows(rows, field_names=None):
     """
     if not rows:
         raise PetastormTpuError('Cannot collate an empty batch')
-    first = rows[0]
-    if hasattr(first, '_asdict'):
-        rows = [r._asdict() for r in rows]
-        first = rows[0]
-    names = field_names or list(first.keys())
+    # per-row normalization: a batch may mix namedtuples with plain dicts
+    # (e.g. checkpoint-restored buffer rows next to freshly-read rows)
+    rows = [r._asdict() if hasattr(r, '_asdict') else r for r in rows]
+    names = field_names or list(rows[0].keys())
     batch = {}
     for name in names:
         values = [_sanitize_value(r[name], name) for r in rows]
@@ -80,6 +79,17 @@ def _rows_from_columnar_batch(batch_namedtuple):
     return [{k: v[i] for k, v in d.items()} for i in range(n)]
 
 
+def _to_plain_row(row):
+    """Checkpoint-friendly row: schema namedtuple classes are created
+    dynamically and do not unpickle, so store plain dicts (collate accepts
+    both). NGram windows are dicts of offset -> namedtuple."""
+    if hasattr(row, '_asdict'):
+        return row._asdict()
+    if isinstance(row, dict):
+        return {k: (v._asdict() if hasattr(v, '_asdict') else v) for k, v in row.items()}
+    return row
+
+
 class JaxDataLoader(object):
     """
     :param reader: a :class:`petastorm_tpu.reader.Reader` (row or batch oriented)
@@ -94,10 +104,14 @@ class JaxDataLoader(object):
     :param to_device: ``None`` -> numpy host batches; a ``jax.Device`` -> arrays
         committed to it; a ``jax.sharding.Sharding`` -> global sharded arrays
         (multi-host: each process feeds its local shard)
+    :param resume_state: dict from :meth:`state_dict`. Restores the rows that
+        were buffered client-side at checkpoint time; construct the underlying
+        reader with its own ``resume_state=state['reader']``.
     """
 
     def __init__(self, reader, batch_size, shuffling_queue_capacity=0,
-                 min_after_retrieve=None, seed=None, drop_last=True, to_device=None):
+                 min_after_retrieve=None, seed=None, drop_last=True, to_device=None,
+                 resume_state=None):
         if batch_size < 1:
             raise ValueError('batch_size must be >= 1')
         self.reader = reader
@@ -108,12 +122,24 @@ class JaxDataLoader(object):
             shuffling_queue_capacity, min_after_retrieve, seed, batch_size,
             batched_reader=reader.batched_output)
         self._ngram = getattr(reader, 'ngram', None)
+        self._buffer = None
+        self._pending = []
+        if resume_state is not None:
+            if not isinstance(resume_state, dict) or resume_state.get('version') != 1:
+                raise ValueError('Unrecognized resume_state (expected a dict produced by '
+                                 'JaxDataLoader.state_dict())')
+            self._resume_rows = list(resume_state['rows'])
+        else:
+            self._resume_rows = None
 
     # -- iteration ----------------------------------------------------------
 
     def __iter__(self):
-        buffer = self._make_buffer()
-        pending = []
+        buffer = self._buffer = self._make_buffer()
+        pending = self._pending = []
+        if self._resume_rows:
+            buffer.add_many(self._resume_rows)
+            self._resume_rows = None
         for item in self.reader:
             if self.reader.batched_output:
                 buffer.add_many(_rows_from_columnar_batch(item))
@@ -122,16 +148,42 @@ class JaxDataLoader(object):
             while buffer.can_retrieve():
                 pending.append(buffer.retrieve())
                 if len(pending) == self.batch_size:
-                    yield self._emit(pending)
-                    pending = []
+                    # collate+clear BEFORE yield: a state_dict() taken while the
+                    # consumer holds this batch must not count its rows as pending
+                    batch = self._emit(pending)
+                    pending.clear()
+                    yield batch
         buffer.finish()
         while buffer.can_retrieve():
             pending.append(buffer.retrieve())
             if len(pending) == self.batch_size:
-                yield self._emit(pending)
-                pending = []
+                batch = self._emit(pending)
+                pending.clear()
+                yield batch
         if pending and not self._drop_last:
-            yield self._emit(pending)
+            batch = self._emit(list(pending))
+            pending.clear()
+            yield batch
+
+    # -- checkpoint ---------------------------------------------------------
+
+    def state_dict(self):
+        """Loader-level read-position checkpoint: the underlying reader's
+        :meth:`Reader.state_dict` plus every row currently buffered client-side
+        (shuffling buffer + partial batch), so no yielded-to-loader row is
+        lost. Note the state embeds those rows — with a large
+        ``shuffling_queue_capacity`` it is correspondingly large. Resume with::
+
+            reader = make_reader(url, ..., resume_state=state['reader'])
+            loader = JaxDataLoader(reader, ..., resume_state=state)
+        """
+        rows = []
+        if self._buffer is not None:
+            rows.extend(getattr(self._buffer, '_items', []))
+        rows.extend(self._pending)
+        return {'version': 1,
+                'reader': self.reader.state_dict(),
+                'rows': [_to_plain_row(r) for r in rows]}
 
     def _emit(self, rows):
         if self._ngram is not None:

@@ -1,0 +1,39 @@
+"""Shuffle-quality analysis: correlation of a shuffled id stream vs the
+unshuffled order (reference test_util/shuffling_analysis.py:52-85).
+
+Used by tests (and tuning sessions) to quantify decorrelation instead of just
+asserting "order changed": a well-shuffled stream's rank correlation against
+the sequential order should be near zero, and the distribution over repeated
+runs should be tight around it.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def rank_correlation(ids):
+    """Spearman rank correlation of the observed stream against 0..N-1 order.
+
+    1.0 = unshuffled, ~0 = decorrelated, -1.0 = exactly reversed.
+    """
+    ids = np.asarray(ids, dtype=np.float64)
+    n = len(ids)
+    if n < 2:
+        return 1.0
+    position = np.arange(n, dtype=np.float64)
+    ranks = np.argsort(np.argsort(ids)).astype(np.float64)
+    pc = np.corrcoef(position, ranks)[0, 1]
+    return float(pc)
+
+
+def compute_correlation_distribution(reader_factory, num_runs=5, id_field='id'):
+    """Run ``reader_factory()`` ``num_runs`` times, collecting the rank
+    correlation of each run's id stream (reference shuffling_analysis.py:52-85
+    does the same over pairs of shuffled readouts)."""
+    correlations = []
+    for _ in range(num_runs):
+        with reader_factory() as reader:
+            ids = [getattr(row, id_field) for row in reader]
+        correlations.append(abs(rank_correlation(ids)))
+    return np.asarray(correlations)
