@@ -135,12 +135,24 @@ class JaxDataLoader(object):
     # -- iteration ----------------------------------------------------------
 
     def __iter__(self):
+        import time
         buffer = self._buffer = self._make_buffer()
         pending = self._pending = []
         if self._resume_rows:
             buffer.add_many(self._resume_rows)
             self._resume_rows = None
-        for item in self.reader:
+        self._iter_start = time.perf_counter()
+        self._reader_wait_s = 0.0
+        self._rows_out = 0
+        reader_it = iter(self.reader)
+        while True:
+            w0 = time.perf_counter()
+            try:
+                item = next(reader_it)
+            except StopIteration:
+                self._reader_wait_s += time.perf_counter() - w0
+                break
+            self._reader_wait_s += time.perf_counter() - w0
             if self.reader.batched_output:
                 buffer.add_many(_rows_from_columnar_batch(item))
             else:
@@ -186,6 +198,7 @@ class JaxDataLoader(object):
                 'rows': [_to_plain_row(r) for r in rows]}
 
     def _emit(self, rows):
+        self._rows_out += len(rows)
         if self._ngram is not None:
             batch = self._collate_ngram(rows)
         else:
@@ -193,6 +206,25 @@ class JaxDataLoader(object):
         if self._to_device is not None:
             batch = self._stage(batch)
         return batch
+
+    @property
+    def diagnostics(self):
+        """Host-side input-pipeline counters (SURVEY.md §5: the reference only
+        exposes queue depths; the BASELINE metric is input-stall, so the loader
+        tracks it): rows emitted, seconds blocked waiting on the reader, the
+        wait fraction of wall time since iteration started, plus the underlying
+        pool's diagnostics."""
+        import time
+        out = dict(self.reader.diagnostics)
+        start = getattr(self, '_iter_start', None)
+        if start is not None:
+            elapsed = max(time.perf_counter() - start, 1e-9)
+            out.update({
+                'rows_emitted': self._rows_out,
+                'reader_wait_s': round(self._reader_wait_s, 4),
+                'reader_wait_fraction': round(self._reader_wait_s / elapsed, 4),
+            })
+        return out
 
     def _collate_ngram(self, windows):
         """windows: list of dicts offset -> namedtuple. Returns

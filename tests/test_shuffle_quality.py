@@ -25,24 +25,32 @@ def test_unshuffled_stream_fully_correlated(synthetic_dataset):
 
 def test_row_group_shuffle_decorrelates(synthetic_dataset):
     # row-group shuffle alone leaves rows ordered WITHIN each 10-row group, so
-    # correlation drops but stays visible; it must be well below unshuffled
-    corr = compute_correlation_distribution(
-        lambda: make_reader(synthetic_dataset.url, reader_pool_type='dummy',
-                            shuffle_row_groups=True, schema_fields=['id']),
-        num_runs=5)
-    assert corr.max() < 0.6
+    # correlation drops but stays visible; it must be well below unshuffled.
+    # Per-run seeds keep the distribution deterministic (with only 10 groups a
+    # single unseeded permutation can legitimately land above any fixed cutoff).
+    corrs = []
+    for seed in range(5):
+        corrs.append(compute_correlation_distribution(
+            lambda: make_reader(synthetic_dataset.url, reader_pool_type='dummy',
+                                shuffle_row_groups=True, seed=seed,
+                                schema_fields=['id']),
+            num_runs=1)[0])
+    assert np.mean(corrs) < 0.5, corrs
 
 
 def test_row_drop_partitions_improve_decorrelation(synthetic_dataset):
-    base = compute_correlation_distribution(
-        lambda: make_reader(synthetic_dataset.url, reader_pool_type='dummy',
-                            shuffle_row_groups=True, schema_fields=['id']),
-        num_runs=5).mean()
-    dropped = compute_correlation_distribution(
-        lambda: make_reader(synthetic_dataset.url, reader_pool_type='dummy',
-                            shuffle_row_groups=True, shuffle_row_drop_partitions=5,
-                            schema_fields=['id']),
-        num_runs=5).mean()
+    def mean_corr(**kwargs):
+        vals = []
+        for seed in range(5):
+            vals.append(compute_correlation_distribution(
+                lambda: make_reader(synthetic_dataset.url, reader_pool_type='dummy',
+                                    shuffle_row_groups=True, seed=seed,
+                                    schema_fields=['id'], **kwargs),
+                num_runs=1)[0])
+        return np.mean(vals)
+
+    base = mean_corr()
+    dropped = mean_corr(shuffle_row_drop_partitions=5)
     assert dropped <= base + 0.1  # finer ventilation units never hurt much
 
 

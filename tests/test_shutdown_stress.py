@@ -1,0 +1,91 @@
+"""Shutdown-path hardening: the reference's known race spots (SURVEY.md §5 —
+zmq slow joiners, stop-aware puts, mid-epoch stop) exercised under repetition.
+Every scenario must terminate promptly — a hang here is a deadlock regression.
+"""
+
+import threading
+import time
+
+import pytest
+
+from petastorm_tpu import make_reader
+from petastorm_tpu.jax import JaxDataLoader
+
+
+def _assert_finishes(fn, seconds, label):
+    done = threading.Event()
+    err = []
+
+    def run():
+        try:
+            fn()
+        except Exception as e:  # noqa: BLE001
+            err.append(e)
+        finally:
+            done.set()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    assert done.wait(seconds), '{} did not finish within {}s (deadlock?)'.format(label, seconds)
+    if err:
+        raise err[0]
+
+
+@pytest.mark.parametrize('pool', ['thread', 'process'])
+def test_stop_mid_iteration_repeatedly(synthetic_dataset, pool):
+    # stop with rows still in flight: workers blocked on a full results queue
+    # must unblock and exit (reference thread_pool.py:200-214 stop-aware put)
+    def cycle():
+        reader = make_reader(synthetic_dataset.url, schema_fields=['id'],
+                             reader_pool_type=pool, workers_count=2,
+                             results_queue_size=2, num_epochs=None)
+        it = iter(reader)
+        for _ in range(5):
+            next(it)
+        reader.stop()
+        reader.join()
+
+    n = 2 if pool == 'process' else 5
+    for _ in range(n):
+        _assert_finishes(cycle, 60, 'stop mid-iteration ({})'.format(pool))
+
+
+def test_immediate_stop_without_reading(synthetic_dataset):
+    def cycle():
+        reader = make_reader(synthetic_dataset.url, schema_fields=['id'],
+                             reader_pool_type='thread', workers_count=3)
+        reader.stop()
+        reader.join()
+
+    for _ in range(5):
+        _assert_finishes(cycle, 30, 'immediate stop')
+
+
+def test_loader_context_exit_mid_batch(synthetic_dataset):
+    def cycle():
+        with make_reader(synthetic_dataset.url, schema_fields=['id'],
+                         reader_pool_type='thread', workers_count=2,
+                         num_epochs=None) as reader:
+            loader = JaxDataLoader(reader, batch_size=7, shuffling_queue_capacity=20)
+            it = iter(loader)
+            next(it)
+            next(it)
+        # context exit stops the reader while the loader generator is live
+
+    for _ in range(3):
+        _assert_finishes(cycle, 30, 'loader context exit')
+
+
+def test_loader_diagnostics_counters(synthetic_dataset):
+    with make_reader(synthetic_dataset.url, schema_fields=['id'],
+                     reader_pool_type='dummy') as reader:
+        loader = JaxDataLoader(reader, batch_size=10, drop_last=False)
+        it = iter(loader)
+        next(it)
+        time.sleep(0.01)
+        d = loader.diagnostics
+        assert d['rows_emitted'] == 10
+        assert 0.0 <= d['reader_wait_fraction'] <= 1.0
+        assert d['reader_wait_s'] >= 0.0
+        list(it)
+        assert loader.diagnostics['rows_emitted'] == 100
