@@ -253,6 +253,24 @@ class JaxDataLoader(object):
         self.join()
 
 
+def stack_ngram_time_axis(ngram_batch):
+    """Collapse a collated NGram batch (offset -> field -> [B, ...]) into
+    field -> [B, T, ...] arrays, T being the window length in offset order.
+
+    This is the bridge from the reader's windowed sequence readout to
+    sequence-sharded training: the result can be staged with a
+    ``NamedSharding(mesh, P('data', 'seq', ...))`` and consumed by
+    context-parallel ops (``petastorm_tpu.ops.ring_attention``). Fields absent
+    from some timesteps (NGram allows per-timestep field sets) are skipped.
+    """
+    offsets = sorted(ngram_batch)
+    common = set(ngram_batch[offsets[0]])
+    for off in offsets[1:]:
+        common &= set(ngram_batch[off])
+    return {name: np.stack([ngram_batch[off][name] for off in offsets], axis=1)
+            for name in sorted(common)}
+
+
 def make_jax_dataset(reader, batch_size, **loader_kwargs):
     """Generator of batches — the ``make_petastorm_dataset`` analog
     (reference tf_utils.py:348-402)."""

@@ -10,3 +10,4 @@ device-side half of the input pipeline.
 
 from petastorm_tpu.ops.preprocess import normalize_images  # noqa: F401
 from petastorm_tpu.ops.augment import random_flip, random_crop  # noqa: F401
+from petastorm_tpu.ops.ring_attention import make_ring_attention, ring_attention  # noqa: F401

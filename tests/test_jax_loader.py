@@ -217,3 +217,28 @@ def test_make_global_batch_datetime_stays_host():
     assert isinstance(out['ts'], np.ndarray)  # host-side
     import jax as _jax
     assert isinstance(out['x'], _jax.Array)
+
+
+def test_ngram_time_stack_feeds_sequence_sharding(synthetic_dataset):
+    # windowed readout -> [B, T, ...] -> staged over a ('data','seq') mesh:
+    # the data-side half of context parallelism (ring attention consumes this)
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from petastorm_tpu.jax.loader import stack_ngram_time_axis
+    from petastorm_tpu.ngram import NGram
+    from petastorm_tpu.test_util.dataset_utils import TestSchema
+
+    fields = {i: [TestSchema.id] for i in range(4)}
+    ngram = NGram(fields, delta_threshold=1, timestamp_field=TestSchema.id)
+    with make_reader(synthetic_dataset.url, reader_pool_type='dummy', ngram=ngram,
+                     shuffle_row_groups=False) as reader:
+        batch = next(iter(JaxDataLoader(reader, batch_size=4)))
+    stacked = stack_ngram_time_axis(batch)
+    assert stacked['id'].shape == (4, 4)
+    np.testing.assert_array_equal(stacked['id'][:, 1], stacked['id'][:, 0] + 1)
+
+    mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(2, 4), ('data', 'seq'))
+    sharding = NamedSharding(mesh, P('data', 'seq'))
+    from petastorm_tpu.jax.infeed import stage_batch
+    staged = stage_batch(stacked, sharding)
+    assert staged['id'].sharding.is_equivalent_to(sharding, 2)
+    np.testing.assert_array_equal(np.asarray(staged['id']), stacked['id'])

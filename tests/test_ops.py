@@ -99,3 +99,50 @@ def test_random_crop_shape_and_content(rng):
         assert found
     with pytest.raises(ValueError, match='larger than image'):
         random_crop(images, jax.random.key(2), 20, 8)
+
+
+# -- ring attention (context parallelism over a virtual mesh) ----------------
+
+def _reference_attention(q, k, v, causal):
+    d = q.shape[-1]
+    s = np.einsum('bhqd,bhkd->bhqk', q, k) / np.sqrt(d)
+    if causal:
+        t = q.shape[2]
+        mask = np.tril(np.ones((t, t), bool))
+        s = np.where(mask[None, None], s, -1e30)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    return np.einsum('bhqk,bhkd->bhqd', p, v)
+
+
+@pytest.mark.parametrize('causal', [False, True])
+@pytest.mark.parametrize('ring', [2, 8])
+def test_ring_attention_matches_full_attention(causal, ring, rng):
+    from jax.sharding import Mesh
+    from petastorm_tpu.ops.ring_attention import make_ring_attention
+
+    b, h, t, d = 2, 3, 32, 8
+    q = rng.standard_normal((b, h, t, d), dtype=np.float32)
+    k = rng.standard_normal((b, h, t, d), dtype=np.float32)
+    v = rng.standard_normal((b, h, t, d), dtype=np.float32)
+
+    mesh = Mesh(np.array(jax.devices()[:ring]), ('seq',))
+    attn = make_ring_attention(mesh, seq_axis='seq', causal=causal)
+    out = np.asarray(attn(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)))
+    expected = _reference_attention(q, k, v, causal)
+    np.testing.assert_allclose(out, expected, rtol=2e-4, atol=2e-4)
+
+
+def test_ring_attention_with_data_and_seq_axes(rng):
+    from jax.sharding import Mesh
+    from petastorm_tpu.ops.ring_attention import make_ring_attention
+
+    b, h, t, d = 4, 2, 16, 4
+    q = rng.standard_normal((b, h, t, d), dtype=np.float32)
+    k = rng.standard_normal((b, h, t, d), dtype=np.float32)
+    v = rng.standard_normal((b, h, t, d), dtype=np.float32)
+    mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(2, 4), ('data', 'seq'))
+    attn = make_ring_attention(mesh, seq_axis='seq', batch_axis='data', causal=True)
+    out = np.asarray(attn(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)))
+    np.testing.assert_allclose(out, _reference_attention(q, k, v, True),
+                               rtol=2e-4, atol=2e-4)
