@@ -12,6 +12,7 @@ BASELINE.md north-star metric (>=95% duty cycle == <=5% stall).
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 import time
 from dataclasses import dataclass, field
@@ -35,10 +36,19 @@ class BenchmarkResult:
         return s
 
 
+_psutil_proc = None
+
+
 def _process_stats():
+    """RSS MB + CPU%% since the previous call ON THE SAME Process instance —
+    psutil's cpu_percent returns 0.0 for the first call of a fresh instance,
+    so the instance must be shared with the priming call."""
+    global _psutil_proc
     import psutil
-    proc = psutil.Process()
-    return proc.memory_info().rss / (1 << 20), proc.cpu_percent(interval=None)
+    if _psutil_proc is None:
+        _psutil_proc = psutil.Process()
+    return (_psutil_proc.memory_info().rss / (1 << 20),
+            _psutil_proc.cpu_percent(interval=None))
 
 
 def reader_throughput(dataset_url, field_regex=None, warmup_cycles=200, measure_cycles=1000,
@@ -59,8 +69,7 @@ def reader_throughput(dataset_url, field_regex=None, warmup_cycles=200, measure_
                             shuffle_row_groups=shuffle_row_groups,
                             num_epochs=None)
     try:
-        import psutil
-        psutil.Process().cpu_percent(interval=None)  # prime the counter
+        _process_stats()  # prime the CPU%% counter (shared Process instance)
         if read_method == 'python':
             it = iter(reader)
             for _ in range(warmup_cycles):
@@ -154,7 +163,20 @@ def main(argv=None):
     parser.add_argument('-d', '--read-method', choices=('python', 'jax'), default='python')
     parser.add_argument('--batch-size', type=int, default=64)
     parser.add_argument('--no-shuffle', action='store_true')
+    parser.add_argument('--fresh-process', action='store_true',
+                        help='re-run the measurement in a newly spawned interpreter so the '
+                             'reported RSS reflects only this benchmark (reference '
+                             'benchmark/throughput.py:146-151 always does this)')
     args = parser.parse_args(argv)
+
+    if args.fresh_process and not os.environ.get('_PSTPU_THROUGHPUT_CHILD'):
+        import subprocess
+        child_argv = [a for a in (argv if argv is not None else sys.argv[1:])
+                      if a != '--fresh-process']
+        env = dict(os.environ, _PSTPU_THROUGHPUT_CHILD='1')
+        return subprocess.run(
+            [sys.executable, '-m', 'petastorm_tpu.tools.throughput'] + child_argv,
+            env=env).returncode
 
     result = reader_throughput(
         args.dataset_url, field_regex=args.field_regex, warmup_cycles=args.warmup_cycles,

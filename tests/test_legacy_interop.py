@@ -259,3 +259,52 @@ def test_legacy_dataset_reads_end_to_end(ref, tmp_path):
     ids = sorted(r.id for r in out)
     assert ids == list(range(20))
     np.testing.assert_array_equal(out[0].matrix, np.full((2, 3), out[0].id, dtype=np.float32))
+
+
+def test_copy_tool_migrates_legacy_dataset(ref, tmp_path):
+    """petastorm-copy-dataset parity as a MIGRATION path: a store carrying only
+    the reference's pickled metadata reads in and copies out as a native store
+    (JSON schema metadata), which then reads without any legacy machinery."""
+    from petastorm_tpu import make_reader
+    from petastorm_tpu.etl.dataset_metadata import read_metadata_dict, write_petastorm_dataset
+    from petastorm_tpu.tools.copy_dataset import copy_dataset
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+
+    src = tmp_path / 'legacy'
+    src.mkdir()
+    our_schema = Unischema('LegacySchema', [
+        UnischemaField('id', np.int64, (), our_codecs.ScalarCodec(), False),
+        UnischemaField('matrix', np.float32, (2, 3), our_codecs.NdarrayCodec(), False),
+    ])
+    url = 'file://' + str(src)
+    rows = [{'id': i, 'matrix': np.full((2, 3), i, dtype=np.float32)} for i in range(20)]
+    write_petastorm_dataset(url, our_schema, rows, rows_per_row_group=5)
+
+    ref_schema_bytes = pickle.dumps(ref.Unischema('LegacySchema', [
+        ref.UnischemaField('id', np.int64, (), ref.ScalarCodec(ref.sql_types.LongType()), False),
+        ref.UnischemaField('matrix', np.float32, (2, 3), ref.NdarrayCodec(), False),
+    ]), protocol=2)
+    import json
+    import pyarrow.fs as pafs
+    fs = pafs.LocalFileSystem()
+    files = [f.path for f in fs.get_file_info(pafs.FileSelector(str(src)))
+             if f.path.endswith('.parquet')]
+    counts = {f.rsplit('/', 1)[1]: pq.ParquetFile(f).metadata.num_row_groups
+              for f in sorted(files)}
+    arrow_schema = pq.ParquetFile(sorted(files)[0]).schema_arrow.with_metadata({
+        legacy.REF_UNISCHEMA_KEY: ref_schema_bytes,
+        legacy.REF_ROW_GROUPS_PER_FILE_KEY: json.dumps(counts).encode('utf-8'),
+    })
+    pq.write_metadata(arrow_schema, str(src / '_common_metadata'))
+
+    target = 'file://' + str(tmp_path / 'native')
+    copied = copy_dataset(url, target, rows_per_row_group=10)
+    assert copied == 20
+
+    from petastorm_tpu.etl.dataset_metadata import UNISCHEMA_KEY
+    meta = read_metadata_dict(target)
+    key = UNISCHEMA_KEY if isinstance(UNISCHEMA_KEY, bytes) else UNISCHEMA_KEY.encode()
+    assert key in {k if isinstance(k, bytes) else k.encode() for k in meta}  # native JSON schema
+    with make_reader(target, shuffle_row_groups=False, reader_pool_type='dummy') as reader:
+        out = sorted(r.id for r in reader)
+    assert out == list(range(20))
