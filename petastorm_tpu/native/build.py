@@ -17,6 +17,8 @@ import sys
 _HERE = os.path.dirname(os.path.abspath(__file__))
 SOURCE = os.path.join(_HERE, 'rowgroup_reader.cpp')
 OUTPUT = os.path.join(_HERE, 'libpstpu.so')
+SHM_SOURCE = os.path.join(_HERE, 'shm_ring.cpp')
+SHM_OUTPUT = os.path.join(_HERE, 'libpstpu_shm.so')
 
 
 def _arrow_paths():
@@ -93,6 +95,38 @@ def build(force=False, quiet=False):
             fcntl.flock(lock_file, fcntl.LOCK_UN)
 
 
+def build_shm(force=False, quiet=False):
+    """Compile the shared-memory ring transport (no external deps). Same
+    concurrency-safe temp-file + flock scheme as :func:`build`."""
+    if not force and os.path.exists(SHM_OUTPUT) and \
+            os.path.getmtime(SHM_OUTPUT) >= os.path.getmtime(SHM_SOURCE):
+        return SHM_OUTPUT
+    import fcntl
+    lock_path = SHM_OUTPUT + '.lock'
+    with open(lock_path, 'w') as lock_file:
+        fcntl.flock(lock_file, fcntl.LOCK_EX)
+        try:
+            if not force and os.path.exists(SHM_OUTPUT) and \
+                    os.path.getmtime(SHM_OUTPUT) >= os.path.getmtime(SHM_SOURCE):
+                return SHM_OUTPUT
+            tmp_out = '{}.tmp.{}'.format(SHM_OUTPUT, os.getpid())
+            cmd = ['g++', '-O2', '-std=c++17', '-shared', '-fPIC', SHM_SOURCE,
+                   '-o', tmp_out]
+            if not quiet:
+                print('building shm ring:', ' '.join(cmd))
+            result = subprocess.run(cmd, capture_output=True, text=True)
+            if result.returncode != 0:
+                if os.path.exists(tmp_out):
+                    os.unlink(tmp_out)
+                raise RuntimeError('shm ring build failed:\n' + result.stderr)
+            os.replace(tmp_out, SHM_OUTPUT)
+            return SHM_OUTPUT
+        finally:
+            fcntl.flock(lock_file, fcntl.LOCK_UN)
+
+
 if __name__ == '__main__':
     build(force='--force' in sys.argv)
     print('built', OUTPUT)
+    build_shm(force='--force' in sys.argv)
+    print('built', SHM_OUTPUT)

@@ -1,0 +1,229 @@
+// First-party shared-memory SPSC ring for worker->main result transport.
+//
+// The reference delegates its process-pool transport to libzmq (C) over tcp
+// loopback (reference workers_pool/process_pool.py:52-74). This is the
+// equivalent native component done first-party (SURVEY.md §2.10 plan): one
+// single-producer/single-consumer byte ring per worker process in POSIX shared
+// memory, so a decoded row-group payload crosses the process boundary with
+// exactly one memcpy in and one out — no socket syscalls, no kernel copies.
+//
+// Layout: [RingHeader][data area of `capacity` bytes]. `head`/`tail` are
+// monotonically increasing byte positions (index = pos % capacity). Messages
+// are 8-byte little-endian length + payload, wrapping byte-wise. Producer:
+// load head (acquire) -> check space -> write -> store tail (release).
+// Consumer: load tail (acquire) -> read -> store head (release). Blocking is
+// left to the Python callers (sleep-poll), keeping the C side lock-free.
+//
+// Build: python -m petastorm_tpu.native.build (second, dependency-free target).
+
+#include <atomic>
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <string>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+struct RingHeader {
+  std::atomic<uint64_t> head;  // consumer position
+  std::atomic<uint64_t> tail;  // producer position
+  uint64_t capacity;
+  uint64_t magic;
+  char pad[64 - 4 * sizeof(uint64_t)];  // keep the data area cache-aligned
+};
+
+constexpr uint64_t kMagic = 0x70737470755F7268ULL;  // "pstpu_rh"
+
+struct RingHandle {
+  RingHeader* hdr;
+  uint8_t* data;
+  size_t map_len;
+  std::string name;
+  bool owner;
+};
+
+thread_local std::string g_error;
+
+void set_error(const std::string& msg) { g_error = msg; }
+
+void copy_in(RingHandle* r, uint64_t pos, const uint8_t* src, uint64_t len) {
+  const uint64_t cap = r->hdr->capacity;
+  const uint64_t idx = pos % cap;
+  const uint64_t first = (idx + len <= cap) ? len : cap - idx;
+  std::memcpy(r->data + idx, src, first);
+  if (first < len) std::memcpy(r->data, src + first, len - first);
+}
+
+void copy_out(RingHandle* r, uint64_t pos, uint8_t* dst, uint64_t len) {
+  const uint64_t cap = r->hdr->capacity;
+  const uint64_t idx = pos % cap;
+  const uint64_t first = (idx + len <= cap) ? len : cap - idx;
+  std::memcpy(dst, r->data + idx, first);
+  if (first < len) std::memcpy(dst + first, r->data, len - first);
+}
+
+}  // namespace
+
+extern "C" {
+
+const char* pstpu_ring_last_error() { return g_error.c_str(); }
+
+// Create (consumer side). Returns NULL on failure.
+void* pstpu_ring_create(const char* name, uint64_t capacity) {
+  if (capacity < 4096) {
+    set_error("ring capacity must be >= 4096 bytes");
+    return nullptr;
+  }
+  int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) {
+    set_error(std::string("shm_open(create) failed: ") + std::strerror(errno));
+    return nullptr;
+  }
+  const size_t map_len = sizeof(RingHeader) + capacity;
+  if (ftruncate(fd, static_cast<off_t>(map_len)) != 0) {
+    set_error(std::string("ftruncate failed: ") + std::strerror(errno));
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, map_len, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) {
+    set_error(std::string("mmap failed: ") + std::strerror(errno));
+    shm_unlink(name);
+    return nullptr;
+  }
+  auto* hdr = new (mem) RingHeader();
+  hdr->head.store(0, std::memory_order_relaxed);
+  hdr->tail.store(0, std::memory_order_relaxed);
+  hdr->capacity = capacity;
+  hdr->magic = kMagic;
+  auto* handle = new RingHandle{hdr, reinterpret_cast<uint8_t*>(mem) + sizeof(RingHeader),
+                                map_len, name, /*owner=*/true};
+  return handle;
+}
+
+// Attach (producer side). Returns NULL on failure.
+void* pstpu_ring_attach(const char* name) {
+  int fd = shm_open(name, O_RDWR, 0600);
+  if (fd < 0) {
+    set_error(std::string("shm_open(attach) failed: ") + std::strerror(errno));
+    return nullptr;
+  }
+  struct stat st;
+  if (fstat(fd, &st) != 0 || static_cast<size_t>(st.st_size) < sizeof(RingHeader)) {
+    set_error("ring shm segment too small");
+    close(fd);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, static_cast<size_t>(st.st_size), PROT_READ | PROT_WRITE,
+                   MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) {
+    set_error(std::string("mmap failed: ") + std::strerror(errno));
+    return nullptr;
+  }
+  auto* hdr = reinterpret_cast<RingHeader*>(mem);
+  if (hdr->magic != kMagic ||
+      sizeof(RingHeader) + hdr->capacity != static_cast<uint64_t>(st.st_size)) {
+    set_error("ring header corrupt (magic/capacity mismatch)");
+    munmap(mem, static_cast<size_t>(st.st_size));
+    return nullptr;
+  }
+  auto* handle = new RingHandle{hdr, reinterpret_cast<uint8_t*>(mem) + sizeof(RingHeader),
+                                static_cast<size_t>(st.st_size), name, /*owner=*/false};
+  return handle;
+}
+
+uint64_t pstpu_ring_capacity(void* h) {
+  return static_cast<RingHandle*>(h)->hdr->capacity;
+}
+
+// Space currently free for writing (bytes, including the 8-byte length prefix).
+uint64_t pstpu_ring_free_space(void* h) {
+  auto* r = static_cast<RingHandle*>(h);
+  const uint64_t head = r->hdr->head.load(std::memory_order_acquire);
+  const uint64_t tail = r->hdr->tail.load(std::memory_order_relaxed);
+  return r->hdr->capacity - (tail - head);
+}
+
+// Non-blocking write of one message. 1 = written, 0 = would block (not enough
+// space right now), -1 = message can never fit this ring.
+int pstpu_ring_write(void* h, const void* data, uint64_t len) {
+  auto* r = static_cast<RingHandle*>(h);
+  const uint64_t need = len + 8;
+  if (need > r->hdr->capacity) {
+    set_error("message larger than ring capacity");
+    return -1;
+  }
+  const uint64_t head = r->hdr->head.load(std::memory_order_acquire);
+  const uint64_t tail = r->hdr->tail.load(std::memory_order_relaxed);
+  if (r->hdr->capacity - (tail - head) < need) return 0;
+  uint64_t len_le = len;  // assume little-endian host (x86/arm TPU hosts)
+  copy_in(r, tail, reinterpret_cast<const uint8_t*>(&len_le), 8);
+  copy_in(r, tail + 8, static_cast<const uint8_t*>(data), len);
+  r->hdr->tail.store(tail + need, std::memory_order_release);
+  return 1;
+}
+
+// Gather write: header + payload as ONE message, no caller-side concat copy.
+// Same return convention as pstpu_ring_write.
+int pstpu_ring_write2(void* h, const void* a, uint64_t a_len, const void* b, uint64_t b_len) {
+  auto* r = static_cast<RingHandle*>(h);
+  const uint64_t len = a_len + b_len;
+  const uint64_t need = len + 8;
+  if (need > r->hdr->capacity) {
+    set_error("message larger than ring capacity");
+    return -1;
+  }
+  const uint64_t head = r->hdr->head.load(std::memory_order_acquire);
+  const uint64_t tail = r->hdr->tail.load(std::memory_order_relaxed);
+  if (r->hdr->capacity - (tail - head) < need) return 0;
+  uint64_t len_le = len;
+  copy_in(r, tail, reinterpret_cast<const uint8_t*>(&len_le), 8);
+  copy_in(r, tail + 8, static_cast<const uint8_t*>(a), a_len);
+  copy_in(r, tail + 8 + a_len, static_cast<const uint8_t*>(b), b_len);
+  r->hdr->tail.store(tail + need, std::memory_order_release);
+  return 1;
+}
+
+// Length of the next unread message, or -1 when the ring is empty.
+int64_t pstpu_ring_next_len(void* h) {
+  auto* r = static_cast<RingHandle*>(h);
+  const uint64_t tail = r->hdr->tail.load(std::memory_order_acquire);
+  const uint64_t head = r->hdr->head.load(std::memory_order_relaxed);
+  if (tail == head) return -1;
+  uint64_t len_le = 0;
+  copy_out(r, head, reinterpret_cast<uint8_t*>(&len_le), 8);
+  return static_cast<int64_t>(len_le);
+}
+
+// Read one message into buf. Returns its length, -1 when empty, -2 when buf
+// is too small (message left in place; call pstpu_ring_next_len first).
+int64_t pstpu_ring_read(void* h, void* buf, uint64_t buf_cap) {
+  auto* r = static_cast<RingHandle*>(h);
+  const uint64_t tail = r->hdr->tail.load(std::memory_order_acquire);
+  const uint64_t head = r->hdr->head.load(std::memory_order_relaxed);
+  if (tail == head) return -1;
+  uint64_t len_le = 0;
+  copy_out(r, head, reinterpret_cast<uint8_t*>(&len_le), 8);
+  if (len_le > buf_cap) return -2;
+  copy_out(r, head + 8, static_cast<uint8_t*>(buf), len_le);
+  r->hdr->head.store(head + 8 + len_le, std::memory_order_release);
+  return static_cast<int64_t>(len_le);
+}
+
+// Unmap; the creator also unlinks the shm name.
+void pstpu_ring_close(void* h) {
+  auto* r = static_cast<RingHandle*>(h);
+  munmap(r->hdr, r->map_len);
+  if (r->owner) shm_unlink(r->name.c_str());
+  delete r;
+}
+
+}  // extern "C"

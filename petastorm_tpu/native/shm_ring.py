@@ -1,0 +1,155 @@
+"""ctypes bindings for the C++ shared-memory SPSC ring (shm_ring.cpp).
+
+One ring per worker process, worker -> main. Non-blocking C primitives;
+blocking (with stop-aware sleep-poll) lives here in Python. Availability is
+probed like the row-group kernel: any build/load failure makes
+``is_available()`` False and the process pool falls back to zmq transport.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import threading
+import time
+
+logger = logging.getLogger(__name__)
+
+_lib = None
+_lib_lock = threading.Lock()
+_load_failed = False
+
+DEFAULT_RING_BYTES = 64 << 20
+
+
+def _load_library():
+    global _lib, _load_failed
+    if _lib is not None or _load_failed:
+        return _lib
+    with _lib_lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        try:
+            from petastorm_tpu.native.build import build_shm
+            lib = ctypes.CDLL(build_shm(quiet=True))
+        except Exception as e:  # noqa: BLE001 - fall back to zmq transport
+            logger.info('shm ring unavailable (%s); process pool will use zmq', e)
+            _load_failed = True
+            return None
+        lib.pstpu_ring_create.restype = ctypes.c_void_p
+        lib.pstpu_ring_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+        lib.pstpu_ring_attach.restype = ctypes.c_void_p
+        lib.pstpu_ring_attach.argtypes = [ctypes.c_char_p]
+        lib.pstpu_ring_last_error.restype = ctypes.c_char_p
+        lib.pstpu_ring_capacity.restype = ctypes.c_uint64
+        lib.pstpu_ring_capacity.argtypes = [ctypes.c_void_p]
+        lib.pstpu_ring_free_space.restype = ctypes.c_uint64
+        lib.pstpu_ring_free_space.argtypes = [ctypes.c_void_p]
+        lib.pstpu_ring_write.restype = ctypes.c_int
+        lib.pstpu_ring_write.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64]
+        lib.pstpu_ring_write2.restype = ctypes.c_int
+        lib.pstpu_ring_write2.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64,
+                                          ctypes.c_char_p, ctypes.c_uint64]
+        lib.pstpu_ring_next_len.restype = ctypes.c_int64
+        lib.pstpu_ring_next_len.argtypes = [ctypes.c_void_p]
+        lib.pstpu_ring_read.restype = ctypes.c_int64
+        lib.pstpu_ring_read.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64]
+        lib.pstpu_ring_close.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def is_available():
+    return _load_library() is not None
+
+
+class ShmRing(object):
+    """One SPSC byte ring in POSIX shared memory."""
+
+    def __init__(self, handle, lib):
+        self._handle = handle
+        self._lib = lib
+
+    @classmethod
+    def create(cls, name, capacity=DEFAULT_RING_BYTES):
+        lib = _load_library()
+        if lib is None:
+            raise RuntimeError('shm ring library not available')
+        handle = lib.pstpu_ring_create(name.encode(), capacity)
+        if not handle:
+            raise OSError('ring create failed: {}'.format(
+                lib.pstpu_ring_last_error().decode()))
+        return cls(handle, lib)
+
+    @classmethod
+    def attach(cls, name):
+        lib = _load_library()
+        if lib is None:
+            raise RuntimeError('shm ring library not available')
+        handle = lib.pstpu_ring_attach(name.encode())
+        if not handle:
+            raise OSError('ring attach failed: {}'.format(
+                lib.pstpu_ring_last_error().decode()))
+        return cls(handle, lib)
+
+    @property
+    def capacity(self):
+        return self._lib.pstpu_ring_capacity(self._handle)
+
+    def try_write(self, data):
+        """True = written; False = ring currently full. Raises when the
+        message can never fit (grow ``ring_bytes``)."""
+        rc = self._lib.pstpu_ring_write(self._handle, data, len(data))
+        if rc < 0:
+            raise ValueError('message of {} bytes exceeds ring capacity {} — increase the '
+                             'process pool ring_bytes (or shrink row groups)'.format(
+                                 len(data), self.capacity))
+        return rc == 1
+
+    def write(self, data, stop_check=None, poll_s=0.0002):
+        """Blocking write with optional ``stop_check()`` abort callback."""
+        while not self.try_write(data):
+            if stop_check is not None and stop_check():
+                return False
+            time.sleep(poll_s)
+        return True
+
+    def try_write2(self, header, payload):
+        """Gather write of header+payload as one message — no concat copy."""
+        rc = self._lib.pstpu_ring_write2(self._handle, header, len(header),
+                                         payload, len(payload))
+        if rc < 0:
+            raise ValueError('message of {} bytes exceeds ring capacity {} — increase the '
+                             'process pool ring_bytes (or shrink row groups)'.format(
+                                 len(header) + len(payload), self.capacity))
+        return rc == 1
+
+    def write2(self, header, payload, stop_check=None, poll_s=0.0002):
+        while not self.try_write2(header, payload):
+            if stop_check is not None and stop_check():
+                return False
+            time.sleep(poll_s)
+        return True
+
+    def try_read(self):
+        """One message as bytes, or None when the ring is empty."""
+        mv = self.try_read_view()
+        return None if mv is None else bytes(mv)
+
+    def try_read_view(self):
+        """One message as a memoryview (zero further copies: consumers may
+        slice a header off and hand the rest straight to a deserializer), or
+        None when the ring is empty."""
+        n = self._lib.pstpu_ring_next_len(self._handle)
+        if n < 0:
+            return None
+        buf = ctypes.create_string_buffer(int(n))
+        got = self._lib.pstpu_ring_read(self._handle, buf, n)
+        if got < 0:
+            return None  # raced/buffer mismatch: treat as empty, caller re-polls
+        return memoryview(buf)[:got]
+
+    def close(self):
+        if self._handle:
+            self._lib.pstpu_ring_close(self._handle)
+            self._handle = None

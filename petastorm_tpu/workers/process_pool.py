@@ -1,14 +1,18 @@
-"""Process pool: spawned worker processes with a ZMQ star topology.
+"""Process pool: spawned worker processes, shm-ring or ZMQ star topology.
 
 Parity: /root/reference/petastorm/workers_pool/process_pool.py —
 main PUSH -> workers (ventilate), main PUB -> workers (control),
-workers PUSH -> main PULL (results) (:52-74); spawn not fork (:15-17);
+workers -> main (results) (:52-74); spawn not fork (:15-17);
 startup handshake (:208-214); orphaned-worker suicide via a main-pid monitor
 thread (:324-331); slow-joiner-safe shutdown rebroadcasting FINISHED (:287-304);
 pluggable payload serializers; ``diagnostics`` (:306-314).
 
-Sockets are ipc:// endpoints in a private temp dir (lower latency than tcp
-loopback, no port conflicts).
+TPU-first departure: the high-bandwidth worker->main results path defaults to
+the first-party C++ shared-memory SPSC ring (native/shm_ring.cpp) — one memcpy
+in, one out, no socket syscalls — with the reference-style ZMQ PULL as the
+fallback (``transport='zmq'``). Ventilation and control stay on ZMQ (ipc://
+endpoints in a private temp dir): they are low-bandwidth and need fan-out/
+fan-in semantics the ring does not provide.
 
 Note: workers are spawned, so (as with any ``multiprocessing`` spawn user)
 scripts creating a ProcessPool at module level must guard the pool-creating code
@@ -22,10 +26,12 @@ import multiprocessing
 import os
 import pickle
 import shutil
+import struct
 import sys
 import tempfile
 import threading
 import time
+import uuid
 
 import zmq
 
@@ -39,17 +45,43 @@ _STARTED, _DATA, _DONE, _ERROR = b'S', b'D', b'F', b'E'
 
 _WORKER_STARTUP_TIMEOUT_S = 30
 _DEFAULT_RESULTS_HWM = 50
+_DEFAULT_RING_BYTES = 64 << 20
+
+
+def _ring_header(kind, seq):
+    """Ring message framing: kind byte + little-endian int64 seq (-1 = None),
+    then the payload; header and payload are gather-written as one message."""
+    return kind + struct.pack('<q', -1 if seq is None else seq)
+
+
+def _ring_unpack(view):
+    """(kind, seq, payload_view) from a message memoryview — the payload stays
+    a zero-copy view handed straight to the deserializer."""
+    seq = struct.unpack_from('<q', view, 1)[0]
+    return bytes(view[0:1]), (None if seq < 0 else seq), view[9:]
 
 
 class ProcessPool(object):
     def __init__(self, workers_count, results_queue_size=_DEFAULT_RESULTS_HWM, serializer=None,
-                 results_timeout_s=None):
+                 results_timeout_s=None, transport=None, ring_bytes=_DEFAULT_RING_BYTES):
         """``results_timeout_s``: raise if no worker message arrives within this
-        many seconds (None = block indefinitely, matching ThreadPool)."""
+        many seconds (None = block indefinitely, matching ThreadPool).
+        ``transport``: 'shm' (first-party C++ shared-memory rings) | 'zmq' |
+        None = shm when the native library is available, else zmq.
+        ``ring_bytes``: per-worker ring capacity for the shm transport; one
+        serialized row-group payload must fit."""
         self._workers_count = workers_count
         self._results_hwm = results_queue_size
         self._serializer = serializer or PickleSerializer()
         self._results_timeout_s = results_timeout_s
+        if transport is None:
+            from petastorm_tpu.native import shm_ring
+            transport = 'shm' if shm_ring.is_available() else 'zmq'
+        if transport not in ('shm', 'zmq'):
+            raise ValueError("transport must be 'shm', 'zmq' or None, got {!r}".format(transport))
+        self._transport = transport
+        self._ring_bytes = ring_bytes
+        self._rings = []
         self._context = None
         self._processes = []
         self._ventilator = None
@@ -60,6 +92,10 @@ class ProcessPool(object):
         # checkpoint plumbing (see thread_pool.py): messages carry the item seq
         self.last_result_seq = None
         self.done_callback = None
+
+    @property
+    def transport(self):
+        return self._transport
 
     @property
     def workers_count(self):
@@ -77,12 +113,23 @@ class ProcessPool(object):
         self._ventilator_send = self._context.socket(zmq.PUSH)
         self._ventilator_send.setsockopt(zmq.LINGER, 0)
         self._ventilator_send.bind(vent_addr)
-        self._results_receive = self._context.socket(zmq.PULL)
-        self._results_receive.setsockopt(zmq.RCVHWM, self._results_hwm)
-        self._results_receive.bind(result_addr)
         self._control_send = self._context.socket(zmq.PUB)
         self._control_send.setsockopt(zmq.LINGER, 0)
         self._control_send.bind(control_addr)
+
+        ring_names = [None] * self._workers_count
+        self._results_receive = None
+        if self._transport == 'shm':
+            from petastorm_tpu.native.shm_ring import ShmRing
+            run_id = uuid.uuid4().hex[:12]
+            for worker_id in range(self._workers_count):
+                name = '/pstpu_{}_{}_{}'.format(os.getpid(), run_id, worker_id)
+                self._rings.append(ShmRing.create(name, self._ring_bytes))
+                ring_names[worker_id] = name
+        else:
+            self._results_receive = self._context.socket(zmq.PULL)
+            self._results_receive.setsockopt(zmq.RCVHWM, self._results_hwm)
+            self._results_receive.bind(result_addr)
 
         # spawn (NOT fork): forked children inherit locked mutexes/threads from
         # Arrow, JAX, etc. (reference process_pool.py:15-17 for the JVM analog)
@@ -93,7 +140,7 @@ class ProcessPool(object):
             p = ctx.Process(
                 target=_worker_bootstrap,
                 args=(worker_id, os.getpid(), setup_blob, vent_addr, result_addr, control_addr,
-                      self._results_hwm),
+                      self._results_hwm, ring_names[worker_id]),
                 daemon=True)
             p.start()
             self._processes.append(p)
@@ -107,14 +154,35 @@ class ProcessPool(object):
                 raise TimeoutWaitingForResultError(
                     'Only {} of {} workers started within {}s'.format(
                         started, self._workers_count, _WORKER_STARTUP_TIMEOUT_S))
-            if self._results_receive.poll(100):
-                kind = self._results_receive.recv_multipart()[0]
-                if kind == _STARTED:
-                    started += 1
+            msg = self._poll_message(100)
+            if msg is not None and msg[0] == _STARTED:
+                started += 1
 
         if ventilator is not None:
             self._ventilator = ventilator
             self._ventilator.start()
+
+    def _poll_message(self, timeout_ms):
+        """Next (kind, seq, payload_bytes) from the results transport, or None
+        after ``timeout_ms``. shm: round-robin over the per-worker rings."""
+        if self._transport == 'zmq':
+            if not self._results_receive.poll(timeout_ms):
+                return None
+            kind, seq_bytes, payload = self._results_receive.recv_multipart()
+            return kind, (int(seq_bytes) if seq_bytes else None), payload
+        deadline = time.monotonic() + timeout_ms / 1000.0
+        sleep_s = 0.0002
+        while True:
+            for ring in self._rings:
+                view = ring.try_read_view()
+                if view is not None:
+                    return _ring_unpack(view)
+            if time.monotonic() >= deadline:
+                return None
+            # exponential backoff to 2ms: a sleeping consumer leaves the cores
+            # to the workers; sub-ms latency only matters on the first misses
+            time.sleep(sleep_s)
+            sleep_s = min(sleep_s * 2, 0.002)
 
     def ventilate(self, *args, **kwargs):
         self._ventilated_items += 1
@@ -124,7 +192,8 @@ class ProcessPool(object):
         timeout_s = timeout_s if timeout_s is not None else self._results_timeout_s
         deadline = (time.monotonic() + timeout_s) if timeout_s is not None else None
         while True:
-            if not self._results_receive.poll(50):
+            msg = self._poll_message(50)
+            if msg is None:
                 if self._all_done():
                     raise EmptyResultError()
                 if deadline is not None and time.monotonic() > deadline:
@@ -132,8 +201,7 @@ class ProcessPool(object):
                         'No results from worker processes in {}s; {} items in flight'.format(
                             timeout_s, self._ventilated_items - self._completed_items))
                 continue
-            kind, seq_bytes, payload = self._results_receive.recv_multipart()
-            seq = int(seq_bytes) if seq_bytes else None
+            kind, seq, payload = msg
             if kind == _DATA:
                 self.last_result_seq = seq
                 return self._serializer.deserialize(payload)
@@ -170,9 +238,14 @@ class ProcessPool(object):
         deadline = time.monotonic() + 10
         while any(p.is_alive() for p in self._processes) and time.monotonic() < deadline:
             self._control_send.send(_CONTROL_FINISHED)
-            # drain results so workers blocked on a full PUSH queue can exit
-            while self._results_receive.poll(0):
-                self._results_receive.recv_multipart()
+            # drain results so workers blocked on a full transport can exit
+            if self._transport == 'zmq':
+                while self._results_receive.poll(0):
+                    self._results_receive.recv_multipart()
+            else:
+                for ring in self._rings:
+                    while ring.try_read() is not None:
+                        pass
             time.sleep(0.05)
         for p in self._processes:
             if p.is_alive():
@@ -180,8 +253,12 @@ class ProcessPool(object):
                 p.terminate()
             p.join()
         self._processes = []
+        for ring in self._rings:
+            ring.close()
+        self._rings = []
         for sock in (self._ventilator_send, self._results_receive, self._control_send):
-            sock.close()
+            if sock is not None:
+                sock.close()
         self._context.term()
         if self._ipc_dir:
             shutil.rmtree(self._ipc_dir, ignore_errors=True)
@@ -202,8 +279,9 @@ class ProcessPool(object):
 # ---------------------------------------------------------------------------
 
 def _worker_bootstrap(worker_id, main_pid, setup_blob, vent_addr, result_addr, control_addr,
-                      results_hwm):
-    """Entry point of a spawned worker process."""
+                      results_hwm, ring_name=None):
+    """Entry point of a spawned worker process. ``ring_name`` selects the shm
+    results transport; None = zmq PUSH."""
     worker_class, worker_setup_args, serializer = pickle.loads(setup_blob)
 
     _start_orphan_monitor(main_pid)
@@ -211,38 +289,61 @@ def _worker_bootstrap(worker_id, main_pid, setup_blob, vent_addr, result_addr, c
     context = zmq.Context()
     vent_recv = context.socket(zmq.PULL)
     vent_recv.connect(vent_addr)
-    result_send = context.socket(zmq.PUSH)
-    result_send.setsockopt(zmq.SNDHWM, results_hwm)
-    result_send.connect(result_addr)
     control_recv = context.socket(zmq.SUB)
     control_recv.setsockopt(zmq.SUBSCRIBE, b'')
     control_recv.connect(control_addr)
+
+    finished = {'flag': False}
+
+    def check_finished():
+        """Also polled while blocked on a full ring, so shutdown never
+        deadlocks against an unconsumed results transport."""
+        if not finished['flag'] and control_recv.poll(0):
+            if control_recv.recv() == _CONTROL_FINISHED:
+                finished['flag'] = True
+        return finished['flag']
+
+    ring = None
+    result_send = None
+    if ring_name is not None:
+        from petastorm_tpu.native.shm_ring import ShmRing
+        ring = ShmRing.attach(ring_name)
+
+        def send(kind, seq, payload=b''):
+            ring.write2(_ring_header(kind, seq), payload, stop_check=check_finished)
+    else:
+        result_send = context.socket(zmq.PUSH)
+        result_send.setsockopt(zmq.SNDHWM, results_hwm)
+        result_send.connect(result_addr)
+
+        def send(kind, seq, payload=b''):
+            seq_bytes = b'' if seq is None else str(seq).encode()
+            result_send.send_multipart([kind, seq_bytes, payload])
+
+    current = {'seq': None}  # seq of the item being processed, for publish tagging
+
+    def publish(data):
+        send(_DATA, current['seq'], serializer.serialize(data))
+
+    worker = worker_class(worker_id, publish, worker_setup_args)
+    send(_STARTED, None)
 
     poller = zmq.Poller()
     poller.register(vent_recv, zmq.POLLIN)
     poller.register(control_recv, zmq.POLLIN)
 
-    current = {'seq': b''}  # seq of the item being processed, for publish tagging
-
-    def publish(data):
-        result_send.send_multipart([_DATA, current['seq'], serializer.serialize(data)])
-
-    worker = worker_class(worker_id, publish, worker_setup_args)
-    result_send.send_multipart([_STARTED, b'', b''])
-
     try:
         while True:
             events = dict(poller.poll(100))
-            if control_recv in events:
-                if control_recv.recv() == _CONTROL_FINISHED:
+            if control_recv in events or finished['flag']:
+                if finished['flag'] or control_recv.recv() == _CONTROL_FINISHED:
                     break
             if vent_recv in events:
                 args, kwargs = vent_recv.recv_pyobj()
-                seq = kwargs.pop('_seq', None)
-                current['seq'] = b'' if seq is None else str(seq).encode()
+                current['seq'] = kwargs.pop('_seq', None)
                 try:
                     worker.process(*args, **kwargs)
-                    result_send.send_multipart([_DONE, current['seq'], b''])
+                    send(_DONE, current['seq'])
                 except Exception:  # noqa: BLE001 - forwarded to the main process
                     exc = sys.exc_info()[1]
                     logger.exception('Worker %d failed', worker_id)
@@ -250,14 +351,17 @@ def _worker_bootstrap(worker_id, main_pid, setup_blob, vent_addr, result_addr, c
                         blob = pickle.dumps(exc)
                     except Exception:  # unpicklable exception: forward a summary
                         blob = pickle.dumps(RuntimeError('{}: {}'.format(type(exc).__name__, exc)))
-                    result_send.send_multipart([_ERROR, b'', blob])
+                    send(_ERROR, None, blob)
                     # seq-less sentinel: the failed item stays undelivered so a
                     # checkpoint re-reads it (see thread_pool.py)
-                    result_send.send_multipart([_DONE, b'', b''])
+                    send(_DONE, None)
     finally:
         worker.shutdown()
+        if ring is not None:
+            ring.close()
         for sock in (vent_recv, result_send, control_recv):
-            sock.close()
+            if sock is not None:
+                sock.close()
         context.term()
 
 

@@ -163,3 +163,50 @@ def test_serializers_roundtrip():
     t = pa.table({'x': np.arange(10), 'y': ['a'] * 10})
     out = s.deserialize(s.serialize(t))
     assert out.equals(t)
+
+
+class TestProcessPoolTransports:
+    """Both results transports (first-party C++ shm ring, reference-style zmq)
+    must behave identically through the pool protocol."""
+
+    @pytest.mark.parametrize('transport', ['shm', 'zmq'])
+    def test_identity_roundtrip(self, transport):
+        pool = ProcessPool(2, transport=transport)
+        assert pool.transport == transport
+        pool.start(IdentityWorker)
+        for i in range(30):
+            pool.ventilate(i)
+        results = _drain(pool)
+        assert sorted(results) == list(range(30))
+        pool.stop(); pool.join()
+
+    @pytest.mark.parametrize('transport', ['shm', 'zmq'])
+    def test_exception_propagates(self, transport):
+        pool = ProcessPool(1, transport=transport)
+        pool.start(ExceptionEveryNWorker, worker_setup_args=1)
+        pool.ventilate(3)  # 3 % 1 == 0 -> raises
+        with pytest.raises(ValueError, match='stub failure'):
+            pool.get_results()
+        pool.stop(); pool.join()
+
+    def test_shm_large_payload_backpressure(self):
+        # payloads larger than the ring force the blocking-write path and the
+        # never-fits error path
+        from petastorm_tpu.native.shm_ring import ShmRing
+        import os
+        name = '/pstpu_bp_{}'.format(os.getpid())
+        ring = ShmRing.create(name, 1 << 20)
+        w = ShmRing.attach(name)
+        payload = b'z' * (400 << 10)
+        assert w.try_write(payload)
+        assert w.try_write(payload)
+        assert not w.try_write(payload)  # full: 2x400KB + headers in a 1MB ring
+        assert ring.try_read() == payload
+        assert w.try_write(payload)  # space reclaimed
+        with pytest.raises(ValueError, match='exceeds ring capacity'):
+            w.try_write(b'z' * (2 << 20))
+        w.close(); ring.close()
+
+    def test_unknown_transport_rejected(self):
+        with pytest.raises(ValueError, match='transport'):
+            ProcessPool(1, transport='carrier-pigeon')
