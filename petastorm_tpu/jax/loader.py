@@ -129,15 +129,30 @@ class JaxDataLoader(object):
                 raise ValueError('Unrecognized resume_state (expected a dict produced by '
                                  'JaxDataLoader.state_dict())')
             self._resume_rows = list(resume_state['rows'])
+            self._resume_rng = resume_state.get('buffer_rng')
         else:
             self._resume_rows = None
+            self._resume_rng = None
 
     # -- iteration ----------------------------------------------------------
 
     def __iter__(self):
+        # eager (not part of the generator body): a second iter() while rows
+        # are in flight would rebind _buffer/_pending and silently drop the
+        # first iterator's buffered rows from future state_dict() checkpoints
+        if (self._buffer is not None and self._buffer.size) or self._pending:
+            raise RuntimeError(
+                'JaxDataLoader.__iter__ called again while a previous iteration still holds '
+                'buffered rows; exhaust the previous iterator (or create a new loader) first.')
+        return self._iterate()
+
+    def _iterate(self):
         import time
         buffer = self._buffer = self._make_buffer()
         pending = self._pending = []
+        if self._resume_rng is not None and hasattr(buffer, 'rng_state'):
+            buffer.rng_state = self._resume_rng
+            self._resume_rng = None
         if self._resume_rows:
             buffer.add_many(self._resume_rows)
             self._resume_rows = None
@@ -176,6 +191,9 @@ class JaxDataLoader(object):
             batch = self._emit(list(pending))
             pending.clear()
             yield batch
+        # drop_last leftovers are intentionally dropped — clear them so an
+        # exhausted loader can be iterated again (multi-epoch pattern)
+        pending.clear()
 
     # -- checkpoint ---------------------------------------------------------
 
@@ -183,18 +201,28 @@ class JaxDataLoader(object):
         """Loader-level read-position checkpoint: the underlying reader's
         :meth:`Reader.state_dict` plus every row currently buffered client-side
         (shuffling buffer + partial batch), so no yielded-to-loader row is
-        lost. Note the state embeds those rows — with a large
-        ``shuffling_queue_capacity`` it is correspondingly large. Resume with::
+        lost, and the shuffling buffer's RNG state, so a seeded resume
+        reproduces the exact pre-checkpoint stream. Note the state embeds the
+        buffered rows — with a large ``shuffling_queue_capacity`` it is
+        correspondingly large. Resume with::
 
             reader = make_reader(url, ..., resume_state=state['reader'])
             loader = JaxDataLoader(reader, ..., resume_state=state)
         """
-        rows = []
-        if self._buffer is not None:
-            rows.extend(getattr(self._buffer, '_items', []))
-        rows.extend(self._pending)
+        if self._resume_rows is not None:
+            # resume-constructed but not yet iterated: the restored rows/RNG
+            # still await injection — re-checkpoint them, don't lose them
+            rows = list(self._resume_rows)
+            rng = self._resume_rng
+        else:
+            rows = []
+            if self._buffer is not None:
+                rows.extend(getattr(self._buffer, '_items', []))
+            rows.extend(self._pending)
+            rng = getattr(self._buffer, 'rng_state', None)
         return {'version': 1,
                 'reader': self.reader.state_dict(),
+                'buffer_rng': rng,
                 'rows': [_to_plain_row(r) for r in rows]}
 
     def _emit(self, rows):

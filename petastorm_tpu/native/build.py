@@ -38,20 +38,42 @@ def _arrow_paths():
     return include, libdirs, arrow_lib, parquet_lib
 
 
+def _source_hash(path):
+    import hashlib
+    with open(path, 'rb') as f:
+        return hashlib.sha256(f.read()).hexdigest()
+
+
 def _stamp():
     # the .so links versioned Arrow sonames with an rpath into the wheel dir:
-    # a pyarrow upgrade invalidates it even though the source didn't change
+    # a pyarrow upgrade invalidates it even though the source didn't change.
+    # The source hash (not mtime — checkout mtimes are arbitrary) invalidates
+    # it on edits.
     import pyarrow
-    return '{}:{}'.format(pyarrow.__version__, sys.version_info[:2])
+    return '{}:{}:{}'.format(pyarrow.__version__, sys.version_info[:2],
+                             _source_hash(SOURCE))
+
+
+def _shm_stamp():
+    return _source_hash(SHM_SOURCE)
 
 
 def _is_fresh():
-    if not (os.path.exists(OUTPUT) and
-            os.path.getmtime(OUTPUT) >= os.path.getmtime(SOURCE)):
+    if not os.path.exists(OUTPUT):
         return False
     try:
         with open(OUTPUT + '.stamp') as f:
             return f.read() == _stamp()
+    except OSError:
+        return False
+
+
+def _shm_is_fresh():
+    if not os.path.exists(SHM_OUTPUT):
+        return False
+    try:
+        with open(SHM_OUTPUT + '.stamp') as f:
+            return f.read() == _shm_stamp()
     except OSError:
         return False
 
@@ -98,16 +120,14 @@ def build(force=False, quiet=False):
 def build_shm(force=False, quiet=False):
     """Compile the shared-memory ring transport (no external deps). Same
     concurrency-safe temp-file + flock scheme as :func:`build`."""
-    if not force and os.path.exists(SHM_OUTPUT) and \
-            os.path.getmtime(SHM_OUTPUT) >= os.path.getmtime(SHM_SOURCE):
+    if not force and _shm_is_fresh():
         return SHM_OUTPUT
     import fcntl
     lock_path = SHM_OUTPUT + '.lock'
     with open(lock_path, 'w') as lock_file:
         fcntl.flock(lock_file, fcntl.LOCK_EX)
         try:
-            if not force and os.path.exists(SHM_OUTPUT) and \
-                    os.path.getmtime(SHM_OUTPUT) >= os.path.getmtime(SHM_SOURCE):
+            if not force and _shm_is_fresh():
                 return SHM_OUTPUT
             tmp_out = '{}.tmp.{}'.format(SHM_OUTPUT, os.getpid())
             cmd = ['g++', '-O2', '-std=c++17', '-shared', '-fPIC', SHM_SOURCE,
@@ -120,6 +140,8 @@ def build_shm(force=False, quiet=False):
                     os.unlink(tmp_out)
                 raise RuntimeError('shm ring build failed:\n' + result.stderr)
             os.replace(tmp_out, SHM_OUTPUT)
+            with open(SHM_OUTPUT + '.stamp', 'w') as f:
+                f.write(_shm_stamp())
             return SHM_OUTPUT
         finally:
             fcntl.flock(lock_file, fcntl.LOCK_UN)

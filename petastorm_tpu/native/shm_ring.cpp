@@ -91,6 +91,18 @@ void* pstpu_ring_create(const char* name, uint64_t capacity) {
     shm_unlink(name);
     return nullptr;
   }
+  // Pre-fault the whole segment NOW: ftruncate on tmpfs succeeds beyond the
+  // /dev/shm quota and the first store past it delivers SIGBUS (killing the
+  // process uncatchably). posix_fallocate reserves the blocks up front and
+  // reports exhaustion as a plain error the caller can fall back from.
+  int falloc_rc = posix_fallocate(fd, 0, static_cast<off_t>(map_len));
+  if (falloc_rc != 0 && falloc_rc != EOPNOTSUPP && falloc_rc != EINVAL) {
+    set_error(std::string("posix_fallocate failed (is /dev/shm large enough?): ") +
+              std::strerror(falloc_rc));
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
   void* mem = mmap(nullptr, map_len, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
   close(fd);
   if (mem == MAP_FAILED) {

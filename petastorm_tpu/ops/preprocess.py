@@ -30,8 +30,12 @@ _BLOCK_COLS = 512  # lanes: multiple of 128
 
 
 def _kernel(img_ref, mean_ref, inv_std_ref, out_ref):
-    # Mosaic has no direct uint8->f32 cast; widen through int32 first
-    x = img_ref[:].astype(jnp.int32).astype(jnp.float32)
+    x = img_ref[:]
+    if jnp.issubdtype(x.dtype, jnp.integer):
+        # Mosaic has no direct uint8->f32 cast; widen through int32 first.
+        # Float inputs must NOT take this path — int32 would truncate them.
+        x = x.astype(jnp.int32)
+    x = x.astype(jnp.float32)
     out_ref[:] = ((x - mean_ref[:]) * inv_std_ref[:]).astype(out_ref.dtype)
 
 

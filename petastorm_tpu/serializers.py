@@ -38,7 +38,9 @@ class ArrowTableSerializer(object):
         return self._PICKLE + pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
 
     def deserialize(self, data):
-        marker, body = data[:1], data[1:]
+        # The shm transport delivers memoryviews; bytes(...) normalizes the
+        # marker so it compares equal to the bytes constants.
+        marker, body = bytes(data[:1]), data[1:]
         if marker == self._TABLE:
             with pa.ipc.open_stream(pa.BufferReader(body)) as reader:
                 return reader.read_all()

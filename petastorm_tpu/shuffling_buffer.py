@@ -132,5 +132,15 @@ class RandomShufflingBuffer(ShufflingBufferBase):
     def size(self):
         return len(self._items)
 
+    @property
+    def rng_state(self):
+        """Picklable RNG state, for loader checkpoints: restoring it makes a
+        seeded resume reproduce the exact pre-checkpoint retrieval stream."""
+        return self._rng.bit_generator.state
+
+    @rng_state.setter
+    def rng_state(self, state):
+        self._rng.bit_generator.state = state
+
     def finish(self):
         self._done_adding = True

@@ -89,6 +89,10 @@ class ProcessPool(object):
         self._completed_items = 0
         self._stopped = False
         self._ipc_dir = None
+        # The C++ ring is strictly single-consumer; this lock serializes the
+        # get_results() poll loop against the join() drain so two threads never
+        # race pstpu_ring_read on the same ring.
+        self._ring_lock = threading.Lock()
         # checkpoint plumbing (see thread_pool.py): messages carry the item seq
         self.last_result_seq = None
         self.done_callback = None
@@ -96,6 +100,29 @@ class ProcessPool(object):
     @property
     def transport(self):
         return self._transport
+
+    def _create_rings(self, ring_names):
+        from petastorm_tpu.native.shm_ring import ShmRing
+        # Rings smaller than requested would break the "one serialized
+        # row-group payload must fit" invariant mid-run, so when /dev/shm
+        # cannot hold full-size rings (docker often caps it at 64MB) we bail
+        # out here and let the caller fall back to zmq instead.
+        try:
+            st = os.statvfs('/dev/shm')
+            avail = st.f_bavail * st.f_frsize
+        except OSError:
+            # statvfs unavailable: proceed; the pre-faulting create still
+            # surfaces exhaustion as a catchable error
+            avail = None
+        if avail is not None and self._ring_bytes * self._workers_count > avail * 0.9:
+            raise OSError(
+                '/dev/shm has {} bytes free; {} rings of {} bytes will not fit'.format(
+                    avail, self._workers_count, self._ring_bytes))
+        run_id = uuid.uuid4().hex[:12]
+        for worker_id in range(self._workers_count):
+            name = '/pstpu_{}_{}_{}'.format(os.getpid(), run_id, worker_id)
+            self._rings.append(ShmRing.create(name, self._ring_bytes))
+            ring_names[worker_id] = name
 
     @property
     def workers_count(self):
@@ -120,13 +147,19 @@ class ProcessPool(object):
         ring_names = [None] * self._workers_count
         self._results_receive = None
         if self._transport == 'shm':
-            from petastorm_tpu.native.shm_ring import ShmRing
-            run_id = uuid.uuid4().hex[:12]
-            for worker_id in range(self._workers_count):
-                name = '/pstpu_{}_{}_{}'.format(os.getpid(), run_id, worker_id)
-                self._rings.append(ShmRing.create(name, self._ring_bytes))
-                ring_names[worker_id] = name
-        else:
+            try:
+                self._create_rings(ring_names)
+            except OSError as e:
+                # /dev/shm too small for the requested rings (surfaced as a
+                # catchable error by the pre-faulting create, not SIGBUS):
+                # degrade to the zmq transport rather than dying later.
+                logger.warning('shm ring allocation failed (%s); falling back to zmq transport', e)
+                for ring in self._rings:
+                    ring.close()
+                self._rings = []
+                ring_names = [None] * self._workers_count
+                self._transport = 'zmq'
+        if self._transport == 'zmq':
             self._results_receive = self._context.socket(zmq.PULL)
             self._results_receive.setsockopt(zmq.RCVHWM, self._results_hwm)
             self._results_receive.bind(result_addr)
@@ -173,10 +206,11 @@ class ProcessPool(object):
         deadline = time.monotonic() + timeout_ms / 1000.0
         sleep_s = 0.0002
         while True:
-            for ring in self._rings:
-                view = ring.try_read_view()
-                if view is not None:
-                    return _ring_unpack(view)
+            with self._ring_lock:
+                for ring in self._rings:
+                    view = ring.try_read_view()
+                    if view is not None:
+                        return _ring_unpack(view)
             if time.monotonic() >= deadline:
                 return None
             # exponential backoff to 2ms: a sleeping consumer leaves the cores
@@ -243,9 +277,10 @@ class ProcessPool(object):
                 while self._results_receive.poll(0):
                     self._results_receive.recv_multipart()
             else:
-                for ring in self._rings:
-                    while ring.try_read() is not None:
-                        pass
+                with self._ring_lock:
+                    for ring in self._rings:
+                        while ring.try_read() is not None:
+                            pass
             time.sleep(0.05)
         for p in self._processes:
             if p.is_alive():

@@ -30,6 +30,16 @@ def test_normalize_pallas_matches_reference(shape, rng):
                                rtol=1e-5, atol=1e-5)
 
 
+def test_normalize_pallas_float_input_not_truncated(rng):
+    # Regression: the kernel used to widen through int32 unconditionally,
+    # flattening fractional float inputs to -1.0 (advisor finding r1).
+    images = rng.random((2, 8, 128, 3)).astype(np.float32)  # values in [0, 1)
+    out = normalize_images(jnp.asarray(images), 0.5, 0.5, out_dtype=jnp.float32,
+                           interpret=True)
+    np.testing.assert_allclose(np.asarray(out), _reference(images, 0.5, 0.5),
+                               rtol=1e-5, atol=1e-5)
+
+
 def test_normalize_jnp_fallback_matches_reference(rng):
     images = rng.integers(0, 256, (3, 16, 24, 3), dtype=np.uint8)
     out = normalize_images(jnp.asarray(images), MEAN, STD, out_dtype=jnp.float32,

@@ -75,3 +75,19 @@ class TestRandom:
         out = np.array([buf.retrieve() for _ in range(n)])
         corr = np.corrcoef(np.arange(n), out)[0, 1]
         assert abs(corr) < 0.1
+
+    def test_rng_state_restore_reproduces_retrieval_order(self):
+        # loader checkpoints save/restore this mid-stream: restoring the state
+        # must replay the exact retrieval sequence from that point on
+        buf = RandomShufflingBuffer(50, min_after_retrieve=1, extra_capacity=100, seed=9)
+        buf.add_many(range(40))
+        for _ in range(10):
+            buf.retrieve()
+        snapshot_state = buf.rng_state
+        snapshot_items = list(buf._items)
+        expected = [buf.retrieve() for _ in range(10)]
+
+        replay = RandomShufflingBuffer(50, min_after_retrieve=1, extra_capacity=100, seed=9)
+        replay.add_many(snapshot_items)
+        replay.rng_state = snapshot_state
+        assert [replay.retrieve() for _ in range(10)] == expected

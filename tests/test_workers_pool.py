@@ -141,11 +141,12 @@ class TestProcessPool:
             _drain(pool)
         pool.stop(); pool.join()
 
-    def test_arrow_table_serializer(self):
+    @pytest.mark.parametrize('transport', ['shm', 'zmq'])
+    def test_arrow_table_serializer(self, transport):
         import pyarrow as pa
         from petastorm_tpu.test_util.stub_workers import ArrowTableWorker
 
-        pool = ProcessPool(1, serializer=ArrowTableSerializer())
+        pool = ProcessPool(1, serializer=ArrowTableSerializer(), transport=transport)
         pool.start(ArrowTableWorker)
         pool.ventilate(5)
         table = pool.get_results()
@@ -163,6 +164,15 @@ def test_serializers_roundtrip():
     t = pa.table({'x': np.arange(10), 'y': ['a'] * 10})
     out = s.deserialize(s.serialize(t))
     assert out.equals(t)
+    # The shm transport hands deserialize a memoryview, not bytes — both the
+    # table and the pickle-fallback branches must still dispatch correctly.
+    for payload in (t, {'a': 1}):
+        blob = memoryview(s.serialize(payload))
+        out = s.deserialize(blob)
+        if isinstance(payload, pa.Table):
+            assert out.equals(payload)
+        else:
+            assert out == payload
 
 
 class TestProcessPoolTransports:
