@@ -365,6 +365,37 @@ class CompressedImageCodec(DataFieldCodec):
             image = cv2.cvtColor(image, cv2.COLOR_BGR2RGB)
         return image.astype(np.dtype(field.numpy_dtype), copy=False)
 
+    def decode_batch(self, field, encoded_list):
+        """Decode a whole column of image cells in one native call (GIL
+        released, pixels land in numpy memory in RGB order with no BGR swap
+        pass) — the batched replacement for the reference's per-image loop
+        (reference codecs.py:92-111). Unsupported flavors (palette/alpha PNG,
+        CMYK JPEG) fall back to the per-image OpenCV path; ``None`` cells
+        (nullable fields) pass through."""
+        from petastorm_tpu.native import image_codec
+
+        present = [(i, v) for i, v in enumerate(encoded_list) if v is not None]
+        out = [None] * len(encoded_list)
+        if not present:
+            return out
+        if image_codec.is_available():
+            try:
+                decoded = image_codec.decode_images([v for _, v in present])
+            except (image_codec.NativeDecodeError, MemoryError):
+                # MemoryError: a corrupt header can claim huge dims and blow
+                # the output allocation; retry per-image like any other bad cell
+                decoded = None
+        else:
+            decoded = None
+        if decoded is None:
+            decoded = [self.decode(field, v) for _, v in present]
+        else:
+            dtype = np.dtype(field.numpy_dtype)
+            decoded = [img.astype(dtype, copy=False) for img in decoded]
+        for (i, _), img in zip(present, decoded):
+            out[i] = img
+        return out
+
     def arrow_type(self, field):
         return pa.binary()
 

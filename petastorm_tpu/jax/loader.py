@@ -152,10 +152,12 @@ class JaxDataLoader(object):
         pending = self._pending = []
         if self._resume_rng is not None and hasattr(buffer, 'rng_state'):
             buffer.rng_state = self._resume_rng
-            self._resume_rng = None
+        self._resume_rng = None
         if self._resume_rows:
             buffer.add_many(self._resume_rows)
-            self._resume_rows = None
+        # clear even when empty: a leftover [] would permanently re-route
+        # state_dict() to the (now stale) resume branch
+        self._resume_rows = None
         self._iter_start = time.perf_counter()
         self._reader_wait_s = 0.0
         self._rows_out = 0

@@ -19,6 +19,8 @@ SOURCE = os.path.join(_HERE, 'rowgroup_reader.cpp')
 OUTPUT = os.path.join(_HERE, 'libpstpu.so')
 SHM_SOURCE = os.path.join(_HERE, 'shm_ring.cpp')
 SHM_OUTPUT = os.path.join(_HERE, 'libpstpu_shm.so')
+IMG_SOURCE = os.path.join(_HERE, 'image_codec.cpp')
+IMG_OUTPUT = os.path.join(_HERE, 'libpstpu_img.so')
 
 
 def _arrow_paths():
@@ -58,6 +60,10 @@ def _shm_stamp():
     return _source_hash(SHM_SOURCE)
 
 
+def _img_stamp():
+    return _source_hash(IMG_SOURCE)
+
+
 def _is_fresh():
     if not os.path.exists(OUTPUT):
         return False
@@ -78,73 +84,84 @@ def _shm_is_fresh():
         return False
 
 
-def build(force=False, quiet=False):
-    """Compile the kernel if missing or stale. Returns the .so path.
+def _img_is_fresh():
+    if not os.path.exists(IMG_OUTPUT):
+        return False
+    try:
+        with open(IMG_OUTPUT + '.stamp') as f:
+            return f.read() == _img_stamp()
+    except OSError:
+        return False
+
+
+def _build_target(output, stamp_fn, make_cmd, label, is_fresh, force, quiet):
+    """Shared concurrency-safe build scheme for every native target.
 
     Safe under concurrency (spawned worker processes may all trigger the first
     build): compilation goes to a per-pid temp file that is atomically renamed
     into place — a process that already dlopen'ed the old .so keeps its mapped
-    inode — and an flock serializes the g++ runs so only one compiles."""
-    if not force and _is_fresh():
-        return OUTPUT
+    inode — and an flock serializes the g++ runs so only one compiles.
+    ``make_cmd`` is called under the lock (it may probe the environment, e.g.
+    pyarrow paths) and returns the full compiler argv ending in the temp path.
+    """
+    if not force and is_fresh():
+        return output
     import fcntl
-    lock_path = OUTPUT + '.lock'
+    lock_path = output + '.lock'
     with open(lock_path, 'w') as lock_file:
         fcntl.flock(lock_file, fcntl.LOCK_EX)
         try:
-            if not force and _is_fresh():  # another process built while we waited
-                return OUTPUT
-            include, libdirs, arrow_lib, parquet_lib = _arrow_paths()
-            tmp_out = '{}.tmp.{}'.format(OUTPUT, os.getpid())
-            cmd = ['g++', '-O2', '-std=c++20', '-shared', '-fPIC', SOURCE,
-                   '-I{}'.format(include)]
-            for d in libdirs:
-                cmd += ['-L{}'.format(d), '-Wl,-rpath,{}'.format(d)]
-            cmd += ['-l:{}'.format(arrow_lib), '-l:{}'.format(parquet_lib),
-                    '-o', tmp_out]
+            if not force and is_fresh():  # another process built while we waited
+                return output
+            tmp_out = '{}.tmp.{}'.format(output, os.getpid())
+            cmd = make_cmd(tmp_out)
             if not quiet:
-                print('building native kernel:', ' '.join(cmd))
+                print('building {}:'.format(label), ' '.join(cmd))
             result = subprocess.run(cmd, capture_output=True, text=True)
             if result.returncode != 0:
                 if os.path.exists(tmp_out):
                     os.unlink(tmp_out)
-                raise RuntimeError('native kernel build failed:\n' + result.stderr)
-            os.replace(tmp_out, OUTPUT)
-            with open(OUTPUT + '.stamp', 'w') as f:
-                f.write(_stamp())
-            return OUTPUT
+                raise RuntimeError('{} build failed:\n{}'.format(label, result.stderr))
+            os.replace(tmp_out, output)
+            with open(output + '.stamp', 'w') as f:
+                f.write(stamp_fn())
+            return output
         finally:
             fcntl.flock(lock_file, fcntl.LOCK_UN)
+
+
+def build(force=False, quiet=False):
+    """Compile the row-group reader kernel against the pyarrow wheel's Arrow
+    C++ libraries. Returns the .so path."""
+    def make_cmd(tmp_out):
+        include, libdirs, arrow_lib, parquet_lib = _arrow_paths()
+        cmd = ['g++', '-O2', '-std=c++20', '-shared', '-fPIC', SOURCE,
+               '-I{}'.format(include)]
+        for d in libdirs:
+            cmd += ['-L{}'.format(d), '-Wl,-rpath,{}'.format(d)]
+        return cmd + ['-l:{}'.format(arrow_lib), '-l:{}'.format(parquet_lib),
+                      '-o', tmp_out]
+
+    return _build_target(OUTPUT, _stamp, make_cmd, 'native kernel', _is_fresh, force, quiet)
 
 
 def build_shm(force=False, quiet=False):
-    """Compile the shared-memory ring transport (no external deps). Same
-    concurrency-safe temp-file + flock scheme as :func:`build`."""
-    if not force and _shm_is_fresh():
-        return SHM_OUTPUT
-    import fcntl
-    lock_path = SHM_OUTPUT + '.lock'
-    with open(lock_path, 'w') as lock_file:
-        fcntl.flock(lock_file, fcntl.LOCK_EX)
-        try:
-            if not force and _shm_is_fresh():
-                return SHM_OUTPUT
-            tmp_out = '{}.tmp.{}'.format(SHM_OUTPUT, os.getpid())
-            cmd = ['g++', '-O2', '-std=c++17', '-shared', '-fPIC', SHM_SOURCE,
-                   '-o', tmp_out]
-            if not quiet:
-                print('building shm ring:', ' '.join(cmd))
-            result = subprocess.run(cmd, capture_output=True, text=True)
-            if result.returncode != 0:
-                if os.path.exists(tmp_out):
-                    os.unlink(tmp_out)
-                raise RuntimeError('shm ring build failed:\n' + result.stderr)
-            os.replace(tmp_out, SHM_OUTPUT)
-            with open(SHM_OUTPUT + '.stamp', 'w') as f:
-                f.write(_shm_stamp())
-            return SHM_OUTPUT
-        finally:
-            fcntl.flock(lock_file, fcntl.LOCK_UN)
+    """Compile the shared-memory ring transport (no external deps)."""
+    def make_cmd(tmp_out):
+        return ['g++', '-O2', '-std=c++17', '-shared', '-fPIC', SHM_SOURCE, '-o', tmp_out]
+
+    return _build_target(SHM_OUTPUT, _shm_stamp, make_cmd, 'shm ring', _shm_is_fresh,
+                         force, quiet)
+
+
+def build_img(force=False, quiet=False):
+    """Compile the batched image decoder against the system libjpeg/libpng/libdeflate."""
+    def make_cmd(tmp_out):
+        return ['g++', '-O3', '-std=c++17', '-shared', '-fPIC', IMG_SOURCE,
+                '-ljpeg', '-lpng16', '-ldeflate', '-o', tmp_out]
+
+    return _build_target(IMG_OUTPUT, _img_stamp, make_cmd, 'image codec', _img_is_fresh,
+                         force, quiet)
 
 
 if __name__ == '__main__':
@@ -152,3 +169,5 @@ if __name__ == '__main__':
     print('built', OUTPUT)
     build_shm(force='--force' in sys.argv)
     print('built', SHM_OUTPUT)
+    build_img(force='--force' in sys.argv)
+    print('built', IMG_OUTPUT)

@@ -168,7 +168,11 @@ class RowGroupDecoderWorker(WorkerBase):
             field = schema.fields[name]
             col = columns[name]
             codec = field.codec
-            decoded_cols[name] = [None if v is None else codec.decode(field, v) for v in col]
+            if hasattr(codec, 'decode_batch'):
+                # whole-column native decode (one GIL-released call per column)
+                decoded_cols[name] = codec.decode_batch(field, col)
+            else:
+                decoded_cols[name] = [None if v is None else codec.decode(field, v) for v in col]
         return [{name: decoded_cols[name][i] for name in column_names} for i in range(n)]
 
     def _load_rows(self, piece, column_names, shuffle_row_drop_partition=None):

@@ -345,6 +345,31 @@ def test_jax_loader_state_dict_before_resume_iteration_preserves_rows(synthetic_
     assert state2['buffer_rng'] == state['buffer_rng']
 
 
+def test_jax_loader_resume_with_empty_rows_then_checkpoint(synthetic_dataset):
+    # a checkpoint with zero buffered rows must not leave the resumed loader's
+    # state_dict() permanently stuck on the (empty) resume branch
+    from petastorm_tpu.jax import JaxDataLoader
+
+    reader = make_reader(synthetic_dataset.url, schema_fields=['id'],
+                         reader_pool_type='dummy', seed=11)
+    loader = JaxDataLoader(reader, batch_size=10)  # no shuffle buffer: rows=[]
+    state = loader.state_dict()
+    reader.stop(); reader.join()
+    assert state['rows'] == []
+
+    r2 = make_reader(synthetic_dataset.url, schema_fields=['id'],
+                     reader_pool_type='dummy', seed=11, resume_state=state['reader'])
+    with JaxDataLoader(r2, batch_size=10, shuffling_queue_capacity=30, seed=11,
+                       resume_state=state) as resumed:
+        it = iter(resumed)
+        next(it)
+        state2 = resumed.state_dict()
+    # the mid-iteration checkpoint must reflect the live buffer, not the
+    # stale empty resume state
+    assert state2['rows']
+    assert state2['buffer_rng'] is not None
+
+
 def test_jax_loader_seeded_resume_is_deterministic(synthetic_dataset):
     # the checkpoint carries the shuffling buffer's mid-stream RNG state
     # (state['buffer_rng']); two resumes from the same state must replay the
