@@ -1,0 +1,171 @@
+"""bench_duty.py — the north-star duty-cycle benchmark as one command.
+
+Builds a synthetic ImageNet-Parquet store (photo-like PNGs), runs a REAL jitted
+ResNet-50 bf16 train step on whatever device is present, and measures how much
+wall time the step loop spends blocked on input (`pipeline_duty_cycle`,
+BASELINE.md methodology). Variants isolate where the host budget goes:
+
+  png        PNG decode + resize transform on the host (the baseline config)
+  raw        pre-resized uint8 NdarrayCodec store — the decode-free ceiling
+  png_cached second epoch with a pre-filled local-disk cache (cache stores
+             decoded rows, so PNG decode is skipped; resize still runs)
+
+Emits one JSON line per variant:
+  {"metric": "duty_cycle_<variant>", "examples_per_sec": ..,
+   "input_stall_fraction": .., "host_cores": .., "device": ..}
+
+Usage: python bench_duty.py [--steps 30] [--batch-size 64] [--image-size 160]
+                            [--variants png,raw,png_cached] [--num-classes 1000]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import zlib
+
+import numpy as np
+
+REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+
+def build_png_store(url, rows, seed=0):
+    from examples.imagenet.generate_petastorm_imagenet import generate_synthetic_imagenet
+    images_per_synset = 32
+    generate_synthetic_imagenet(url, num_synsets=max(1, rows // images_per_synset),
+                                images_per_synset=images_per_synset,
+                                rows_per_row_group=16)
+
+
+def build_raw_store(url, rows, image_size, num_classes, seed=0):
+    """Pre-resized uint8 tensors + integer labels: zero host decode work."""
+    from examples.imagenet.generate_petastorm_imagenet import synthetic_image
+    from petastorm_tpu.codecs import NdarrayCodec, ScalarCodec
+    from petastorm_tpu.etl.dataset_metadata import materialize_dataset
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+
+    schema = Unischema('RawImagenet', [
+        UnischemaField('image', np.uint8, (image_size, image_size, 3), NdarrayCodec(), False),
+        UnischemaField('label', np.int64, (), ScalarCodec(np.int64), False),
+    ])
+    rng = np.random.default_rng(seed)
+    with materialize_dataset(url, schema, rows_per_row_group=64) as writer:
+        for i in range(rows):
+            writer.write({'image': synthetic_image(rng, image_size, image_size),
+                          'label': int(i % num_classes)})
+    return schema
+
+
+def make_step(image_size, num_classes, seed=0):
+    import jax
+    import jax.numpy as jnp
+
+    from examples.imagenet.jax_resnet_example import device_preprocess
+    from petastorm_tpu.models import resnet50
+    from petastorm_tpu.models.train import create_train_state, make_train_step
+
+    model = resnet50(num_classes=num_classes, dtype=jnp.bfloat16)
+    state = create_train_state(model, jax.random.PRNGKey(seed),
+                               jnp.zeros((1, image_size, image_size, 3)))
+    state = jax.device_put(state, jax.devices()[0])
+    train_step = make_train_step(donate=False, preprocess_fn=device_preprocess,
+                                 preprocess_seed=seed)
+    holder = {'state': state}
+
+    def step_fn(images, labels):
+        holder['state'], metrics = train_step(holder['state'], images, labels)
+        return metrics['loss']
+
+    return step_fn
+
+
+def run_variant(variant, args, png_url, raw_url, tmpdir):
+    from examples.imagenet.jax_resnet_example import make_transform
+    from petastorm_tpu import make_reader
+    from petastorm_tpu.tools.throughput import pipeline_duty_cycle
+
+    step_fn = make_step(args.image_size, args.num_classes)
+    reader_kwargs = {'seed': 7, 'shuffle_row_groups': True,
+                     'workers_count': args.workers}
+    if variant in ('png', 'png_cached'):
+        url = png_url
+        reader_kwargs['transform_spec'] = make_transform(args.image_size, args.num_classes)
+        batch_to_args = lambda b: (b['image'], b['label'])  # noqa: E731
+    elif variant == 'raw':
+        url = raw_url
+        batch_to_args = lambda b: (b['image'], b['label'])  # noqa: E731
+    else:
+        raise ValueError(variant)
+
+    if variant == 'png_cached':
+        cache_dir = os.path.join(tmpdir, 'disk_cache')
+        reader_kwargs.update({'cache_type': 'local-disk', 'cache_location': cache_dir,
+                              'cache_size_limit': 10 << 30,
+                              'cache_row_size_estimate': 200 << 10})
+        # pre-fill: one full epoch populates the decoded-row cache, so the
+        # measured pass below behaves like every epoch after the first
+        with make_reader(url, num_epochs=1, **reader_kwargs) as reader:
+            for _ in reader:
+                pass
+
+    res = pipeline_duty_cycle(
+        url, step_fn, batch_to_args, batch_size=args.batch_size, steps=args.steps,
+        warmup_steps=args.warmup_steps, reader_kwargs=reader_kwargs,
+        loader_kwargs={'shuffling_queue_capacity': 512, 'seed': 7})
+    return res
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('--steps', type=int, default=30)
+    parser.add_argument('--warmup-steps', type=int, default=5)
+    parser.add_argument('--batch-size', type=int, default=64)
+    parser.add_argument('--image-size', type=int, default=160)
+    parser.add_argument('--num-classes', type=int, default=1000)
+    parser.add_argument('--rows', type=int, default=256)
+    parser.add_argument('--workers', type=int, default=max(1, os.cpu_count() or 1))
+    parser.add_argument('--variants', default='png,raw,png_cached')
+    parser.add_argument('--keep-dir', default=None,
+                        help='reuse/keep the dataset dir (default: fresh tempdir)')
+    args = parser.parse_args(argv)
+
+    import jax
+    device = str(jax.devices()[0].platform)
+
+    tmpdir = args.keep_dir or tempfile.mkdtemp(prefix='bench_duty_')
+    png_dir = os.path.join(tmpdir, 'imagenet_png')
+    raw_dir = os.path.join(tmpdir, 'imagenet_raw')
+    png_url, raw_url = 'file://' + png_dir, 'file://' + raw_dir
+    variants = [v.strip() for v in args.variants.split(',') if v.strip()]
+    try:
+        if not os.path.exists(png_dir) and any(v.startswith('png') for v in variants):
+            build_png_store(png_url, args.rows)
+        if not os.path.exists(raw_dir) and 'raw' in variants:
+            build_raw_store(raw_url, args.rows, args.image_size, args.num_classes)
+
+        for variant in variants:
+            res = run_variant(variant, args, png_url, raw_url, tmpdir)
+            print(json.dumps({
+                'metric': 'duty_cycle_{}'.format(variant),
+                'examples_per_sec': round(res.samples_per_second, 1),
+                'input_stall_fraction': round(res.input_stall_fraction, 4),
+                'duty_cycle': round(1 - res.input_stall_fraction, 4),
+                'host_cores': os.cpu_count(),
+                'device': device,
+                'batch_size': args.batch_size,
+                'image_size': args.image_size,
+                'steps': args.steps,
+            }), flush=True)
+    finally:
+        if args.keep_dir is None:
+            shutil.rmtree(tmpdir, ignore_errors=True)
+
+
+if __name__ == '__main__':
+    main()

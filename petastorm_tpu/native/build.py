@@ -64,37 +64,17 @@ def _img_stamp():
     return _source_hash(IMG_SOURCE)
 
 
-def _is_fresh():
-    if not os.path.exists(OUTPUT):
+def _target_is_fresh(output, stamp_fn):
+    if not os.path.exists(output):
         return False
     try:
-        with open(OUTPUT + '.stamp') as f:
-            return f.read() == _stamp()
+        with open(output + '.stamp') as f:
+            return f.read() == stamp_fn()
     except OSError:
         return False
 
 
-def _shm_is_fresh():
-    if not os.path.exists(SHM_OUTPUT):
-        return False
-    try:
-        with open(SHM_OUTPUT + '.stamp') as f:
-            return f.read() == _shm_stamp()
-    except OSError:
-        return False
-
-
-def _img_is_fresh():
-    if not os.path.exists(IMG_OUTPUT):
-        return False
-    try:
-        with open(IMG_OUTPUT + '.stamp') as f:
-            return f.read() == _img_stamp()
-    except OSError:
-        return False
-
-
-def _build_target(output, stamp_fn, make_cmd, label, is_fresh, force, quiet):
+def _build_target(output, stamp_fn, make_cmd, label, force, quiet):
     """Shared concurrency-safe build scheme for every native target.
 
     Safe under concurrency (spawned worker processes may all trigger the first
@@ -104,14 +84,14 @@ def _build_target(output, stamp_fn, make_cmd, label, is_fresh, force, quiet):
     ``make_cmd`` is called under the lock (it may probe the environment, e.g.
     pyarrow paths) and returns the full compiler argv ending in the temp path.
     """
-    if not force and is_fresh():
+    if not force and _target_is_fresh(output, stamp_fn):
         return output
     import fcntl
     lock_path = output + '.lock'
     with open(lock_path, 'w') as lock_file:
         fcntl.flock(lock_file, fcntl.LOCK_EX)
         try:
-            if not force and is_fresh():  # another process built while we waited
+            if not force and _target_is_fresh(output, stamp_fn):  # built while we waited
                 return output
             tmp_out = '{}.tmp.{}'.format(output, os.getpid())
             cmd = make_cmd(tmp_out)
@@ -142,7 +122,7 @@ def build(force=False, quiet=False):
         return cmd + ['-l:{}'.format(arrow_lib), '-l:{}'.format(parquet_lib),
                       '-o', tmp_out]
 
-    return _build_target(OUTPUT, _stamp, make_cmd, 'native kernel', _is_fresh, force, quiet)
+    return _build_target(OUTPUT, _stamp, make_cmd, 'native kernel', force, quiet)
 
 
 def build_shm(force=False, quiet=False):
@@ -150,8 +130,7 @@ def build_shm(force=False, quiet=False):
     def make_cmd(tmp_out):
         return ['g++', '-O2', '-std=c++17', '-shared', '-fPIC', SHM_SOURCE, '-o', tmp_out]
 
-    return _build_target(SHM_OUTPUT, _shm_stamp, make_cmd, 'shm ring', _shm_is_fresh,
-                         force, quiet)
+    return _build_target(SHM_OUTPUT, _shm_stamp, make_cmd, 'shm ring', force, quiet)
 
 
 def build_img(force=False, quiet=False):
@@ -160,8 +139,7 @@ def build_img(force=False, quiet=False):
         return ['g++', '-O3', '-std=c++17', '-shared', '-fPIC', IMG_SOURCE,
                 '-ljpeg', '-lpng16', '-ldeflate', '-o', tmp_out]
 
-    return _build_target(IMG_OUTPUT, _img_stamp, make_cmd, 'image codec', _img_is_fresh,
-                         force, quiet)
+    return _build_target(IMG_OUTPUT, _img_stamp, make_cmd, 'image codec', force, quiet)
 
 
 if __name__ == '__main__':
@@ -169,5 +147,10 @@ if __name__ == '__main__':
     print('built', OUTPUT)
     build_shm(force='--force' in sys.argv)
     print('built', SHM_OUTPUT)
-    build_img(force='--force' in sys.argv)
-    print('built', IMG_OUTPUT)
+    try:
+        # optional at runtime (codecs fall back to OpenCV), so a host without
+        # the png/jpeg/deflate dev libraries must not fail the prebuild step
+        build_img(force='--force' in sys.argv)
+        print('built', IMG_OUTPUT)
+    except RuntimeError as e:
+        print('image codec skipped (optional): {}'.format(e))

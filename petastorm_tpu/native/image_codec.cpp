@@ -224,8 +224,13 @@ void unfilter_paeth(const uint8_t* src, const uint8_t* prev, uint8_t* cur, uint6
 #if defined(__SSE2__)
   if (BPP == 3 && rowbytes >= 8) {
     const uint64_t n_px = rowbytes / 3;
-    __m128i a = load_px4(cur);
-    __m128i c = load_px4(prev);
+    // zero-padded temp: cur[3] is not written yet, and prev[3] belongs to the
+    // next pixel — loading them directly would read uninitialized/irrelevant
+    // bytes into lane 3 (harmless for the math, but UB and MSan-hostile)
+    uint8_t first_px[4] = {cur[0], cur[1], cur[2], 0};
+    uint8_t first_prev[4] = {prev[0], prev[1], prev[2], 0};
+    __m128i a = load_px4(first_px);
+    __m128i c = load_px4(first_prev);
     // stop one pixel early: the 4-byte loads/stores of the vector path would
     // touch one byte past the row at the final pixel
     for (uint64_t px = 1; px + 1 < n_px; px++) {
