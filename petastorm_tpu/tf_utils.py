@@ -1,9 +1,13 @@
 """TensorFlow adapter — capability parity with the reference's ``tf_utils``
 (/root/reference/petastorm/tf_utils.py): numpy->tf dtype promotion (:27-44),
 value sanitization (:58-97), ``make_petastorm_dataset`` via
-``tf.data.Dataset.from_generator`` (:348-402). The graph-mode ``tf_tensors``
-py_func pump is intentionally not reproduced — it exists for TF1 sessions; this
-framework targets eager tf.data only (and, primarily, the JAX loader).
+``tf.data.Dataset.from_generator`` (:348-402), NGram flattening to
+per-timestep namedtuples (:141-183,254-286), and client-side shuffling
+(:201-219 — the TF1 ``tf.RandomShuffleQueue`` is replaced by the framework's
+seedable shuffling buffer inside the generator; batched readers refuse it,
+:327-331). The graph-mode ``tf_tensors`` py_func pump is intentionally not
+reproduced — it exists for TF1 sessions; this framework targets eager tf.data
+only (and, primarily, the JAX loader).
 
 TensorFlow is imported lazily so the rest of the framework works without it.
 """
@@ -49,43 +53,95 @@ def _sanitize_field_value(value):
     return value
 
 
-def make_petastorm_dataset(reader):
-    """Wrap a reader in a ``tf.data.Dataset`` yielding row namedtuples (or
-    column-batch namedtuples for batched readers), reference tf_utils.py:348-402."""
-    tf = _tf()
+def _tf_spec(tf, field, batched):
+    """TensorSpec for one field, applying the reference's dtype promotions."""
+    if field.numpy_dtype is Decimal or field.numpy_dtype in (np.str_, np.bytes_):
+        tf_dtype = tf.string
+    elif field.numpy_dtype is np.datetime64:
+        tf_dtype = tf.int64
+    elif np.dtype(field.numpy_dtype) == np.uint16:
+        tf_dtype = tf.int32
+    elif np.dtype(field.numpy_dtype) == np.uint32:
+        tf_dtype = tf.int64
+    else:
+        tf_dtype = tf.as_dtype(np.dtype(field.numpy_dtype))
+    shape = field.shape
+    if batched:
+        shape = (None,) + tuple(shape or ())
+    return tf.TensorSpec(shape=shape, dtype=tf_dtype)
 
-    if getattr(reader, 'ngram', None) is not None:
-        raise NotImplementedError(
-            'NGram readers are not supported by make_petastorm_dataset (the reference '
-            'tf adapter refuses too, tf_utils.py:404); use the JAX loader, which batches '
-            'NGram windows natively.')
+
+def _shuffled(reader, shuffle_buffer_size, seed):
+    """Iterate the reader through a seedable client-side shuffling buffer —
+    the eager replacement for the reference's TF1 ``tf.RandomShuffleQueue``
+    (tf_utils.py:201-219)."""
+    from petastorm_tpu.shuffling_buffer import RandomShufflingBuffer
+
+    buf = RandomShufflingBuffer(shuffle_buffer_size,
+                                min_after_retrieve=max(1, shuffle_buffer_size // 2),
+                                extra_capacity=max(1000, shuffle_buffer_size), seed=seed)
+    for item in reader:
+        buf.add_many([item])
+        while buf.can_retrieve():
+            yield buf.retrieve()
+    buf.finish()
+    while buf.can_retrieve():
+        yield buf.retrieve()
+
+
+def make_petastorm_dataset(reader, shuffle_buffer_size=0, seed=None):
+    """Wrap a reader in a ``tf.data.Dataset`` (reference tf_utils.py:348-402).
+
+    Elements are row namedtuples; column-batch namedtuples for batched readers;
+    for NGram readers, dicts of ``offset -> per-timestep namedtuple`` (the
+    reference's NGram flattening, tf_utils.py:141-183,254-286).
+
+    ``shuffle_buffer_size > 0`` decorrelates rows with the framework's seedable
+    shuffling buffer before they enter the TF graph; batched readers reject it
+    because whole row groups would shuffle as units (reference
+    tf_utils.py:327-331).
+    """
+    tf = _tf()
+    ngram = getattr(reader, 'ngram', None)
+
+    if shuffle_buffer_size and reader.batched_output:
+        raise ValueError(
+            'shuffle_buffer_size is not supported with batched readers: whole row-group '
+            'batches would shuffle as units (reference tf_utils.py:327-331). Shuffle via '
+            'make_reader shuffle_row_groups/shuffle_row_drop_partitions, or use '
+            'dataset.unbatch().shuffle(...).')
     schema = reader.transformed_schema
 
+    def rows():
+        if shuffle_buffer_size:
+            return _shuffled(reader, shuffle_buffer_size, seed)
+        return iter(reader)
+
+    if ngram is not None:
+        offsets = sorted(ngram.fields)
+        views = {off: ngram.get_schema_at_timestep(schema, off) for off in offsets}
+        signature = {off: tuple(_tf_spec(tf, views[off].fields[n], False)
+                                for n in views[off].fields)
+                     for off in offsets}
+
+        def generator():
+            for window in rows():
+                yield {off: tuple(_sanitize_field_value(v) for v in window[off])
+                       for off in offsets}
+
+        dataset = tf.data.Dataset.from_generator(generator, output_signature=signature)
+        view_namedtuples = {off: views[off].namedtuple for off in offsets}
+        return dataset.map(
+            lambda window: {off: view_namedtuples[off](*window[off]) for off in offsets})
+
+    signature = tuple(_tf_spec(tf, schema.fields[name], reader.batched_output)
+                      for name in schema.fields)
+
     def generator():
-        for item in reader:
+        for item in rows():
             yield tuple(_sanitize_field_value(v) for v in item)
 
-    # derive output signature from one sample row (shapes with None wildcards)
-    field_names = list(schema.fields)
-    signature = []
-    for name in field_names:
-        field = schema.fields[name]
-        if field.numpy_dtype is Decimal or field.numpy_dtype in (np.str_, np.bytes_):
-            tf_dtype = tf.string
-        elif field.numpy_dtype is np.datetime64:
-            tf_dtype = tf.int64
-        elif np.dtype(field.numpy_dtype) == np.uint16:
-            tf_dtype = tf.int32
-        elif np.dtype(field.numpy_dtype) == np.uint32:
-            tf_dtype = tf.int64
-        else:
-            tf_dtype = tf.as_dtype(np.dtype(field.numpy_dtype))
-        shape = field.shape
-        if reader.batched_output:
-            shape = (None,) + tuple(shape or ())
-        signature.append(tf.TensorSpec(shape=shape, dtype=tf_dtype))
-
-    dataset = tf.data.Dataset.from_generator(generator, output_signature=tuple(signature))
+    dataset = tf.data.Dataset.from_generator(generator, output_signature=signature)
     namedtuple_type = schema.namedtuple
     return dataset.map(lambda *args: namedtuple_type(*args))
 

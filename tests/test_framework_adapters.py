@@ -71,3 +71,79 @@ class TestTfDataset:
             ds = make_petastorm_dataset(reader)
             batch = next(iter(ds))
         assert batch.id.shape[0] == 10  # row-group sized
+
+    def test_dtype_promotions(self, synthetic_dataset):
+        # uint16 -> int32, Decimal -> string (reference tf_utils.py:27-44)
+        tf = pytest.importorskip('tensorflow')
+        from petastorm_tpu.tf_utils import make_petastorm_dataset
+        with make_reader(synthetic_dataset.url, reader_pool_type='dummy',
+                         schema_fields=['id', 'matrix_uint16', 'decimal'],
+                         shuffle_row_groups=False) as reader:
+            row = next(iter(make_petastorm_dataset(reader)))
+        assert row.matrix_uint16.dtype == tf.int32
+        assert row.decimal.dtype == tf.string
+        assert row.decimal.numpy().decode().startswith('0.')
+
+    def test_ngram_flattening(self, synthetic_dataset):
+        # NGram windows surface as dicts of offset -> per-timestep namedtuples
+        # (reference tf_utils.py:141-183,254-286)
+        tf = pytest.importorskip('tensorflow')
+        from petastorm_tpu.ngram import NGram
+        from petastorm_tpu.test_util.dataset_utils import TestSchema
+        from petastorm_tpu.tf_utils import make_petastorm_dataset
+
+        ngram = NGram({0: [TestSchema.id, TestSchema.id2], 1: [TestSchema.id]},
+                      delta_threshold=1, timestamp_field=TestSchema.id)
+        with make_reader(synthetic_dataset.url, reader_pool_type='dummy', ngram=ngram,
+                         shuffle_row_groups=False) as reader:
+            windows = list(make_petastorm_dataset(reader).take(8))
+        assert sorted(windows[0].keys()) == [0, 1]
+        assert set(windows[0][0]._fields) == {'id', 'id2'}
+        assert set(windows[0][1]._fields) == {'id'}
+        for w in windows:
+            assert int(w[1].id) == int(w[0].id) + 1
+
+    def test_ngram_with_images_through_tf(self, synthetic_dataset):
+        tf = pytest.importorskip('tensorflow')
+        from petastorm_tpu.ngram import NGram
+        from petastorm_tpu.test_util.dataset_utils import TestSchema
+        from petastorm_tpu.tf_utils import make_petastorm_dataset
+
+        ngram = NGram({0: [TestSchema.id, TestSchema.image_png], 1: [TestSchema.id]},
+                      delta_threshold=1, timestamp_field=TestSchema.id)
+        with make_reader(synthetic_dataset.url, reader_pool_type='dummy', ngram=ngram,
+                         shuffle_row_groups=False) as reader:
+            w = next(iter(make_petastorm_dataset(reader)))
+        expected = {r['id']: r for r in synthetic_dataset.data}
+        np.testing.assert_array_equal(w[0].image_png.numpy(),
+                                      expected[int(w[0].id)]['image_png'])
+
+    def test_shuffle_buffer(self, synthetic_dataset):
+        tf = pytest.importorskip('tensorflow')
+        from petastorm_tpu.tf_utils import make_petastorm_dataset
+        with make_reader(synthetic_dataset.url, reader_pool_type='dummy',
+                         schema_fields=['id'], shuffle_row_groups=False) as reader:
+            ids = [int(r.id) for r in make_petastorm_dataset(
+                reader, shuffle_buffer_size=40, seed=3)]
+        assert sorted(ids) == list(range(100))
+        assert ids != sorted(ids)  # decorrelated
+
+    def test_shuffle_buffer_seed_reproducible(self, synthetic_dataset):
+        tf = pytest.importorskip('tensorflow')
+        from petastorm_tpu.tf_utils import make_petastorm_dataset
+
+        def run():
+            with make_reader(synthetic_dataset.url, reader_pool_type='dummy',
+                             schema_fields=['id'], shuffle_row_groups=False) as reader:
+                return [int(r.id) for r in make_petastorm_dataset(
+                    reader, shuffle_buffer_size=40, seed=11)]
+
+        assert run() == run()
+
+    def test_shuffle_rejected_for_batched_reader(self, scalar_dataset):
+        tf = pytest.importorskip('tensorflow')
+        from petastorm_tpu.tf_utils import make_petastorm_dataset
+        with make_batch_reader(scalar_dataset.url, reader_pool_type='dummy',
+                               schema_fields=['id'], shuffle_row_groups=False) as reader:
+            with pytest.raises(ValueError, match='batched reader'):
+                make_petastorm_dataset(reader, shuffle_buffer_size=10)
