@@ -26,7 +26,6 @@ import os
 import shutil
 import sys
 import tempfile
-import zlib
 
 import numpy as np
 
@@ -35,12 +34,12 @@ if REPO_ROOT not in sys.path:
     sys.path.insert(0, REPO_ROOT)
 
 
-def build_png_store(url, rows, seed=0):
+def build_png_store(url, rows, seed=0, image_codec='png'):
     from examples.imagenet.generate_petastorm_imagenet import generate_synthetic_imagenet
     images_per_synset = 32
     generate_synthetic_imagenet(url, num_synsets=max(1, rows // images_per_synset),
                                 images_per_synset=images_per_synset,
-                                rows_per_row_group=16)
+                                rows_per_row_group=16, seed=seed, image_codec=image_codec)
 
 
 def build_raw_store(url, rows, image_size, num_classes, seed=0):

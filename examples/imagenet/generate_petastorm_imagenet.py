@@ -65,9 +65,12 @@ def imagenet_directory_to_petastorm_dataset(imagenet_path, output_url,
 
 
 def generate_synthetic_imagenet(output_url, num_synsets=4, images_per_synset=8,
-                                rows_per_row_group=16):
-    write_petastorm_dataset(output_url, ImagenetSchema,
-                            _iter_synthetic(num_synsets, images_per_synset),
+                                rows_per_row_group=16, seed=0, image_codec='png'):
+    """``image_codec``: 'png' (reference ImagenetSchema parity) or 'jpeg' —
+    realistic ImageNet pipelines are JPEG-compressed."""
+    schema = ImagenetSchema if image_codec == 'png' else make_imagenet_schema(image_codec)
+    write_petastorm_dataset(output_url, schema,
+                            _iter_synthetic(num_synsets, images_per_synset, seed=seed),
                             rows_per_row_group=rows_per_row_group)
 
 

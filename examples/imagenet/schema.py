@@ -11,8 +11,15 @@ import numpy as np
 from petastorm_tpu.codecs import CompressedImageCodec, ScalarCodec
 from petastorm_tpu.unischema import Unischema, UnischemaField
 
-ImagenetSchema = Unischema('ImagenetSchema', [
-    UnischemaField('noun_id', np.str_, (), ScalarCodec(), False),
-    UnischemaField('text', np.str_, (), ScalarCodec(), False),
-    UnischemaField('image', np.uint8, (None, None, 3), CompressedImageCodec('png'), False),
-])
+def make_imagenet_schema(image_codec='png', quality=80):
+    """ImagenetSchema with a selectable image compression codec — realistic
+    ImageNet pipelines are JPEG; the reference schema is PNG."""
+    return Unischema('ImagenetSchema', [
+        UnischemaField('noun_id', np.str_, (), ScalarCodec(), False),
+        UnischemaField('text', np.str_, (), ScalarCodec(), False),
+        UnischemaField('image', np.uint8, (None, None, 3),
+                       CompressedImageCodec(image_codec, quality=quality), False),
+    ])
+
+
+ImagenetSchema = make_imagenet_schema('png')

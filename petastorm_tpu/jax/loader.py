@@ -139,17 +139,17 @@ class JaxDataLoader(object):
     def __iter__(self):
         # eager (not part of the generator body): a second iter() while rows
         # are in flight would rebind _buffer/_pending and silently drop the
-        # first iterator's buffered rows from future state_dict() checkpoints
+        # first iterator's buffered rows from future state_dict() checkpoints.
+        # Buffer creation and resume-row injection are ALSO eager — were they in
+        # the generator body, two iter() calls before any next() would both pass
+        # this guard, and advancing both would rebind _buffer and orphan the
+        # first iterator's rows from checkpoints.
         if (self._buffer is not None and self._buffer.size) or self._pending:
             raise RuntimeError(
                 'JaxDataLoader.__iter__ called again while a previous iteration still holds '
                 'buffered rows; exhaust the previous iterator (or create a new loader) first.')
-        return self._iterate()
-
-    def _iterate(self):
-        import time
         buffer = self._buffer = self._make_buffer()
-        pending = self._pending = []
+        self._pending = []
         if self._resume_rng is not None and hasattr(buffer, 'rng_state'):
             buffer.rng_state = self._resume_rng
         self._resume_rng = None
@@ -158,6 +158,10 @@ class JaxDataLoader(object):
         # clear even when empty: a leftover [] would permanently re-route
         # state_dict() to the (now stale) resume branch
         self._resume_rows = None
+        return self._iterate(buffer, self._pending)
+
+    def _iterate(self, buffer, pending):
+        import time
         self._iter_start = time.perf_counter()
         self._reader_wait_s = 0.0
         self._rows_out = 0

@@ -77,6 +77,11 @@ def _shuffled(reader, shuffle_buffer_size, seed):
     (tf_utils.py:201-219)."""
     from petastorm_tpu.shuffling_buffer import RandomShufflingBuffer
 
+    if shuffle_buffer_size < 2:
+        # a 1-slot buffer cannot decorrelate anything; pass rows straight through
+        # instead of tripping RandomShufflingBuffer's min_after_retrieve < capacity check
+        yield from reader
+        return
     buf = RandomShufflingBuffer(shuffle_buffer_size,
                                 min_after_retrieve=max(1, shuffle_buffer_size // 2),
                                 extra_capacity=max(1000, shuffle_buffer_size), seed=seed)
