@@ -1,0 +1,48 @@
+"""Host-only profile of the raw (zero-decode) reader->loader path."""
+import cProfile
+import os
+import pstats
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np  # noqa: E402
+
+
+def main():
+    tmpdir = tempfile.mkdtemp(prefix='profile_raw_')
+    url = 'file://' + tmpdir + '/store'
+    from bench_duty import build_raw_store
+    build_raw_store(url, rows=512, image_size=160, num_classes=1000)
+
+    from petastorm_tpu import make_reader
+    from petastorm_tpu.jax import JaxDataLoader
+
+    reader = make_reader(url, num_epochs=None, seed=7, shuffle_row_groups=True,
+                         workers_count=1, reader_pool_type='thread')
+    loader = JaxDataLoader(reader, batch_size=64, shuffling_queue_capacity=512, seed=7)
+    it = iter(loader)
+    for _ in range(4):
+        next(it)  # warmup
+
+    n_batches = 60
+    prof = cProfile.Profile()
+    t0 = time.perf_counter()
+    prof.enable()
+    for _ in range(n_batches):
+        next(it)
+    prof.disable()
+    dt = time.perf_counter() - t0
+    rows = n_batches * 64
+    print('== {} rows in {:.3f}s = {:.0f} rows/s = {:.1f} us/row =='.format(
+        rows, dt, rows / dt, 1e6 * dt / rows))
+    stats = pstats.Stats(prof)
+    stats.sort_stats('cumulative').print_stats(25)
+    reader.stop()
+    reader.join()
+
+
+if __name__ == '__main__':
+    main()

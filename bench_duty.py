@@ -54,7 +54,9 @@ def build_raw_store(url, rows, image_size, num_classes, seed=0):
         UnischemaField('label', np.int64, (), ScalarCodec(np.int64), False),
     ])
     rng = np.random.default_rng(seed)
-    with materialize_dataset(url, schema, rows_per_row_group=64) as writer:
+    # uncompressed: the raw variant is the decode-free ceiling; snappy on raw
+    # pixel tensors costs read-side decompression for a marginal size win
+    with materialize_dataset(url, schema, rows_per_row_group=64, compression='none') as writer:
         for i in range(rows):
             writer.write({'image': synthetic_image(rng, image_size, image_size),
                           'label': int(i % num_classes)})

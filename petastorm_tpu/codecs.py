@@ -68,6 +68,12 @@ class DataFieldCodec(object):
     #: stable identifier used in JSON-serialized schemas
     codec_id = None
 
+    #: Parquet column compression this codec's payloads want: ``None`` defers to
+    #: the dataset default; ``'none'`` opts out (codecs whose cells are already
+    #: compressed — png/jpeg/zlib bytes — gain nothing from snappy and pay its
+    #: decode on every read, which is pure input-pipeline stall)
+    preferred_column_compression = None
+
     def encode(self, field, value):
         """Encode an in-memory value to the Parquet cell representation."""
         raise NotImplementedError
@@ -171,6 +177,20 @@ class ScalarCodec(DataFieldCodec):
             return Decimal(encoded)
         return dtype(encoded)
 
+    def decode_column(self, field, column):
+        """Whole-column decode of a numeric/bool Arrow column to one numpy array
+        (the columnar hot path) — ``None`` for flavors that need the per-cell
+        path (nulls, strings, Decimals, datetimes)."""
+        dtype = field.numpy_dtype
+        if dtype is Decimal or dtype in (np.str_, np.bytes_, np.datetime64):
+            return None
+        if column.null_count:
+            return None
+        arr = column.to_numpy(zero_copy_only=False)
+        if isinstance(arr, np.ndarray) and arr.dtype.kind in 'biuf':
+            return arr.astype(np.dtype(dtype), copy=False)
+        return None
+
     def arrow_type(self, field):
         return arrow_type_for_numpy(self._storage_dtype(field))
 
@@ -224,6 +244,41 @@ class NdarrayCodec(DataFieldCodec):
             arr = np.load(io.BytesIO(encoded), allow_pickle=False)
         return arr
 
+    def decode_column(self, field, column):
+        """Whole-column decode: all cells of a row group almost always carry an
+        IDENTICAL ``np.save`` header (same shape/dtype), so parse it once, then
+        each remaining cell is a bytes-compare plus one memcpy into a
+        preallocated ``[N, ...]`` output — no per-cell header parse, no per-cell
+        intermediate array + copy. ``None`` (-> generic per-cell path) for
+        nulls, ragged shapes, or non-standard headers."""
+        from petastorm_tpu.columnar import column_cells
+
+        if column.null_count:
+            return None
+        cells = column_cells(column)
+        if not cells:
+            return None
+        first = memoryview(cells[0])
+        parsed = _parse_npy_header(first)
+        if parsed is None:
+            return None
+        dtype, fortran, shape, data_off = parsed
+        if fortran:
+            return None
+        count = 1
+        for dim in shape:
+            count *= dim
+        cell_len = data_off + count * dtype.itemsize
+        header = bytes(first[:data_off])
+        out = np.empty((len(cells),) + shape, dtype=dtype)
+        flat_out = out.reshape(len(cells), -1) if count else out.reshape(len(cells), 0)
+        for i, cell in enumerate(cells):
+            buf = memoryview(cell)
+            if len(buf) != cell_len or bytes(buf[:data_off]) != header:
+                return None  # mixed shapes/dtypes in this row group: generic path
+            flat_out[i] = np.frombuffer(buf, dtype=dtype, count=count, offset=data_off)
+        return out
+
     def arrow_type(self, field):
         return pa.binary()
 
@@ -237,9 +292,9 @@ _NPY_HEADER_RE = re.compile(
     rb"'shape': \(([0-9, ]*),?\), \}\s*")
 
 
-def _fast_npy_decode(encoded):
-    """Decode standard ``np.save`` bytes; None if the header is non-standard."""
-    buf = memoryview(encoded)
+def _parse_npy_header(buf):
+    """``(dtype, fortran_order, shape, data_offset)`` of standard ``np.save``
+    bytes; None if the header is non-standard."""
     if len(buf) < 12 or bytes(buf[:6]) != _NPY_MAGIC:
         return None
     major = buf[6]
@@ -257,6 +312,16 @@ def _fast_npy_decode(encoded):
     dtype = np.dtype(m.group(1).decode())
     fortran = m.group(2) == b'True'
     shape = tuple(int(x) for x in m.group(3).split(b',') if x.strip())
+    return dtype, fortran, shape, data_off
+
+
+def _fast_npy_decode(encoded):
+    """Decode standard ``np.save`` bytes; None if the header is non-standard."""
+    buf = memoryview(encoded)
+    parsed = _parse_npy_header(buf)
+    if parsed is None:
+        return None
+    dtype, fortran, shape, data_off = parsed
     count = 1
     for dim in shape:
         count *= dim
@@ -273,6 +338,7 @@ class CompressedNdarrayCodec(DataFieldCodec):
     """zlib-compressed ``np.savez_compressed`` bytes (reference codecs.py:155-186)."""
 
     codec_id = 'compressed_ndarray'
+    preferred_column_compression = 'none'  # cells are already zlib streams
 
     def encode(self, field, value):
         _require_ndarray(field, value)
@@ -308,6 +374,25 @@ class ScalarListCodec(DataFieldCodec):
     def decode(self, field, encoded):
         return np.asarray(encoded, dtype=np.dtype(field.numpy_dtype))
 
+    def decode_column(self, field, column):
+        """Whole-column decode of a LIST column whose rows are uniform-length:
+        one reshape over the flattened Arrow values buffer instead of N python
+        lists. ``None`` (-> per-cell path) for ragged/null flavors."""
+        if column.null_count:
+            return None
+        col = column.combine_chunks()
+        offs = col.offsets.to_numpy()
+        if len(offs) < 2:
+            return None
+        lens = np.diff(offs)
+        if (lens != lens[0]).any() or col.values.null_count:
+            return None
+        vals = col.values.to_numpy(zero_copy_only=False)
+        if not isinstance(vals, np.ndarray) or vals.dtype.kind not in 'biuf':
+            return None
+        out = vals[offs[0]:offs[-1]].reshape(len(lens), int(lens[0]))
+        return out.astype(np.dtype(field.numpy_dtype), copy=False)
+
     def arrow_type(self, field):
         return pa.list_(arrow_type_for_numpy(field.numpy_dtype))
 
@@ -322,6 +407,7 @@ class CompressedImageCodec(DataFieldCodec):
     """
 
     codec_id = 'compressed_image'
+    preferred_column_compression = 'none'  # cells are already png/jpeg streams
 
     def __init__(self, image_codec='png', quality=80):
         if image_codec not in ('png', 'jpeg', 'jpg'):

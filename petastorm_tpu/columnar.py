@@ -1,0 +1,396 @@
+"""Column-block utilities shared by the decode workers and the JAX loader.
+
+A *column block* is the unit that flows from a decode worker to the consumer:
+a plain dict ``{field_name: column}`` where each column holds one decoded value
+per row, as either
+
+  * a numpy array with a leading row axis (fields whose cells share one
+    shape/dtype — the common case), or
+  * a 1-D object array (ragged tensors, strings, Decimals, nullable cells).
+
+Blocks replace the reference's list-of-row-dicts worker output
+(/root/reference/petastorm/py_dict_reader_worker.py:121-169): rows stop being
+Python objects on the hot path, so per-row cost collapses to numpy slicing.
+Rows are materialized (as schema namedtuples) only for users who iterate rows.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+import numpy as np
+import pyarrow as pa
+
+
+def column_cells(column):
+    """ChunkedArray -> list of per-row cell values. Binary columns skip
+    ``to_pylist`` (which copies every cell into a bytes object) and hand out
+    zero-copy memoryview slices of the Arrow data buffer instead — codecs
+    (np.frombuffer, cv2.imdecode) consume memoryviews directly, so the only
+    copy left in the decode path is the decode itself."""
+    t = column.type
+    if pa.types.is_binary(t) or pa.types.is_large_binary(t):
+        out = []
+        for chunk in column.chunks:
+            n = len(chunk)
+            if n == 0:
+                continue
+            if chunk.null_count:
+                out.extend(chunk.to_pylist())
+                continue
+            off_dtype = np.int64 if pa.types.is_large_binary(t) else np.int32
+            _, offsets_buf, data_buf = chunk.buffers()
+            offs = np.frombuffer(offsets_buf, dtype=off_dtype, count=n + 1,
+                                 offset=chunk.offset * np.dtype(off_dtype).itemsize).tolist()
+            mv = memoryview(data_buf)
+            out.extend(mv[offs[i]:offs[i + 1]] for i in range(n))
+        return out
+    return column.to_pylist()
+
+
+def _object_column(values):
+    out = np.empty(len(values), dtype=object)
+    for i, v in enumerate(values):
+        out[i] = v
+    return out
+
+
+def stack_cells(values):
+    """List of decoded cells -> one block column: a stacked ``[N, ...]`` array
+    when every cell is an array of one shape/dtype (or a numpy/python scalar),
+    else a 1-D object array preserving each cell (including ``None``)."""
+    if not values:
+        return np.empty(0, dtype=object)
+    v0 = values[0]
+    if isinstance(v0, np.ndarray) and v0.ndim > 0:
+        shape, dtype = v0.shape, v0.dtype
+        for v in values:
+            if not (isinstance(v, np.ndarray) and v.shape == shape and v.dtype == dtype):
+                return _object_column(values)
+        if dtype == object:
+            return _object_column(values)
+        return np.stack(values)
+    if isinstance(v0, (np.bool_, np.number)) or type(v0) in (int, float, bool):
+        try:
+            return np.array(values)
+        except ValueError:
+            return _object_column(values)
+    # str/bytes/Decimal/datetime/None/mixed: object column keeps cells verbatim
+    return _object_column(values)
+
+
+def block_num_rows(block):
+    return len(next(iter(block.values()))) if block else 0
+
+
+def block_to_rows(block, field_order=None):
+    """Explode a block into per-row dicts (worker-side transforms and NGram
+    assembly still operate on rows)."""
+    names = list(field_order) if field_order is not None else list(block)
+    cols = [block[name] for name in names]
+    n = len(cols[0]) if cols else 0
+    return [dict(zip(names, (c[i] for c in cols))) for i in range(n)]
+
+
+def rows_to_block(rows, field_order=None):
+    """Re-collate row dicts into a block (after a per-row transform)."""
+    names = list(field_order) if field_order is not None else list(rows[0])
+    return {name: stack_cells([r[name] for r in rows]) for name in names}
+
+
+def take_block(block, indices):
+    """Select rows of every column (numpy fancy indexing; object columns too)."""
+    return {name: col[indices] for name, col in block.items()}
+
+
+def concat_columns(parts):
+    """Concatenate per-segment arrays of one logical column. Segments may mix a
+    stacked 2-D layout with a 1-D object layout (e.g. a list column that is
+    uniform in one row group and ragged in the next) — mixed layouts degrade to
+    one object column instead of crashing concat."""
+    if len(parts) == 1:
+        return parts[0]
+    uniform = (len({p.ndim for p in parts}) == 1 and
+               len({p.shape[1:] for p in parts}) == 1 and
+               len({p.dtype == object for p in parts}) == 1)
+    if uniform:
+        return np.concatenate(parts)
+    rows = []
+    for p in parts:
+        rows.extend(p[i] for i in range(len(p)))
+    return _object_column(rows)
+
+
+def concat_blocks(blocks):
+    """Concatenate blocks row-wise (all blocks must share the same field set)."""
+    if len(blocks) == 1:
+        return blocks[0]
+    return {name: concat_columns([b[name] for b in blocks]) for name in blocks[0]}
+
+
+class BatchingColumnQueue(object):
+    """FIFO queue of column blocks re-chunked to a fixed row count — the ONE
+    implementation of block buffering/slicing, shared by
+    ``make_batch_reader(batch_size=)`` rebatching (via
+    ``rebatch.RebatchingResultsQueueReader``) and the loader's
+    :class:`FifoColumnarBuffer`.
+
+    ``put`` accepts a block (dict of equal-length columns); ``get`` returns a
+    block with exactly ``batch_size`` rows, preserving input row order
+    (reference pyarrow_helpers/batching_table_queue.py:20-79 semantics,
+    columnar instead of Arrow tables). Rows are never copied at ``put`` time:
+    input columns are buffered as views and only concatenated when a batch
+    boundary crosses a buffer segment.
+    """
+
+    def __init__(self, batch_size):
+        if batch_size < 1:
+            raise ValueError('batch_size must be >= 1, got {}'.format(batch_size))
+        self._batch_size = batch_size
+        self._segments = deque()  # (block, tag)
+        self._head = 0  # rows of the head segment already consumed
+        self._buffered = 0
+        self._drained_tags = []  # tags of segments fully consumed by take()
+
+    def __len__(self):
+        return self._buffered
+
+    def put(self, batch, tag=None):
+        """``tag``: opaque id returned via :meth:`pop_drained_tags` once every
+        row of this batch has left the queue (checkpoint bookkeeping)."""
+        lengths = {len(v) for v in batch.values()}
+        if len(lengths) != 1:
+            raise ValueError('ragged batch: column lengths {}'.format(sorted(lengths)))
+        n = lengths.pop()
+        if n == 0:
+            if tag is not None:
+                self._drained_tags.append(tag)
+            return
+        self._segments.append((batch, tag))
+        self._buffered += n
+
+    def pop_drained_tags(self):
+        """Tags of segments whose rows have all been taken since the last call."""
+        tags, self._drained_tags = self._drained_tags, []
+        return tags
+
+    def empty(self):
+        """True when a full ``batch_size`` batch cannot be produced yet."""
+        return self._buffered < self._batch_size
+
+    def get(self):
+        assert not self.empty()
+        return self.take(self._batch_size)
+
+    def drain(self):
+        """Return all remaining rows as one final (possibly short) batch, or
+        None if nothing is buffered."""
+        if self._buffered == 0:
+            return None
+        return self.take(self._buffered)
+
+    def take(self, count):
+        parts = []  # list of dict-of-views
+        taken = 0
+        while taken < count:
+            head, tag = self._segments[0]
+            head_len = len(next(iter(head.values())))
+            take = min(count - taken, head_len - self._head)
+            parts.append({k: v[self._head:self._head + take] for k, v in head.items()})
+            self._head += take
+            taken += take
+            if self._head == head_len:
+                self._segments.popleft()
+                self._head = 0
+                if tag is not None:
+                    self._drained_tags.append(tag)
+        self._buffered -= count
+        return concat_blocks(parts)
+
+    def clear(self):
+        self._segments.clear()
+        self._head = 0
+        self._buffered = 0
+        self._drained_tags = []
+
+    def snapshot_rows(self):
+        """Remaining buffered rows as plain row dicts (loader checkpoints)."""
+        rows = []
+        for i, (seg, _) in enumerate(self._segments):
+            start = self._head if i == 0 else 0
+            cols = list(seg.items())
+            for r in range(start, block_num_rows(seg)):
+                rows.append({k: v[r] for k, v in cols})
+        return rows
+
+
+class FifoColumnarBuffer(object):
+    """FIFO of column blocks with fixed-size batch extraction — the columnar
+    analog of :class:`petastorm_tpu.shuffling_buffer.NoopShufflingBuffer`, a
+    thin loader-facing facade over :class:`BatchingColumnQueue`."""
+
+    def __init__(self):
+        self._q = BatchingColumnQueue(1)
+
+    @property
+    def size(self):
+        return len(self._q)
+
+    def add_block(self, block):
+        self._q.put(block)
+
+    def can_emit(self, batch_size):
+        return len(self._q) >= batch_size
+
+    def emit(self, count):
+        return self._q.take(count)
+
+    def finish(self):
+        pass
+
+    def clear(self):
+        self._q.clear()
+
+    def snapshot_rows(self):
+        return self._q.snapshot_rows()
+
+
+class ShuffledColumnarBuffer(object):
+    """Columnar decorrelation buffer: the analog of
+    :class:`petastorm_tpu.shuffling_buffer.RandomShufflingBuffer`, but instead
+    of per-row random-swap retrieves it keeps buffered blocks intact and
+    permutes *row indices* ``(segment, row)`` over them. Emitting a batch
+    gathers the selected rows segment-by-segment into one freshly allocated
+    batch — exactly one data copy per emitted row, no pool-rebuild copies, no
+    per-row Python. Every row is permuted within a window of ~``capacity``
+    rows, and the ``min_after`` floor keeps a mixing reservoir alive across
+    refills (same decorrelation contract as the row buffer; verified by the
+    rank-correlation test in tests/test_shuffle_quality.py).
+
+    Blocks larger than ``capacity`` are accepted whole (a row group may dwarf
+    the buffer — same stance as the row buffer's ``extra_capacity``)."""
+
+    def __init__(self, capacity, min_after, seed=None):
+        if min_after >= capacity:
+            raise ValueError('min_after ({}) must be smaller than capacity ({})'.format(
+                min_after, capacity))
+        self._capacity = capacity
+        self._min_after = min_after
+        self._rng = np.random.default_rng(seed)
+        self._segments = {}       # seg_id -> block
+        self._seg_remaining = {}  # seg_id -> rows not yet emitted
+        self._next_seg = 0
+        # permuted (segment, row) pairs not yet emitted, consumed from _cursor
+        self._order_seg = np.empty(0, dtype=np.int64)
+        self._order_row = np.empty(0, dtype=np.int64)
+        self._cursor = 0
+        self._staged_ids = []     # seg ids not yet folded into the permutation
+        self._staged_rows = 0
+        self._done = False
+
+    @property
+    def size(self):
+        return (len(self._order_seg) - self._cursor) + self._staged_rows
+
+    @property
+    def rng_state(self):
+        """Picklable RNG state, for loader checkpoints: restoring it makes a
+        seeded resume reproduce the exact pre-checkpoint batch stream."""
+        return self._rng.bit_generator.state
+
+    @rng_state.setter
+    def rng_state(self, state):
+        self._rng.bit_generator.state = state
+
+    def add_block(self, block):
+        n = block_num_rows(block)
+        if not n:
+            return
+        sid = self._next_seg
+        self._next_seg += 1
+        self._segments[sid] = block
+        self._seg_remaining[sid] = n
+        self._staged_ids.append(sid)
+        self._staged_rows += n
+
+    def can_emit(self, batch_size):
+        if self._done:
+            return self.size > 0
+        return self.size - batch_size >= self._min_after
+
+    def emit(self, count):
+        count = min(count, self.size)
+        if len(self._order_seg) - self._cursor < count:
+            self._fold_staged()
+        sel_seg = self._order_seg[self._cursor:self._cursor + count]
+        sel_row = self._order_row[self._cursor:self._cursor + count]
+        self._cursor += count
+        out = {}
+        plan = []  # (seg block, row indices) in one pass, shared by all columns
+        for sid in np.unique(sel_seg):
+            rows = sel_row[sel_seg == sid]
+            plan.append((self._segments[sid], rows))
+            self._seg_remaining[sid] -= len(rows)
+            if self._seg_remaining[sid] == 0:
+                del self._segments[sid]
+                del self._seg_remaining[sid]
+        first = plan[0][0]
+        for name in first:
+            col0 = first[name]
+            uniform = (isinstance(col0, np.ndarray) and col0.dtype != object and all(
+                isinstance(seg[name], np.ndarray) and seg[name].dtype == col0.dtype
+                and seg[name].shape[1:] == col0.shape[1:] for seg, _ in plan))
+            if uniform:
+                # single-copy gather straight into the batch allocation
+                out_col = np.empty((count,) + col0.shape[1:], col0.dtype)
+                pos = 0
+                for seg, rows in plan:
+                    np.take(seg[name], rows, axis=0, out=out_col[pos:pos + len(rows)])
+                    pos += len(rows)
+                out[name] = out_col
+            else:
+                parts = [seg[name][rows] for seg, rows in plan]
+                out[name] = parts[0] if len(parts) == 1 else concat_columns(parts)
+        return out
+
+    def _fold_staged(self):
+        """Fold staged segments into a fresh permutation together with every
+        not-yet-emitted index — index arrays only, no row data is touched."""
+        segs = [self._order_seg[self._cursor:]]
+        rows = [self._order_row[self._cursor:]]
+        for sid in self._staged_ids:
+            n = self._seg_remaining[sid]
+            segs.append(np.full(n, sid, dtype=np.int64))
+            rows.append(np.arange(n, dtype=np.int64))
+        all_seg = np.concatenate(segs)
+        all_row = np.concatenate(rows)
+        perm = self._rng.permutation(len(all_seg))
+        self._order_seg = all_seg[perm]
+        self._order_row = all_row[perm]
+        self._cursor = 0
+        self._staged_ids = []
+        self._staged_rows = 0
+
+    def finish(self):
+        self._done = True
+
+    def clear(self):
+        self._segments = {}
+        self._seg_remaining = {}
+        self._order_seg = np.empty(0, dtype=np.int64)
+        self._order_row = np.empty(0, dtype=np.int64)
+        self._cursor = 0
+        self._staged_ids = []
+        self._staged_rows = 0
+
+    def snapshot_rows(self):
+        """Remaining buffered rows as plain row dicts (loader checkpoints)."""
+        rows = []
+        pending = [(self._order_seg[i], self._order_row[i])
+                   for i in range(self._cursor, len(self._order_seg))]
+        for sid in self._staged_ids:
+            pending.extend((sid, r) for r in range(self._seg_remaining[sid]))
+        for sid, r in pending:
+            block = self._segments[sid]
+            rows.append({k: v[r] for k, v in block.items()})
+        return rows

@@ -103,9 +103,21 @@ class DatasetWriter(object):
         for p in self._partition_by:
             if p not in schema.fields:
                 raise PetastormTpuError('partition_by field {!r} not in schema'.format(p))
-        self._compression = compression
+        # per-column compression: codecs whose payloads are already compressed
+        # (png/jpeg/zlib cells) opt out of the dataset-default codec — snappy on
+        # such columns costs read-side decompression for zero size win
+        data_fields_all = [f for f in schema if f.name not in self._partition_by]
+        if isinstance(compression, dict):
+            self._compression = compression
+        else:
+            overrides = {
+                f.name: f.codec.preferred_column_compression for f in data_fields_all
+                if getattr(f.codec, 'preferred_column_compression', None) is not None
+                and f.codec.preferred_column_compression != compression}
+            self._compression = ({**{f.name: compression for f in data_fields_all},
+                                  **overrides} if overrides else compression)
         # physical schema excludes partition columns (they live in the paths)
-        data_fields = [f for f in schema if f.name not in self._partition_by]
+        data_fields = data_fields_all
         self._arrow_schema = pa.schema(
             [pa.field(f.name, f.codec.arrow_type(f), f.nullable) for f in data_fields])
         self._data_field_names = [f.name for f in data_fields]

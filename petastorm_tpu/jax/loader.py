@@ -71,6 +71,38 @@ def collate_rows(rows, field_names=None):
     return batch
 
 
+def _sanitize_batch_columns(batch):
+    """Column-at-a-time dtype sanitization for the columnar fast path — the
+    batch analog of :func:`_sanitize_value`: datetime columns -> int64 ns
+    ticks, Decimal object columns -> float64. ``None`` cells (nullable fields)
+    are preserved, exactly as the row path's per-value sanitizer preserves
+    them — columns containing nulls stay object-typed and host-side."""
+    for name in batch:
+        col = batch[name]
+        if not isinstance(col, np.ndarray):
+            continue
+        if col.dtype.kind == 'M':
+            batch[name] = col.astype('datetime64[ns]').astype(np.int64)
+        elif col.dtype == object and col.size:
+            v0 = next((v for v in col if v is not None), None)
+            has_none = any(v is None for v in col)
+            if isinstance(v0, Decimal):
+                converted = [None if v is None else np.float64(v) for v in col]
+            elif isinstance(v0, np.datetime64):
+                converted = [None if v is None
+                             else v.astype('datetime64[ns]').astype(np.int64)
+                             for v in col]
+            else:
+                continue
+            if has_none:
+                out = np.empty(len(converted), dtype=object)
+                out[:] = converted
+                batch[name] = out
+            else:
+                batch[name] = np.array(converted)
+    return batch
+
+
 def _rows_from_columnar_batch(batch_namedtuple):
     """Transpose a batched reader's columnar output into row dicts
     (reference pytorch.py:163-175)."""
@@ -118,10 +150,24 @@ class JaxDataLoader(object):
         self.batch_size = batch_size
         self._drop_last = drop_last
         self._to_device = to_device
-        self._make_buffer = make_shuffling_buffer_factory(
-            shuffling_queue_capacity, min_after_retrieve, seed, batch_size,
-            batched_reader=reader.batched_output)
         self._ngram = getattr(reader, 'ngram', None)
+        # columnar fast path: readers that emit column blocks (make_batch_reader,
+        # make_reader(output='columnar')) never materialize rows — batches are
+        # numpy slices/gathers of whole blocks
+        self._columnar = bool(reader.batched_output) and self._ngram is None
+        if self._columnar:
+            from petastorm_tpu.columnar import FifoColumnarBuffer, ShuffledColumnarBuffer
+            if shuffling_queue_capacity > 0:
+                floor = (min_after_retrieve if min_after_retrieve is not None
+                         else max(1, shuffling_queue_capacity // 2))
+                self._make_buffer = lambda: ShuffledColumnarBuffer(
+                    shuffling_queue_capacity, floor, seed)
+            else:
+                self._make_buffer = FifoColumnarBuffer
+        else:
+            self._make_buffer = make_shuffling_buffer_factory(
+                shuffling_queue_capacity, min_after_retrieve, seed, batch_size,
+                batched_reader=reader.batched_output)
         self._buffer = None
         self._pending = []
         if resume_state is not None:
@@ -154,11 +200,51 @@ class JaxDataLoader(object):
             buffer.rng_state = self._resume_rng
         self._resume_rng = None
         if self._resume_rows:
-            buffer.add_many(self._resume_rows)
+            if self._columnar:
+                from petastorm_tpu.columnar import rows_to_block
+                buffer.add_block(rows_to_block(self._resume_rows))
+            else:
+                buffer.add_many(self._resume_rows)
         # clear even when empty: a leftover [] would permanently re-route
         # state_dict() to the (now stale) resume branch
         self._resume_rows = None
+        if self._columnar:
+            return self._iterate_columnar(buffer)
         return self._iterate(buffer, self._pending)
+
+    def _iterate_columnar(self, buffer):
+        import time
+        self._iter_start = time.perf_counter()
+        self._reader_wait_s = 0.0
+        self._rows_out = 0
+        bs = self.batch_size
+        reader_it = iter(self.reader)
+        while True:
+            w0 = time.perf_counter()
+            try:
+                item = next(reader_it)
+            except StopIteration:
+                self._reader_wait_s += time.perf_counter() - w0
+                break
+            self._reader_wait_s += time.perf_counter() - w0
+            buffer.add_block(dict(item._asdict()))
+            while buffer.can_emit(bs):
+                yield self._emit_columnar(buffer.emit(bs))
+        buffer.finish()
+        while buffer.size >= bs:
+            yield self._emit_columnar(buffer.emit(bs))
+        if buffer.size and not self._drop_last:
+            yield self._emit_columnar(buffer.emit(buffer.size))
+        # drop_last leftovers are intentionally dropped — clear them so an
+        # exhausted loader can be iterated again (multi-epoch pattern)
+        buffer.clear()
+
+    def _emit_columnar(self, batch):
+        self._rows_out += len(next(iter(batch.values()))) if batch else 0
+        batch = _sanitize_batch_columns(batch)
+        if self._to_device is not None:
+            batch = self._stage(batch)
+        return batch
 
     def _iterate(self, buffer, pending):
         import time
@@ -223,7 +309,10 @@ class JaxDataLoader(object):
         else:
             rows = []
             if self._buffer is not None:
-                rows.extend(getattr(self._buffer, '_items', []))
+                if self._columnar:
+                    rows.extend(self._buffer.snapshot_rows())
+                else:
+                    rows.extend(getattr(self._buffer, '_items', []))
             rows.extend(self._pending)
             rng = getattr(self._buffer, 'rng_state', None)
         return {'version': 1,

@@ -52,6 +52,24 @@ def _make_pool(reader_pool_type, workers_count, results_queue_size):
         reader_pool_type))
 
 
+def _columnar_results_reader_factory(output, batch_size, drop_last, rows_factory):
+    """Results-queue-reader factory for the requested output mode: row slicing,
+    raw row-group blocks, or fixed-size rebatched blocks."""
+    if output == 'rows':
+        if drop_last:
+            raise ValueError('drop_last requires batch_size (without rebatching there is '
+                             'no "last short batch" to drop)')
+        return rows_factory
+    if batch_size is not None:
+        from petastorm_tpu.rebatch import RebatchingResultsQueueReader
+        return lambda schema: RebatchingResultsQueueReader(schema, batch_size,
+                                                           drop_last=drop_last)
+    if drop_last:
+        raise ValueError('drop_last requires batch_size (without rebatching, batches are '
+                         'row-group-sized and there is no "last short batch" to drop)')
+    return BatchResultsQueueReader
+
+
 def _make_cache(cache_type, cache_location, cache_size_limit, cache_row_size_estimate):
     if cache_type in (None, 'null'):
         return NullCache()
@@ -80,6 +98,7 @@ def make_reader(dataset_url,
                 cache_row_size_estimate=None,
                 transform_spec=None,
                 ngram=None,
+                output='rows', batch_size=None, drop_last=False,
                 resume_state=None):
     """Reader for datasets written by :func:`materialize_dataset` — rows decoded
     through the stored Unischema's codecs (reference reader.py:50-174).
@@ -98,6 +117,15 @@ def make_reader(dataset_url,
         ``index % shard_count == cur_shard``
     :param cache_type/...: 'null' or 'local-disk' row-group cache
     :param ngram: :class:`petastorm_tpu.ngram.NGram` for windowed sequence readout
+    :param output: 'rows' (default) yields one schema namedtuple per row —
+        reference ``make_reader`` parity; 'columnar' yields one namedtuple of
+        decoded column arrays per row group (``batched_output=True``) — the TPU
+        hot path: no per-row Python objects ever exist, and ``JaxDataLoader``
+        slices device batches straight out of the blocks. A capability the
+        reference only offered for plain Parquet stores (``make_batch_reader``),
+        here available with full Unischema codec decode.
+    :param batch_size: (columnar only) rebatch blocks to exactly this many rows
+    :param drop_last: (columnar + batch_size only) drop the ragged final batch
     :param resume_state: dict from :meth:`Reader.state_dict` — continue reading
         from a checkpointed position (construct with otherwise-identical args)
     """
@@ -108,12 +136,23 @@ def make_reader(dataset_url,
             'Dataset at {} is missing unischema metadata. If it is a plain Parquet store, '
             'use make_batch_reader instead.'.format(dataset_url))
 
+    if output not in ('rows', 'columnar'):
+        raise ValueError("output must be 'rows' or 'columnar', got {!r}".format(output))
+    if output == 'rows' and batch_size is not None:
+        raise ValueError("batch_size requires output='columnar' (row output is one row "
+                         'per iteration; batch with JaxDataLoader instead)')
+    if output == 'columnar' and ngram is not None:
+        raise ValueError("output='columnar' does not support ngram (windows are row-"
+                         'structured); use the default row output')
+    results_queue_reader_factory = _columnar_results_reader_factory(
+        output, batch_size, drop_last,
+        lambda out_schema: RowResultsQueueReader(out_schema, ngram))
+
     cache = _make_cache(cache_type, cache_location, cache_size_limit, cache_row_size_estimate)
     pool = _make_pool(reader_pool_type, workers_count, results_queue_size)
     return Reader(dataset_url, schema,
                   worker_class=RowGroupDecoderWorker,
-                  results_queue_reader_factory=lambda out_schema: RowResultsQueueReader(
-                      out_schema, ngram),
+                  results_queue_reader_factory=results_queue_reader_factory,
                   pool=pool, schema_fields=schema_fields, seed=seed,
                   shuffle_row_groups=shuffle_row_groups,
                   shuffle_row_drop_partitions=shuffle_row_drop_partitions,
@@ -150,15 +189,8 @@ def make_batch_reader(dataset_url,
     schema = dataset_metadata.infer_or_load_unischema(dataset_url)
     cache = _make_cache(cache_type, cache_location, cache_size_limit, cache_row_size_estimate)
     pool = _make_pool(reader_pool_type, workers_count, results_queue_size)
-    if batch_size is not None:
-        from petastorm_tpu.rebatch import RebatchingResultsQueueReader
-        results_queue_reader_factory = (
-            lambda schema: RebatchingResultsQueueReader(schema, batch_size, drop_last=drop_last))
-    else:
-        if drop_last:
-            raise ValueError('drop_last requires batch_size (without rebatching, batches are '
-                             'row-group-sized and there is no "last short batch" to drop)')
-        results_queue_reader_factory = BatchResultsQueueReader
+    results_queue_reader_factory = _columnar_results_reader_factory(
+        'columnar', batch_size, drop_last, None)
     return Reader(dataset_url, schema,
                   worker_class=ArrowBatchWorker,
                   results_queue_reader_factory=results_queue_reader_factory,

@@ -3,117 +3,20 @@
 Parity+: the reference built a fixed-size Arrow-table rebatcher
 (/root/reference/petastorm/pyarrow_helpers/batching_table_queue.py:20-79) but
 never wired it into the Reader (no imports outside its tests — SURVEY.md §2.6).
-Here the equivalent operates on dicts of numpy column arrays (the container our
-batch workers publish) and IS wired in: ``make_batch_reader(batch_size=N)``
-yields constant-shape batches, which matters on TPU — XLA recompiles on every
-new batch shape, so row-group-sized (variable) batches defeat compilation
-caching.
+Here the equivalent operates on column blocks (the container our workers
+publish) and IS wired in: ``make_batch_reader(batch_size=N)`` and
+``make_reader(output='columnar', batch_size=N)`` yield constant-shape batches,
+which matters on TPU — XLA recompiles on every new batch shape, so
+row-group-sized (variable) batches defeat compilation caching.
 
-Rows are never copied at ``put`` time: input columns are buffered as views and
-only concatenated when a batch boundary crosses a buffer segment.
+The block container itself lives in ``petastorm_tpu.columnar``
+(:class:`BatchingColumnQueue`, re-exported here); this module owns the
+results-queue reader that pumps the worker pool through it.
 """
 
 from __future__ import annotations
 
-from collections import deque
-
-import numpy as np
-
-
-class BatchingColumnQueue(object):
-    """FIFO queue of columnar batches re-chunked to a fixed row count.
-
-    ``put`` accepts a dict of equal-length column arrays; ``get`` returns a dict
-    with exactly ``batch_size`` rows, preserving input row order (reference
-    batching_table_queue.py:20-79 semantics, columnar instead of Arrow tables).
-    """
-
-    def __init__(self, batch_size):
-        if batch_size < 1:
-            raise ValueError('batch_size must be >= 1, got {}'.format(batch_size))
-        self._batch_size = batch_size
-        self._segments = deque()  # (dict of column arrays, tag)
-        self._head = 0  # rows of the head segment already consumed
-        self._buffered = 0
-        self._drained_tags = []  # tags of segments fully consumed by _take
-
-    def __len__(self):
-        return self._buffered
-
-    def put(self, batch, tag=None):
-        """``tag``: opaque id returned via :meth:`pop_drained_tags` once every
-        row of this batch has left the queue (checkpoint bookkeeping)."""
-        lengths = {len(v) for v in batch.values()}
-        if len(lengths) != 1:
-            raise ValueError('ragged batch: column lengths {}'.format(sorted(lengths)))
-        n = lengths.pop()
-        if n == 0:
-            if tag is not None:
-                self._drained_tags.append(tag)
-            return
-        self._segments.append((batch, tag))
-        self._buffered += n
-
-    def pop_drained_tags(self):
-        """Tags of segments whose rows have all been taken since the last call."""
-        tags, self._drained_tags = self._drained_tags, []
-        return tags
-
-    def empty(self):
-        """True when a full ``batch_size`` batch cannot be produced yet."""
-        return self._buffered < self._batch_size
-
-    def get(self):
-        assert not self.empty()
-        return self._take(self._batch_size)
-
-    def drain(self):
-        """Return all remaining rows as one final (possibly short) batch, or
-        None if nothing is buffered."""
-        if self._buffered == 0:
-            return None
-        return self._take(self._buffered)
-
-    def _take(self, count):
-        parts = []  # list of dict-of-views
-        taken = 0
-        while taken < count:
-            head, tag = self._segments[0]
-            head_len = len(next(iter(head.values())))
-            take = min(count - taken, head_len - self._head)
-            parts.append({k: v[self._head:self._head + take] for k, v in head.items()})
-            self._head += take
-            taken += take
-            if self._head == head_len:
-                self._segments.popleft()
-                self._head = 0
-                if tag is not None:
-                    self._drained_tags.append(tag)
-        self._buffered -= count
-        if len(parts) == 1:
-            return parts[0]
-        return {k: _concat_column([p[k] for p in parts]) for k in parts[0]}
-
-
-def _concat_column(parts):
-    """Concatenate per-segment column arrays. List-typed Parquet columns decode
-    to a 2-D array when a row group's lists are uniform-length but a 1-D object
-    array otherwise (batch_worker._column_to_numpy) — mixed segments of one
-    logical column must degrade to object rows instead of crashing concat."""
-    # same-rank, same-trailing-shape parts concatenate directly (including 1-D
-    # object arrays of bytes/decimals/ragged rows); only genuinely mixed
-    # layouts — 2-D uniform next to 1-D ragged, or differing widths — degrade
-    uniform = (len({p.ndim for p in parts}) == 1 and
-               len({p.shape[1:] for p in parts}) == 1)
-    if uniform:
-        return np.concatenate(parts)
-    rows = []
-    for p in parts:
-        rows.extend(p[i] for i in range(len(p)))
-    out = np.empty(len(rows), dtype=object)
-    for i, r in enumerate(rows):
-        out[i] = r
-    return out
+from petastorm_tpu.columnar import BatchingColumnQueue  # noqa: F401  (re-export)
 
 
 class RebatchingResultsQueueReader(object):

@@ -1,15 +1,20 @@
-"""Row-oriented decode worker: loads ONE row group per task, decodes per-row.
+"""Row-group decode worker: loads ONE row group per task, decodes by column.
 
 Parity: /root/reference/petastorm/py_dict_reader_worker.py — in-worker predicate
 pushdown (read+decode predicate columns first, early-exit empty masks, then read
 the rest, :188-252), read-through cache keyed on dataset/piece (:160-163), NGram
 assembly (:165-166), shuffle_row_drop_partition row subsetting (:254-274, with
 NGram-aware spillover :266-271), and a consumer-side results-queue reader that
-converts row dicts to schema namedtuples (:64-97).
+yields one schema namedtuple per ``read_next`` (:64-97).
 
-TPU-first: decode happens here on the CPU host, overlapped with device compute;
-rows are selected BEFORE decode so predicates/row-drop never pay image-decode
-cost for dropped rows.
+TPU-first departure from the reference: the worker's output is a *column block*
+(dict of ``field -> [N, ...]`` numpy array / object column — see
+``petastorm_tpu.columnar``), not a list of per-row Python dicts. Decode runs
+column-at-a-time (``codec.decode_column`` / ``decode_batch``), so the per-row
+Python work the reference pays (dict per row, namedtuple per row, per-cell
+decode call) disappears; consumers slice rows or batches out of blocks with
+numpy. Per-row dicts are materialized only where the API demands them: user
+row transforms and NGram window assembly.
 """
 
 from __future__ import annotations
@@ -18,41 +23,18 @@ import hashlib
 from collections import deque
 
 import numpy as np
-import pyarrow as pa
 
+from petastorm_tpu.columnar import (block_num_rows, block_to_rows, column_cells,
+                                    rows_to_block, stack_cells, take_block)
 from petastorm_tpu.native import open_parquet
-from petastorm_tpu.workers.worker_base import EmptyResultError, WorkerBase
-
-
-def _column_values(column):
-    """ChunkedArray -> list of python values. Binary columns skip ``to_pylist``
-    (which copies every cell into a bytes object) and hand out zero-copy
-    memoryview slices of the Arrow data buffer instead — the codecs
-    (np.frombuffer, cv2.imdecode) consume memoryviews directly, so the only
-    copy left in the decode path is the decode itself."""
-    t = column.type
-    if pa.types.is_binary(t) or pa.types.is_large_binary(t):
-        out = []
-        for chunk in column.chunks:
-            n = len(chunk)
-            if n == 0:
-                continue
-            if chunk.null_count:
-                out.extend(chunk.to_pylist())
-                continue
-            off_dtype = np.int64 if pa.types.is_large_binary(t) else np.int32
-            _, offsets_buf, data_buf = chunk.buffers()
-            offs = np.frombuffer(offsets_buf, dtype=off_dtype, count=n + 1,
-                                 offset=chunk.offset * np.dtype(off_dtype).itemsize).tolist()
-            mv = memoryview(data_buf)
-            out.extend(mv[offs[i]:offs[i + 1]] for i in range(n))
-        return out
-    return column.to_pylist()
+from petastorm_tpu.workers.worker_base import WorkerBase
 
 
 def _cache_key(dataset_path, piece, column_names):
     cols = hashlib.md5(','.join(sorted(column_names)).encode()).hexdigest()[:8]
-    return '{}:{}:rg{}:{}'.format(
+    # 'b1': cache payloads are column blocks (round 3) — never mix with the
+    # row-list payloads an older on-disk cache may hold
+    return '{}:{}:rg{}:b1:{}'.format(
         hashlib.md5(dataset_path.encode()).hexdigest(), piece.path, piece.row_group, cols)
 
 
@@ -120,32 +102,53 @@ class RowGroupDecoderWorker(WorkerBase):
         cache = args['cache']
         if worker_predicate is None and shuffle_row_drop_partition is None:
             key = _cache_key(args['dataset_path'], piece, needed)
-            rows = cache.get(key, lambda: self._load_rows(piece, needed))
+            block = cache.get(key, lambda: self._load_block(piece, needed))
         elif worker_predicate is not None:
-            rows = self._load_rows_with_predicate(piece, needed, worker_predicate,
-                                                  shuffle_row_drop_partition)
+            block = self._load_block_with_predicate(piece, needed, worker_predicate,
+                                                    shuffle_row_drop_partition)
         else:
-            rows = self._load_rows(piece, needed, shuffle_row_drop_partition)
+            block = self._load_block(piece, needed, shuffle_row_drop_partition)
+
+        if block is None or block_num_rows(block) == 0:
+            return
 
         transform = args['transform_spec']
-        if transform is not None and transform.func is not None:
-            rows = [transform.func(r) for r in rows]
         if transform is not None:
-            final_fields = set(args['transformed_schema'].fields)
-            rows = [{k: v for k, v in r.items() if k in final_fields} for r in rows]
+            block = self._apply_transform(block, transform)
+            if block is None or block_num_rows(block) == 0:
+                return
 
         if ngram is not None:
-            rows = ngram.form_ngram(rows, args['transformed_schema'] or out_schema)
+            rows = block_to_rows(block)
+            windows = ngram.form_ngram(rows, args['transformed_schema'] or out_schema)
+            if windows:
+                self.publish(windows)
+            return
 
-        if rows:
-            self.publish(rows)
+        self.publish(block)
+
+    def _apply_transform(self, block, transform):
+        """Row transforms get per-row dicts (reference parity,
+        py_dict_reader_worker.py:38-52); ``TransformSpec(batched=True)`` funcs
+        get the column block itself — zero row materialization."""
+        final_fields = set(self.args['transformed_schema'].fields)
+        if transform.func is None:
+            return {k: v for k, v in block.items() if k in final_fields}
+        if getattr(transform, 'batched', False):
+            out = transform.func(dict(block))
+            return {k: v for k, v in out.items() if k in final_fields}
+        rows = block_to_rows(block)
+        rows = [transform.func(r) for r in rows]
+        rows = [{k: v for k, v in r.items() if k in final_fields} for r in rows]
+        if not rows:
+            return None
+        return rows_to_block(rows)
 
     # -- loading ------------------------------------------------------------
 
-    def _read_columns(self, piece, column_names, row_indices=None):
-        """Read the named logical columns of the piece; returns (dict of
-        per-column python value lists, num_rows). Partition-key columns are
-        materialized from the piece's path."""
+    def _read_table(self, piece, column_names, row_indices=None):
+        """Read the named physical columns of the piece; returns
+        ``(arrow table, total rows in the row group)``."""
         schema = self.args['schema']
         physical = [c for c in column_names if c not in piece.partition_keys
                     and c in schema.fields]
@@ -154,40 +157,59 @@ class RowGroupDecoderWorker(WorkerBase):
         num_rows = table.num_rows
         if row_indices is not None:
             table = table.take(row_indices)
-        columns = {name: _column_values(table.column(name)) for name in physical}
-        n = table.num_rows
-        for key, value in piece.partition_keys.items():
-            if key in column_names:
-                columns[key] = [value] * n
-        return columns, num_rows
+        return table, num_rows
 
-    def _decode_rows(self, columns, column_names, n):
+    def _decode_table(self, table, column_names, piece):
+        """Arrow table -> column block. Per column: the codec's whole-column
+        fast path when it has one, else per-cell decode + stack. Partition-key
+        columns are materialized from the piece's path."""
         schema = self.args['schema']
-        decoded_cols = {}
+        n = table.num_rows
+        block = {}
         for name in column_names:
+            if name in piece.partition_keys:
+                field = schema.fields.get(name)
+                value = piece.partition_keys[name]
+                if field is not None and field.codec is not None:
+                    value = field.codec.decode(field, value)
+                # np.full types the column from the decoded scalar (int64/str/
+                # bool...) so partition labels stage to device like any other
+                # column (batch_worker.py does the same for plain stores)
+                try:
+                    block[name] = np.full(n, value)
+                except (ValueError, TypeError):
+                    col = np.empty(n, dtype=object)
+                    col[:] = value
+                    block[name] = col
+                continue
             field = schema.fields[name]
-            col = columns[name]
             codec = field.codec
-            if hasattr(codec, 'decode_batch'):
-                # whole-column native decode (one GIL-released call per column)
-                decoded_cols[name] = codec.decode_batch(field, col)
-            else:
-                decoded_cols[name] = [None if v is None else codec.decode(field, v) for v in col]
-        return [{name: decoded_cols[name][i] for name in column_names} for i in range(n)]
+            column = table.column(name)
+            decoded = None
+            if hasattr(codec, 'decode_column'):
+                decoded = codec.decode_column(field, column)
+            if decoded is None:
+                cells = column_cells(column)
+                if hasattr(codec, 'decode_batch'):
+                    values = codec.decode_batch(field, cells)
+                else:
+                    values = [None if v is None else codec.decode(field, v) for v in cells]
+                decoded = stack_cells(values)
+            block[name] = decoded
+        return block
 
-    def _load_rows(self, piece, column_names, shuffle_row_drop_partition=None):
+    def _load_block(self, piece, column_names, shuffle_row_drop_partition=None):
         indices = None
         if shuffle_row_drop_partition is not None:
             pf = self._parquet_file(piece.path)
             num_rows = piece.num_rows or pf.metadata.row_group(piece.row_group).num_rows
             indices = select_row_drop_indices(num_rows, shuffle_row_drop_partition,
                                               self.args['ngram'])
-        columns, _ = self._read_columns(piece, column_names, indices)
-        n = len(next(iter(columns.values()))) if columns else 0
-        return self._decode_rows(columns, column_names, n)
+        table, _ = self._read_table(piece, column_names, indices)
+        return self._decode_table(table, column_names, piece)
 
-    def _load_rows_with_predicate(self, piece, column_names, predicate,
-                                  shuffle_row_drop_partition):
+    def _load_block_with_predicate(self, piece, column_names, predicate,
+                                   shuffle_row_drop_partition):
         """Predicate pushdown: decode predicate columns first, mask, early-exit,
         then read+decode remaining columns only for surviving rows."""
         predicate_fields = sorted(predicate.get_fields())
@@ -201,42 +223,48 @@ class RowGroupDecoderWorker(WorkerBase):
         num_rows = pf.metadata.row_group(piece.row_group).num_rows
         drop_indices = select_row_drop_indices(num_rows, shuffle_row_drop_partition,
                                                self.args['ngram'])
-        pred_columns, _ = self._read_columns(piece, predicate_fields, drop_indices
-                                             if shuffle_row_drop_partition else None)
-        n = len(next(iter(pred_columns.values()))) if pred_columns else 0
-        pred_rows = self._decode_rows(pred_columns, predicate_fields, n)
+        pred_table, _ = self._read_table(piece, predicate_fields, drop_indices
+                                         if shuffle_row_drop_partition else None)
+        pred_block = self._decode_table(pred_table, predicate_fields, piece)
+        pred_rows = block_to_rows(pred_block, predicate_fields)
         mask = [predicate.do_include(r) for r in pred_rows]
         if not any(mask):
-            return []
+            return None
         kept_local = np.flatnonzero(mask)
         base = drop_indices if shuffle_row_drop_partition else np.arange(num_rows)
         kept_global = base[kept_local]
 
         remaining = [c for c in column_names if c not in predicate_fields]
-        rem_columns, _ = self._read_columns(piece, remaining, kept_global)
-        rem_rows = self._decode_rows(rem_columns, remaining, len(kept_global))
-        result = []
-        for i, local_idx in enumerate(kept_local):
-            row = dict(pred_rows[local_idx])
-            row.update(rem_rows[i])
-            result.append({k: row[k] for k in column_names if k in row})
-        return result
+        rem_table, _ = self._read_table(piece, remaining, kept_global)
+        rem_block = self._decode_table(rem_table, remaining, piece)
+        kept_pred = take_block(pred_block, kept_local)
+        return {name: (kept_pred[name] if name in kept_pred else rem_block[name])
+                for name in column_names if name in kept_pred or name in rem_block}
 
 
 class RowResultsQueueReader(object):
-    """Consumer-side: converts published row-dict chunks into schema namedtuples,
-    one row per ``read_next`` call (reference py_dict_reader_worker.py:64-97).
+    """Consumer-side: slices schema namedtuples out of published column blocks,
+    one row per ``read_next`` call (reference py_dict_reader_worker.py:64-97 —
+    minus its per-row dict intermediate). NGram readers receive lists of
+    window dicts instead of blocks and buffer them row-wise.
 
-    Checkpoint support: each buffered chunk remembers the seq of the item it
-    came from; when the chunk's last row is yielded, ``delivered_callback(seq)``
-    fires (→ ``ventilator.mark_delivered``), so a :meth:`Reader.state_dict`
+    Checkpoint support: each buffered chunk/block remembers the seq of the item
+    it came from; when its last row is yielded, ``delivered_callback(seq)``
+    fires (-> ``ventilator.mark_delivered``), so a :meth:`Reader.state_dict`
     snapshot never counts partially-yielded row groups as consumed."""
 
     def __init__(self, schema, ngram=None):
         self._schema = schema
         self._ngram = ngram
+        self._namedtuple = schema.namedtuple if ngram is None else None
+        self._field_order = list(schema.fields)
+        # ngram path: buffered window rows; block path: (columns, n, seq) queue
         self._buffer = deque()
         self._spans = deque()  # [seq, rows_remaining] per buffered chunk
+        self._block_cols = None
+        self._block_n = 0
+        self._block_i = 0
+        self._block_seq = None
         self.delivered_callback = None
 
     @property
@@ -252,8 +280,30 @@ class RowResultsQueueReader(object):
             self.delivered_callback(seq)
 
     def read_next(self, pool):
+        if self._ngram is not None:
+            return self._read_next_ngram(pool)
+        while self._block_cols is None:
+            block = pool.get_results()  # raises EmptyResultError at end of epoch
+            n = block_num_rows(block)
+            if n == 0:
+                continue
+            self._block_cols = [block[name] for name in self._field_order]
+            self._block_n = n
+            self._block_i = 0
+            self._block_seq = getattr(pool, 'last_result_seq', None)
+        i = self._block_i
+        row = self._namedtuple(*[col[i] for col in self._block_cols])
+        self._block_i = i + 1
+        if self._block_i == self._block_n:
+            seq = self._block_seq
+            self._block_cols = None
+            if seq is not None and self.delivered_callback is not None:
+                self.delivered_callback(seq)
+        return row
+
+    def _read_next_ngram(self, pool):
         while not self._buffer:
-            rows = pool.get_results()  # raises EmptyResultError at end of epoch
+            rows = pool.get_results()
             self._buffer.extend(rows)
             self._spans.append([getattr(pool, 'last_result_seq', None), len(rows)])
         row = self._buffer.popleft()
@@ -263,6 +313,4 @@ class RowResultsQueueReader(object):
             self._spans.popleft()
             if span[0] is not None and self.delivered_callback is not None:
                 self.delivered_callback(span[0])
-        if self._ngram is not None:
-            return self._ngram.make_namedtuple(self._schema, row)
-        return self._schema.make_namedtuple_from_dict(row)
+        return self._ngram.make_namedtuple(self._schema, row)

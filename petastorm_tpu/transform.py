@@ -23,13 +23,20 @@ class TransformSpec(object):
     :param selected_fields: if not ``None``, an explicit post-transform field-name
         whitelist. (Note: the resulting schema's fields are name-sorted, as in any
         Unischema — selection controls membership, not ordering.)
+    :param batched: when True, ``func`` receives a dict of whole columns (one
+        ``[N, ...]`` array / object column per field) even on row readers, and
+        must return the same — no per-row dict is ever materialized, keeping the
+        worker's hot path columnar. Batch readers always pass columns to
+        ``func`` regardless of this flag.
     """
 
-    def __init__(self, func=None, edit_fields=None, removed_fields=None, selected_fields=None):
+    def __init__(self, func=None, edit_fields=None, removed_fields=None, selected_fields=None,
+                 batched=False):
         self.func = func
         self.edit_fields = [self._as_field(f) for f in (edit_fields or [])]
         self.removed_fields = list(removed_fields or [])
         self.selected_fields = list(selected_fields) if selected_fields is not None else None
+        self.batched = batched
 
     @staticmethod
     def _as_field(field_or_tuple):
