@@ -14,7 +14,7 @@ import os
 
 import numpy as np
 
-from examples.imagenet.schema import ImagenetSchema
+from examples.imagenet.schema import ImagenetSchema, make_imagenet_schema
 from petastorm_tpu.etl.dataset_metadata import write_petastorm_dataset
 
 
@@ -46,12 +46,12 @@ def synthetic_image(rng, h, w):
     return np.clip(base + rng.normal(0, 6, (h, w, 3)), 0, 255).astype(np.uint8)
 
 
-def _iter_synthetic(num_synsets, images_per_synset, seed=0):
+def _iter_synthetic(num_synsets, images_per_synset, seed=0, min_dim=64, max_dim=160):
     rng = np.random.default_rng(seed)
     for s in range(num_synsets):
         noun_id = 'n{:08d}'.format(s)
         for _ in range(images_per_synset):
-            h, w = int(rng.integers(64, 160)), int(rng.integers(64, 160))
+            h, w = int(rng.integers(min_dim, max_dim)), int(rng.integers(min_dim, max_dim))
             yield {'noun_id': noun_id, 'text': 'synthetic synset {}'.format(s),
                    'image': synthetic_image(rng, h, w)}
 
@@ -65,12 +65,15 @@ def imagenet_directory_to_petastorm_dataset(imagenet_path, output_url,
 
 
 def generate_synthetic_imagenet(output_url, num_synsets=4, images_per_synset=8,
-                                rows_per_row_group=16, seed=0, image_codec='png'):
+                                rows_per_row_group=16, seed=0, image_codec='png',
+                                min_dim=64, max_dim=160):
     """``image_codec``: 'png' (reference ImagenetSchema parity) or 'jpeg' —
-    realistic ImageNet pipelines are JPEG-compressed."""
+    realistic ImageNet pipelines are JPEG-compressed. ``min_dim/max_dim``
+    bound the random image sizes (real ImageNet photos are ~300-600px)."""
     schema = ImagenetSchema if image_codec == 'png' else make_imagenet_schema(image_codec)
     write_petastorm_dataset(output_url, schema,
-                            _iter_synthetic(num_synsets, images_per_synset, seed=seed),
+                            _iter_synthetic(num_synsets, images_per_synset, seed=seed,
+                                            min_dim=min_dim, max_dim=max_dim),
                             rows_per_row_group=rows_per_row_group)
 
 

@@ -451,13 +451,19 @@ class CompressedImageCodec(DataFieldCodec):
             image = cv2.cvtColor(image, cv2.COLOR_BGR2RGB)
         return image.astype(np.dtype(field.numpy_dtype), copy=False)
 
-    def decode_batch(self, field, encoded_list):
+    def decode_batch(self, field, encoded_list, min_size=None):
         """Decode a whole column of image cells in one native call (GIL
         released, pixels land in numpy memory in RGB order with no BGR swap
         pass) — the batched replacement for the reference's per-image loop
         (reference codecs.py:92-111). Unsupported flavors (palette/alpha PNG,
         CMYK JPEG) fall back to the per-image OpenCV path; ``None`` cells
-        (nullable fields) pass through."""
+        (nullable fields) pass through.
+
+        ``min_size=(min_h, min_w)`` (from ``TransformSpec.image_decode_hints``)
+        enables scaled JPEG decode: images come out at the smallest m/8 DCT
+        scale covering the minimum instead of full resolution. The OpenCV
+        fallback decodes full size — still >= the hint, so downstream
+        resize-to-target transforms see a valid input either way."""
         from petastorm_tpu.native import image_codec
 
         present = [(i, v) for i, v in enumerate(encoded_list) if v is not None]
@@ -466,7 +472,8 @@ class CompressedImageCodec(DataFieldCodec):
             return out
         if image_codec.is_available():
             try:
-                decoded = image_codec.decode_images([v for _, v in present])
+                decoded = image_codec.decode_images([v for _, v in present],
+                                                    min_size=min_size)
             except (image_codec.NativeDecodeError, MemoryError):
                 # MemoryError: a corrupt header can claim huge dims and blow
                 # the output allocation; retry per-image like any other bad cell

@@ -110,11 +110,12 @@ class DatasetWriter(object):
         if isinstance(compression, dict):
             self._compression = compression
         else:
+            default = compression if compression is not None else 'none'
             overrides = {
                 f.name: f.codec.preferred_column_compression for f in data_fields_all
                 if getattr(f.codec, 'preferred_column_compression', None) is not None
-                and f.codec.preferred_column_compression != compression}
-            self._compression = ({**{f.name: compression for f in data_fields_all},
+                and f.codec.preferred_column_compression != default}
+            self._compression = ({**{f.name: default for f in data_fields_all},
                                   **overrides} if overrides else compression)
         # physical schema excludes partition columns (they live in the paths)
         data_fields = data_fields_all

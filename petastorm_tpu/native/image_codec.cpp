@@ -96,6 +96,22 @@ int probe_png(const uint8_t* data, uint64_t len, int32_t* info) {
   return 0;
 }
 
+// Scaled JPEG decode: libjpeg decodes at m/8 of full size (m=1..8) nearly for
+// free — the IDCT simply produces fewer samples, so most pixels are never
+// computed. Given a minimum output size, pick the smallest m whose scaled
+// dims still cover it (so the only remaining host resize is a small downscale).
+// m=8 == full size; an image already smaller than the minimum stays full size.
+int jpeg_choose_scale(int full_w, int full_h, int min_w, int min_h) {
+  if (min_w <= 0 || min_h <= 0) return 8;
+  for (int m = 1; m < 8; m++) {
+    // jdiv_round_up, exactly as jpeg_calc_output_dimensions computes it
+    const long w = (long(full_w) * m + 7) / 8;
+    const long h = (long(full_h) * m + 7) / 8;
+    if (w >= min_w && h >= min_h) return m;
+  }
+  return 8;
+}
+
 int probe_jpeg(const uint8_t* data, uint64_t len, int32_t* info) {
   if (len < 4 || data[0] != 0xFF || data[1] != 0xD8) return -1;
   uint64_t pos = 2;
@@ -119,16 +135,24 @@ int probe_jpeg(const uint8_t* data, uint64_t len, int32_t* info) {
       info[1] = h;
       info[2] = ncomp;
       info[3] = 8;
-      return 0;
+      return 0;  // caller applies jpeg_choose_scale to info when a hint is set
     }
     pos += 2 + seg_len;
   }
   return -1;
 }
 
-int probe_one(const uint8_t* data, uint64_t len, int32_t* info) {
+int probe_one(const uint8_t* data, uint64_t len, int32_t* info, int min_w, int min_h) {
   if (len >= 8 && std::memcmp(data, kPngMagic, 8) == 0) return probe_png(data, len, info);
-  return probe_jpeg(data, len, info);
+  const int rc = probe_jpeg(data, len, info);
+  if (rc != 0) return rc;
+  // report post-scale output dims so the caller allocates the scaled buffer
+  const int m = jpeg_choose_scale(info[0], info[1], min_w, min_h);
+  if (m < 8) {
+    info[0] = int32_t((long(info[0]) * m + 7) / 8);
+    info[1] = int32_t((long(info[1]) * m + 7) / 8);
+  }
+  return 0;
 }
 
 // ---------------------------------------------------------------------------
@@ -455,7 +479,7 @@ void jpeg_on_error(j_common_ptr cinfo) {
 }
 
 int decode_jpeg(const uint8_t* data, uint64_t len, const int32_t* info, uint8_t* out,
-                std::string* err) {
+                std::string* err, int min_w, int min_h) {
   jpeg_decompress_struct cinfo;
   JpegErr jerr;
   jerr.msg = err;
@@ -469,6 +493,10 @@ int decode_jpeg(const uint8_t* data, uint64_t len, const int32_t* info, uint8_t*
   jpeg_mem_src(&cinfo, const_cast<uint8_t*>(data), len);
   jpeg_read_header(&cinfo, TRUE);
   cinfo.out_color_space = (info[2] == 1) ? JCS_GRAYSCALE : JCS_RGB;
+  // same scale selection as probe_one, so output dims match the allocation
+  cinfo.scale_num = jpeg_choose_scale(int(cinfo.image_width), int(cinfo.image_height),
+                                      min_w, min_h);
+  cinfo.scale_denom = 8;
   jpeg_start_decompress(&cinfo);
   if (int(cinfo.output_width) != info[0] || int(cinfo.output_height) != info[1] ||
       int(cinfo.output_components) != info[2]) {
@@ -488,7 +516,7 @@ int decode_jpeg(const uint8_t* data, uint64_t len, const int32_t* info, uint8_t*
 }
 
 int decode_one(const uint8_t* data, uint64_t len, const int32_t* info, uint8_t* out,
-               std::string* err) {
+               std::string* err, int min_w, int min_h) {
   // C++ exceptions (bad_alloc from the scratch vectors, etc.) must not cross
   // the extern "C" boundary — that would std::terminate the worker process
   // instead of letting Python fall back to the per-image path.
@@ -498,7 +526,7 @@ int decode_one(const uint8_t* data, uint64_t len, const int32_t* info, uint8_t* 
       if (rc != 0) return rc == 1 ? 0 : -1;
       return decode_png(data, len, info, out, err);
     }
-    return decode_jpeg(data, len, info, out, err);
+    return decode_jpeg(data, len, info, out, err, min_w, min_h);
   } catch (const std::exception& e) {
     *err = e.what();
     return -1;
@@ -518,26 +546,35 @@ const char* pstpu_img_last_error() { return g_error.c_str(); }
 
 // Probe n images; infos is n*4 int32 [w,h,c,bit_depth]. Returns -1 when all
 // probed fine, else the index of the first unsupported/corrupt image.
-int64_t pstpu_img_probe_batch(int64_t n, const uint8_t* const* datas, const uint64_t* lens,
-                              int32_t* infos) {
+// min_w/min_h > 0 turn on scaled JPEG decode: reported dims are the smallest
+// m/8 DCT scale still covering (min_w, min_h); PNG dims are unaffected.
+int64_t pstpu_img_probe_batch2(int64_t n, const uint8_t* const* datas, const uint64_t* lens,
+                               int32_t* infos, int32_t min_w, int32_t min_h) {
   for (int64_t i = 0; i < n; i++) {
-    if (probe_one(datas[i], lens[i], infos + i * 4) != 0) return i;
+    if (probe_one(datas[i], lens[i], infos + i * 4, min_w, min_h) != 0) return i;
   }
   return -1;
+}
+
+int64_t pstpu_img_probe_batch(int64_t n, const uint8_t* const* datas, const uint64_t* lens,
+                              int32_t* infos) {
+  return pstpu_img_probe_batch2(n, datas, lens, infos, 0, 0);
 }
 
 // Decode n images into caller-allocated buffers (outs[i] sized from infos).
 // `threads` <= 1 decodes inline on the calling thread (callers inside a reader
 // worker pool want this — the pool already parallelizes across row groups);
-// higher values fan out across an internal thread pool. Returns -1 on success,
-// else the index of the first failure (pstpu_img_last_error has the message).
-int64_t pstpu_img_decode_batch(int64_t n, const uint8_t* const* datas, const uint64_t* lens,
-                               uint8_t* const* outs, const int32_t* infos, int threads) {
+// higher values fan out across an internal thread pool. min_w/min_h must match
+// the probe call that sized the outputs. Returns -1 on success, else the index
+// of the first failure (pstpu_img_last_error has the message).
+int64_t pstpu_img_decode_batch2(int64_t n, const uint8_t* const* datas, const uint64_t* lens,
+                                uint8_t* const* outs, const int32_t* infos, int threads,
+                                int32_t min_w, int32_t min_h) {
   if (n <= 0) return -1;
   if (threads <= 1 || n == 1) {
     for (int64_t i = 0; i < n; i++) {
       std::string err;
-      if (decode_one(datas[i], lens[i], infos + i * 4, outs[i], &err) != 0) {
+      if (decode_one(datas[i], lens[i], infos + i * 4, outs[i], &err, min_w, min_h) != 0) {
         g_error = err;
         return i;
       }
@@ -560,7 +597,7 @@ int64_t pstpu_img_decode_batch(int64_t n, const uint8_t* const* datas, const uin
         if (i >= n) return;
         if (any_fail.load(std::memory_order_relaxed)) return;  // stop early
         std::string err;
-        if (decode_one(datas[i], lens[i], infos + i * 4, outs[i], &err) != 0) {
+        if (decode_one(datas[i], lens[i], infos + i * 4, outs[i], &err, min_w, min_h) != 0) {
           any_fail.store(true, std::memory_order_relaxed);
           std::lock_guard<std::mutex> lock(fail_mutex);
           if (fail_idx < 0 || i < fail_idx) {
@@ -575,7 +612,7 @@ int64_t pstpu_img_decode_batch(int64_t n, const uint8_t* const* datas, const uin
     for (auto& th : pool) th.join();
     for (int64_t i = 0; i < n; i++) {
       std::string err;
-      if (decode_one(datas[i], lens[i], infos + i * 4, outs[i], &err) != 0) {
+      if (decode_one(datas[i], lens[i], infos + i * 4, outs[i], &err, min_w, min_h) != 0) {
         g_error = err;
         return i;
       }
@@ -588,6 +625,11 @@ int64_t pstpu_img_decode_batch(int64_t n, const uint8_t* const* datas, const uin
     return fail_idx;
   }
   return -1;
+}
+
+int64_t pstpu_img_decode_batch(int64_t n, const uint8_t* const* datas, const uint64_t* lens,
+                               uint8_t* const* outs, const int32_t* infos, int threads) {
+  return pstpu_img_decode_batch2(n, datas, lens, outs, infos, threads, 0, 0);
 }
 
 }  // extern "C"

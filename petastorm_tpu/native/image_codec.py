@@ -52,14 +52,15 @@ def _load_library():
             _load_failed = True
             return None
         lib.pstpu_img_last_error.restype = ctypes.c_char_p
-        lib.pstpu_img_probe_batch.restype = ctypes.c_int64
-        lib.pstpu_img_probe_batch.argtypes = [
+        lib.pstpu_img_probe_batch2.restype = ctypes.c_int64
+        lib.pstpu_img_probe_batch2.argtypes = [
             ctypes.c_int64, ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_uint64),
-            ctypes.POINTER(ctypes.c_int32)]
-        lib.pstpu_img_decode_batch.restype = ctypes.c_int64
-        lib.pstpu_img_decode_batch.argtypes = [
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int32, ctypes.c_int32]
+        lib.pstpu_img_decode_batch2.restype = ctypes.c_int64
+        lib.pstpu_img_decode_batch2.argtypes = [
             ctypes.c_int64, ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_uint64),
-            ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_int32), ctypes.c_int]
+            ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
+            ctypes.c_int32, ctypes.c_int32]
         _lib = lib
         return _lib
 
@@ -75,10 +76,16 @@ def _default_threads():
         return 1
 
 
-def decode_images(buffers, threads=None):
+def decode_images(buffers, threads=None, min_size=None):
     """Decode a list of encoded PNG/JPEG cells (bytes/memoryview) in one native
     call. Returns a list of numpy arrays — ``(H, W)`` for grayscale, ``(H, W, 3)``
     RGB otherwise; dtype uint8, or uint16 for 16-bit PNG.
+
+    ``min_size=(min_h, min_w)`` enables scaled JPEG decode: each JPEG comes out
+    at the smallest libjpeg m/8 DCT scale whose dims still cover the minimum
+    (full size if the image is already smaller) — most pixels of a large photo
+    are never computed, which is the cheapest possible "resize". PNGs ignore
+    the hint (the format has no scaled decode).
 
     Raises :class:`NativeDecodeError` when any cell is an unsupported flavor
     (palette/alpha PNG, CMYK JPEG, corrupt data, non-image bytes) — the caller
@@ -90,6 +97,7 @@ def decode_images(buffers, threads=None):
     n = len(buffers)
     if n == 0:
         return []
+    min_h, min_w = (int(min_size[0]), int(min_size[1])) if min_size else (0, 0)
     # numpy views give stable base addresses for arbitrary (read-only) buffers
     views = [np.frombuffer(b, dtype=np.uint8) for b in buffers]
     ptrs = (ctypes.c_void_p * n)(*[v.ctypes.data for v in views])
@@ -97,7 +105,7 @@ def decode_images(buffers, threads=None):
     infos = np.empty((n, 4), dtype=np.int32)
     infos_p = infos.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
 
-    rc = lib.pstpu_img_probe_batch(n, ptrs, lens, infos_p)
+    rc = lib.pstpu_img_probe_batch2(n, ptrs, lens, infos_p, min_w, min_h)
     if rc != -1:
         raise NativeDecodeError('unsupported or corrupt image at index {}'.format(rc), index=rc)
 
@@ -111,8 +119,9 @@ def decode_images(buffers, threads=None):
         outs.append(arr)
         out_ptrs[i] = arr.ctypes.data
 
-    rc = lib.pstpu_img_decode_batch(n, ptrs, lens, out_ptrs, infos_p,
-                                    threads if threads is not None else _default_threads())
+    rc = lib.pstpu_img_decode_batch2(n, ptrs, lens, out_ptrs, infos_p,
+                                     threads if threads is not None else _default_threads(),
+                                     min_w, min_h)
     if rc != -1:
         raise NativeDecodeError('image decode failed at index {}: {}'.format(
             rc, lib.pstpu_img_last_error().decode(errors='replace')), index=rc)

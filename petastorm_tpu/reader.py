@@ -30,7 +30,7 @@ from petastorm_tpu.etl.rowgroup_indexing import get_row_group_indexes
 from petastorm_tpu.fs import FilesystemResolver
 from petastorm_tpu.local_disk_cache import LocalDiskCache
 from petastorm_tpu.row_worker import RowGroupDecoderWorker, RowResultsQueueReader
-from petastorm_tpu.serializers import PickleSerializer
+from petastorm_tpu.serializers import NumpyBlockSerializer
 from petastorm_tpu.transform import transform_schema
 from petastorm_tpu.workers import DummyPool, EmptyResultError, ProcessPool, ThreadPool
 
@@ -41,11 +41,19 @@ logger = logging.getLogger(__name__)
 _VENTILATE_EXTRA_ROWGROUPS = 2
 
 
-def _make_pool(reader_pool_type, workers_count, results_queue_size):
+def _make_pool(reader_pool_type, workers_count, results_queue_size, serializer=None):
+    """Pool construction incl. IPC serializer selection. The reference picks a
+    columnar serializer only for its batch readers (reference reader.py:269);
+    here EVERY worker publishes column blocks, so the raw-buffer
+    :class:`NumpyBlockSerializer` is the process-pool default (its embedded
+    pickle covers NGram window lists and other non-block payloads).
+    Note: block columns crossing the process boundary arrive as read-only numpy
+    views over the IPC message (zero-copy receive)."""
     if reader_pool_type == 'thread':
         return ThreadPool(workers_count, results_queue_size)
     if reader_pool_type == 'process':
-        return ProcessPool(workers_count, results_queue_size, serializer=PickleSerializer())
+        return ProcessPool(workers_count, results_queue_size,
+                           serializer=serializer or NumpyBlockSerializer())
     if reader_pool_type == 'dummy':
         return DummyPool()
     raise ValueError('Unknown reader_pool_type {!r} (expected thread/process/dummy)'.format(

@@ -30,8 +30,13 @@ from petastorm_tpu.native import open_parquet
 from petastorm_tpu.workers.worker_base import WorkerBase
 
 
-def _cache_key(dataset_path, piece, column_names):
-    cols = hashlib.md5(','.join(sorted(column_names)).encode()).hexdigest()[:8]
+def _cache_key(dataset_path, piece, column_names, decode_hints=None):
+    cols = ','.join(sorted(column_names))
+    if decode_hints:
+        # scaled-decode output differs per hint: readers with different hints
+        # must not share cached decoded blocks
+        cols += '|' + repr(sorted(decode_hints.items()))
+    cols = hashlib.md5(cols.encode()).hexdigest()[:8]
     # 'b1': cache payloads are column blocks (round 3) — never mix with the
     # row-list payloads an older on-disk cache may hold
     return '{}:{}:rg{}:b1:{}'.format(
@@ -101,7 +106,8 @@ class RowGroupDecoderWorker(WorkerBase):
 
         cache = args['cache']
         if worker_predicate is None and shuffle_row_drop_partition is None:
-            key = _cache_key(args['dataset_path'], piece, needed)
+            key = _cache_key(args['dataset_path'], piece, needed,
+                             getattr(args['transform_spec'], 'image_decode_hints', None))
             block = cache.get(key, lambda: self._load_block(piece, needed))
         elif worker_predicate is not None:
             block = self._load_block_with_predicate(piece, needed, worker_predicate,
@@ -164,6 +170,8 @@ class RowGroupDecoderWorker(WorkerBase):
         fast path when it has one, else per-cell decode + stack. Partition-key
         columns are materialized from the piece's path."""
         schema = self.args['schema']
+        transform = self.args.get('transform_spec')
+        decode_hints = getattr(transform, 'image_decode_hints', None) or {}
         n = table.num_rows
         block = {}
         for name in column_names:
@@ -191,7 +199,9 @@ class RowGroupDecoderWorker(WorkerBase):
             if decoded is None:
                 cells = column_cells(column)
                 if hasattr(codec, 'decode_batch'):
-                    values = codec.decode_batch(field, cells)
+                    hint = decode_hints.get(name)
+                    values = (codec.decode_batch(field, cells, min_size=hint) if hint
+                              else codec.decode_batch(field, cells))
                 else:
                     values = [None if v is None else codec.decode(field, v) for v in cells]
                 decoded = stack_cells(values)

@@ -1,15 +1,24 @@
 """Payload serializers for the worker-process -> main-process results channel.
 
 Parity: /root/reference/petastorm/reader_impl/{pickle_serializer,
-pyarrow_serializer, arrow_table_serializer}.py. Pickle is the default;
-``ArrowTableSerializer`` moves columnar batches as Arrow IPC record-batch
-streams, which is zero-copy on the receive side.
+pyarrow_serializer, arrow_table_serializer}.py — the reference routes batch
+readers through its Arrow record-batch-stream serializer (reference
+reader.py:269) and everything else through pickle.
+
+TPU-first: our workers publish *column blocks* (dicts of numpy arrays), so the
+default transport is :class:`NumpyBlockSerializer` — a raw-buffer framing whose
+deserialize is near-zero-cost (numpy views over the received message, no parse,
+no per-array copy). Pickle remains the universal fallback and is embedded for
+non-block payloads; ``ArrowTableSerializer`` covers ``pyarrow.Table`` payloads
+for users who plug Arrow-producing workers in.
 """
 
 from __future__ import annotations
 
 import pickle
+import struct
 
+import numpy as np
 import pyarrow as pa
 
 
@@ -19,6 +28,63 @@ class PickleSerializer(object):
 
     def deserialize(self, data):
         return pickle.loads(data)
+
+
+class NumpyBlockSerializer(object):
+    """Column blocks (dict of numpy arrays) as a pickled header + concatenated
+    raw array buffers.
+
+    Serialize is one memcpy per array (vs. pickle's pickler machinery — ~3x
+    faster on image-sized blocks); deserialize builds numpy VIEWS over the
+    received message (~zero cost), which is safe for both transports: the shm
+    ring copies each message into a fresh per-message buffer
+    (native/shm_ring.py:try_read_view) and zmq hands out an owning bytes — the
+    views keep either alive. Object-dtype columns and non-block payloads
+    (NGram window lists, exceptions, sentinels) ride an embedded pickle.
+    """
+
+    _BLOCK = b'N'
+    _PICKLE = b'P'
+
+    def serialize(self, obj):
+        if not isinstance(obj, dict) or not obj:
+            return self._PICKLE + pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+        raw = {}
+        others = {}
+        for k, v in obj.items():
+            if (isinstance(v, np.ndarray) and v.dtype != object and not v.dtype.hasobject
+                    and v.dtype.names is None):  # structured dtypes lose field
+                raw[k] = np.ascontiguousarray(v)  # names through dtype.str: pickle them
+            else:
+                others[k] = v
+        try:
+            header = pickle.dumps(
+                ([(k, v.dtype.str, v.shape) for k, v in raw.items()], others),
+                protocol=pickle.HIGHEST_PROTOCOL)
+        except Exception:  # noqa: BLE001 - unpicklable extras: let pickle raise uniformly
+            return self._PICKLE + pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+        parts = [self._BLOCK, struct.pack('<I', len(header)), header]
+        # datetime/timedelta arrays refuse buffer export (PEP 3118); tobytes
+        parts.extend(v.tobytes() if v.dtype.kind in 'Mm' else memoryview(v).cast('B')
+                     for v in raw.values())
+        return b''.join(parts)
+
+    def deserialize(self, data):
+        mv = memoryview(data)
+        marker = bytes(mv[:1])
+        if marker == self._PICKLE:
+            return pickle.loads(mv[1:])
+        (hlen,) = struct.unpack('<I', mv[1:5])
+        meta, out = pickle.loads(mv[5:5 + hlen])
+        off = 5 + hlen
+        for name, dtype_str, shape in meta:
+            dt = np.dtype(dtype_str)
+            n = dt.itemsize
+            for dim in shape:
+                n *= dim
+            out[name] = np.frombuffer(mv[off:off + n], dtype=dt).reshape(shape)
+            off += n
+        return out
 
 
 class ArrowTableSerializer(object):

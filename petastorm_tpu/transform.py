@@ -28,15 +28,24 @@ class TransformSpec(object):
         must return the same — no per-row dict is ever materialized, keeping the
         worker's hot path columnar. Batch readers always pass columns to
         ``func`` regardless of this flag.
+    :param image_decode_hints: ``{field_name: (min_h, min_w)}`` — a promise that
+        ``func`` will downscale these image fields to at most that size, which
+        lets the decode worker use scaled JPEG decode (libjpeg m/8 DCT scaling:
+        images arrive at the smallest scale still covering the minimum, so most
+        pixels of a large photo are never computed). ``func`` must therefore
+        accept images of any size >= the hint (or the original size, if
+        smaller) — exactly what a resize-to-target transform does. PNG fields
+        are unaffected (no scaled decode exists for the format).
     """
 
     def __init__(self, func=None, edit_fields=None, removed_fields=None, selected_fields=None,
-                 batched=False):
+                 batched=False, image_decode_hints=None):
         self.func = func
         self.edit_fields = [self._as_field(f) for f in (edit_fields or [])]
         self.removed_fields = list(removed_fields or [])
         self.selected_fields = list(selected_fields) if selected_fields is not None else None
         self.batched = batched
+        self.image_decode_hints = dict(image_decode_hints or {})
 
     @staticmethod
     def _as_field(field_or_tuple):

@@ -164,3 +164,91 @@ def test_uint16_rgb_png_roundtrip_through_codec():
     img = rng.integers(0, 65536, (18, 22, 3), np.uint16)
     (out,) = codec.decode_batch(field, [codec.encode(field, img)])
     np.testing.assert_array_equal(out, img)
+
+
+# -- scaled JPEG decode (round 3) --------------------------------------------
+
+def _jpeg_bytes(h, w, quality=85, seed=0):
+    import cv2
+    rng = np.random.default_rng(seed)
+    img = rng.integers(0, 255, (h, w, 3), dtype=np.uint8)
+    ok, enc = cv2.imencode('.jpeg', img, [int(cv2.IMWRITE_JPEG_QUALITY), quality])
+    assert ok
+    return enc.tobytes()
+
+
+@pytest.mark.skipif(not image_codec.is_available(), reason='native codec unavailable')
+def test_scaled_jpeg_dims_cover_min_size():
+    enc = _jpeg_bytes(1200, 900)
+    out = image_codec.decode_images([enc], min_size=(160, 160))[0]
+    # smallest m/8 covering 160: m=2 -> ceil(1200*2/8)=300, ceil(900*2/8)=225
+    assert out.shape == (300, 225, 3)
+    assert out.shape[0] >= 160 and out.shape[1] >= 160
+
+
+@pytest.mark.skipif(not image_codec.is_available(), reason='native codec unavailable')
+def test_scaled_jpeg_small_image_stays_full_size():
+    enc = _jpeg_bytes(100, 80)
+    out = image_codec.decode_images([enc], min_size=(160, 160))[0]
+    assert out.shape == (100, 80, 3)  # cannot upscale; full size
+
+
+@pytest.mark.skipif(not image_codec.is_available(), reason='native codec unavailable')
+def test_scaled_decode_png_ignores_hint():
+    import cv2
+    img = np.random.default_rng(1).integers(0, 255, (400, 300, 3), dtype=np.uint8)
+    ok, enc = cv2.imencode('.png', img)
+    out = image_codec.decode_images([enc.tobytes()], min_size=(100, 100))[0]
+    assert out.shape == (400, 300, 3)
+
+
+@pytest.mark.skipif(not image_codec.is_available(), reason='native codec unavailable')
+def test_scaled_jpeg_approximates_area_resize():
+    import cv2
+    enc = _jpeg_bytes(800, 600, seed=3)
+    full = image_codec.decode_images([enc])[0]
+    scaled = image_codec.decode_images([enc], min_size=(160, 160))[0]
+    ref = cv2.resize(full, (scaled.shape[1], scaled.shape[0]),
+                     interpolation=cv2.INTER_AREA)
+    diff = np.abs(scaled.astype(int) - ref.astype(int)).mean()
+    assert diff < 20  # DCT scaling ~= area resampling (random noise is worst case)
+
+
+@pytest.mark.skipif(not image_codec.is_available(), reason='native codec unavailable')
+def test_scaled_mixed_batch_per_image_scales():
+    encs = [_jpeg_bytes(640, 480, seed=4), _jpeg_bytes(120, 90, seed=5),
+            _jpeg_bytes(1600, 1200, seed=6)]
+    outs = image_codec.decode_images(encs, min_size=(160, 160))
+    assert outs[0].shape == (240, 180, 3)   # m=3
+    assert outs[1].shape == (120, 90, 3)    # smaller than min: full
+    assert outs[2].shape == (400, 300, 3)   # m=2 (m=1 would give width 150 < 160)
+
+
+def test_codec_decode_batch_min_size_passthrough():
+    codec = CompressedImageCodec('jpeg')
+    field = UnischemaField('im', np.uint8, (None, None, 3), codec, False)
+    enc = _jpeg_bytes(800, 600, seed=7)
+    outs = codec.decode_batch(field, [enc, None], min_size=(160, 160))
+    assert outs[1] is None
+    assert outs[0].shape[0] >= 160 and outs[0].shape[0] < 800
+
+
+def test_transform_decode_hints_end_to_end(tmp_path):
+    """A jpeg dataset read with TransformSpec(image_decode_hints=...) resizes
+    through scaled decode and still yields exact target shapes."""
+    import cv2
+    from examples.imagenet.generate_petastorm_imagenet import generate_synthetic_imagenet
+    from examples.imagenet.jax_resnet_example import make_transform
+    from petastorm_tpu import make_reader
+    url = 'file://' + str(tmp_path / 'jpg_ds')
+    generate_synthetic_imagenet(url, num_synsets=2, images_per_synset=8,
+                                rows_per_row_group=8, image_codec='jpeg',
+                                min_dim=200, max_dim=400)
+    with make_reader(url, reader_pool_type='dummy', output='columnar',
+                     shuffle_row_groups=False,
+                     transform_spec=make_transform(96, 10)) as reader:
+        blocks = [b._asdict() for b in reader]
+    images = np.concatenate([b['image'] for b in blocks])
+    assert images.shape == (16, 96, 96, 3)
+    labels = np.concatenate([b['label'] for b in blocks])
+    assert set(labels.tolist()) <= set(range(10))

@@ -220,3 +220,92 @@ class TestProcessPoolTransports:
     def test_unknown_transport_rejected(self):
         with pytest.raises(ValueError, match='transport'):
             ProcessPool(1, transport='carrier-pigeon')
+
+
+class TestNumpyBlockSerializer:
+    """Raw-buffer block serializer: the process-pool default (round 3)."""
+
+    def _rt(self, obj):
+        from petastorm_tpu.serializers import NumpyBlockSerializer
+        s = NumpyBlockSerializer()
+        return s.deserialize(s.serialize(obj))
+
+    def test_numeric_block_roundtrip_values_and_dtypes(self):
+        import numpy as np
+        block = {'img': np.arange(2 * 3 * 4, dtype=np.uint8).reshape(2, 3, 4),
+                 'f': np.linspace(0, 1, 5, dtype=np.float32),
+                 'ts': np.array(['2024-01-01', '2024-01-02'], dtype='datetime64[ns]')}
+        out = self._rt(block)
+        assert set(out) == set(block)
+        for k in block:
+            np.testing.assert_array_equal(out[k], block[k])
+            assert out[k].dtype == block[k].dtype
+
+    def test_mixed_block_object_columns_via_pickle(self):
+        import numpy as np
+        ragged = np.empty(2, dtype=object)
+        ragged[0], ragged[1] = np.ones(2), np.ones(5)
+        block = {'a': np.arange(3), 'ragged': ragged, 's': np.array(['x', 'yy'], dtype=object)}
+        out = self._rt(block)
+        np.testing.assert_array_equal(out['a'], np.arange(3))
+        assert out['ragged'][1].shape == (5,)
+        assert out['s'].tolist() == ['x', 'yy']
+
+    def test_non_block_payloads_roundtrip(self):
+        import numpy as np
+        rows = [{'x': np.ones(2)}, {'x': np.zeros(2)}]  # ngram-style list
+        out = self._rt(rows)
+        assert isinstance(out, list) and len(out) == 2
+        exc = self._rt(ValueError('boom'))
+        assert isinstance(exc, ValueError)
+        assert self._rt({}) == {}
+
+    def test_views_reference_message_not_copies(self):
+        import numpy as np
+        from petastorm_tpu.serializers import NumpyBlockSerializer
+        s = NumpyBlockSerializer()
+        data = s.serialize({'a': np.arange(10, dtype=np.int64)})
+        out = s.deserialize(data)
+        assert out['a'].base is not None  # a view over the message, not a copy
+
+    @pytest.mark.parametrize('serializer_name', ['numpy_block', 'pickle'])
+    def test_process_pool_block_payloads(self, serializer_name, tmp_path):
+        """A process-pool columnar read returns identical data under both the
+        raw-buffer default and plain pickle (reference reader.py:269 analog)."""
+        import numpy as np
+        from petastorm_tpu import make_reader
+        from petastorm_tpu import reader as reader_mod
+        from petastorm_tpu.codecs import NdarrayCodec, ScalarCodec
+        from petastorm_tpu.etl.dataset_metadata import write_petastorm_dataset
+        from petastorm_tpu.serializers import NumpyBlockSerializer, PickleSerializer
+        from petastorm_tpu.unischema import Unischema, UnischemaField
+
+        schema = Unischema('S', [
+            UnischemaField('id', np.int64, (), ScalarCodec(), False),
+            UnischemaField('vec', np.float32, (4,), NdarrayCodec(), False),
+        ])
+        url = 'file://' + str(tmp_path / 'ds')
+        rng = np.random.default_rng(0)
+        expected = {i: rng.standard_normal(4).astype(np.float32) for i in range(40)}
+        write_petastorm_dataset(url, schema, ({'id': i, 'vec': expected[i]}
+                                              for i in range(40)), rows_per_row_group=10)
+
+        serializer = NumpyBlockSerializer() if serializer_name == 'numpy_block' else PickleSerializer()
+        orig = reader_mod._make_pool
+
+        def patched(pool_type, workers, qsize, serializer_arg=None):
+            return orig(pool_type, workers, qsize, serializer=serializer)
+
+        reader_mod._make_pool = patched
+        try:
+            with make_reader(url, reader_pool_type='process', workers_count=2,
+                             output='columnar', shuffle_row_groups=False) as reader:
+                seen = {}
+                for block in reader:
+                    for i, row_id in enumerate(block.id.tolist()):
+                        seen[int(row_id)] = np.asarray(block.vec[i])
+        finally:
+            reader_mod._make_pool = orig
+        assert sorted(seen) == sorted(expected)
+        for k in expected:
+            np.testing.assert_array_equal(seen[k], expected[k])
