@@ -1,0 +1,174 @@
+#!/usr/bin/env python
+"""bench_pod.py — BASELINE.md config 5 as one command: sharded multi-host
+reading + NGram sequence readout feeding a ('data','seq')-sharded step.
+
+Runs TODAY on a virtual CPU mesh (default: 8 forced host devices, 4 simulated
+hosts in one process — the same strategy the reference uses to test multi-node
+sharding without a cluster, reference test_end_to_end.py:426-448) and
+UNCHANGED on a real pod: on v5e-16 each JAX process executes exactly one
+host's branch (``cur_shard=jax.process_index()``), the loop over simulated
+hosts disappears, and the mesh spans the real chips.
+
+Per simulated host it builds: make_reader(cur_shard=h, shard_count=H,
+ngram=window) -> JaxDataLoader -> stack_ngram_time_axis -> [B, T, ...] batches
+staged over the ('data','seq') mesh -> a jitted sequence-model step. Emits one
+JSON line per host plus an aggregate:
+  {"metric": "pod_host", "host": h, "examples_per_sec": .., "stall": ..}
+  {"metric": "pod_aggregate", "hosts": H, "examples_per_sec_total": .., ...}
+
+Usage: python bench_pod.py [--hosts 4] [--steps 20] [--seq-len 4]
+       (set JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
+        off-pod; the script forces them itself when no pod is present)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+
+def _ensure_devices(n):
+    """Force an n-device CPU platform when the ambient backend is smaller
+    (same respawn trick as __graft_entry__.dryrun_multichip)."""
+    import __graft_entry__ as g
+    os.environ['XLA_FLAGS'] = g._force_device_count_flag(os.environ.get('XLA_FLAGS', ''), n)
+    import jax
+    if os.environ.get('_PSTPU_POD_CHILD'):
+        jax.config.update('jax_platforms', 'cpu')
+    try:
+        have = len(jax.devices())
+    except RuntimeError:
+        have = 0
+    if have >= n:
+        return True
+    if os.environ.get('_PSTPU_POD_CHILD'):
+        raise RuntimeError('need {} devices, found {}'.format(n, have))
+    import subprocess
+    env = dict(os.environ, JAX_PLATFORMS='cpu', _PSTPU_POD_CHILD='1')
+    env['XLA_FLAGS'] = g._force_device_count_flag(env.get('XLA_FLAGS', ''), n)
+    env['PYTHONPATH'] = REPO_ROOT + os.pathsep + env.get('PYTHONPATH', '')
+    rc = subprocess.run([sys.executable, os.path.abspath(__file__)] + sys.argv[1:],
+                        env=env).returncode
+    sys.exit(rc)
+
+
+def build_sequence_store(url, rows, feature_dim):
+    """Timestamped telemetry-style rows: NGram's native shape."""
+    import numpy as np
+    from petastorm_tpu.codecs import NdarrayCodec, ScalarCodec
+    from petastorm_tpu.etl.dataset_metadata import write_petastorm_dataset
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+
+    schema = Unischema('PodSeq', [
+        UnischemaField('ts', np.int64, (), ScalarCodec(), False),
+        UnischemaField('features', np.float32, (feature_dim,), NdarrayCodec(), False),
+    ])
+    rng = np.random.default_rng(0)
+    write_petastorm_dataset(url, schema, ({
+        'ts': i,
+        'features': rng.standard_normal(feature_dim).astype(np.float32),
+    } for i in range(rows)), rows_per_row_group=64)
+    return schema
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('--hosts', type=int, default=4)
+    parser.add_argument('--devices', type=int, default=8)
+    parser.add_argument('--steps', type=int, default=20)
+    parser.add_argument('--batch-size', type=int, default=16)
+    parser.add_argument('--seq-len', type=int, default=4)
+    parser.add_argument('--feature-dim', type=int, default=64)
+    parser.add_argument('--rows', type=int, default=4096)
+    parser.add_argument('--workers', type=int, default=2)
+    args = parser.parse_args(argv)
+
+    _ensure_devices(args.devices)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from petastorm_tpu import make_reader
+    from petastorm_tpu.jax import JaxDataLoader
+    from petastorm_tpu.jax.loader import stack_ngram_time_axis
+    from petastorm_tpu.ngram import NGram
+    from petastorm_tpu.parallel import make_mesh
+    from petastorm_tpu.unischema import UnischemaField
+
+    tmpdir = tempfile.mkdtemp(prefix='bench_pod_')
+    url = 'file://' + os.path.join(tmpdir, 'store')
+    schema = build_sequence_store(url, args.rows, args.feature_dim)
+
+    seq_axis = 2 if args.devices % 2 == 0 else 1
+    mesh = make_mesh(('data', 'seq'), axis_shapes=(-1, seq_axis),
+                     devices=jax.devices()[:args.devices])
+    batch_sharding = NamedSharding(mesh, P('data', 'seq'))
+
+    fields = {i: [UnischemaField('ts', np.int64, ()),
+                  UnischemaField('features', np.float32, (args.feature_dim,))]
+              for i in range(args.seq_len)}
+
+    # a small jitted sequence step: per-timestep projection + cross-time mix,
+    # sharded over ('data','seq') — the data-side half of context parallelism
+    w = jnp.ones((args.feature_dim, args.feature_dim), jnp.float32) / args.feature_dim
+
+    @jax.jit
+    def seq_step(x, w):  # x: [B, T, F]
+        h = jnp.einsum('btf,fg->btg', x, w)
+        h = h + jnp.roll(h, 1, axis=1)  # cross-timestep dependency
+        return jnp.mean(h * h)
+
+    total_rate = 0.0
+    worst_stall = 0.0
+    for host in range(args.hosts):
+        ngram = NGram(fields, delta_threshold=1,
+                      timestamp_field=UnischemaField('ts', np.int64, ()))
+        with make_reader(url, reader_pool_type='thread', workers_count=args.workers,
+                         ngram=ngram, cur_shard=host, shard_count=args.hosts,
+                         shuffle_row_groups=True, seed=13, num_epochs=None) as reader:
+            loader = JaxDataLoader(reader, batch_size=args.batch_size, seed=13)
+            it = iter(loader)
+            out = None
+            for _ in range(3):  # warmup + compile
+                batch = stack_ngram_time_axis(next(it))
+                x = jax.device_put(batch['features'], batch_sharding)
+                out = seq_step(x, w)
+            jax.block_until_ready(out)
+            wait = 0.0
+            t0 = time.perf_counter()
+            for _ in range(args.steps):
+                w0 = time.perf_counter()
+                batch = stack_ngram_time_axis(next(it))
+                wait += time.perf_counter() - w0
+                x = jax.device_put(batch['features'], batch_sharding)
+                out = seq_step(x, w)
+            jax.block_until_ready(out)
+            dt = time.perf_counter() - t0
+        rate = args.steps * args.batch_size / dt
+        stall = wait / dt
+        total_rate += rate
+        worst_stall = max(worst_stall, stall)
+        print(json.dumps({'metric': 'pod_host', 'host': host,
+                          'examples_per_sec': round(rate, 1),
+                          'stall': round(stall, 4)}), flush=True)
+    print(json.dumps({'metric': 'pod_aggregate', 'hosts': args.hosts,
+                      'devices': args.devices, 'seq_len': args.seq_len,
+                      'examples_per_sec_total': round(total_rate, 1),
+                      'worst_host_stall': round(worst_stall, 4),
+                      'simulated': True,
+                      'note': 'hosts run serially in one process off-pod; on a '
+                              'real pod each process runs its own shard'}), flush=True)
+
+
+if __name__ == '__main__':
+    main()

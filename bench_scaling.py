@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""Worker-pool scaling curve: samples/sec at workers in {1,2,4,8} for thread
+and process pools, on a PNG-decode workload (the reader's dominant real cost).
+
+One JSON line per point:
+  {"metric": "scaling", "pool": "thread", "workers": 4, "samples_per_sec": ...,
+   "host_cores": N}
+
+The docs/benchmarks.md "cores_needed" budget formula is backed by this curve —
+run it on the host whose budget you are sizing (scaling is flat on a 1-core
+host by construction; the 8-CPU dryrun environment shows the real slope).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+
+def build_store(url, rows):
+    from bench_duty import build_png_store
+    build_png_store(url, rows)
+
+
+def measure(url, pool, workers, measure_rows=2000, warmup_rows=200):
+    from petastorm_tpu import make_reader
+    with make_reader(url, reader_pool_type=pool, workers_count=workers,
+                     output='columnar', shuffle_row_groups=True, seed=0,
+                     num_epochs=None) as reader:
+        it = iter(reader)
+        seen = 0
+        while seen < warmup_rows:
+            seen += len(next(it)[0])
+        seen = 0
+        t0 = time.perf_counter()
+        while seen < measure_rows:
+            seen += len(next(it)[0])
+        dt = time.perf_counter() - t0
+    return seen / dt
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('--workers', default='1,2,4,8')
+    parser.add_argument('--pools', default='thread,process')
+    parser.add_argument('--rows', type=int, default=512)
+    parser.add_argument('--measure-rows', type=int, default=2000)
+    parser.add_argument('--keep-dir', default=None)
+    args = parser.parse_args(argv)
+
+    tmpdir = args.keep_dir or tempfile.mkdtemp(prefix='bench_scaling_')
+    # stamp the kept store with its row count so a changed --rows rebuilds
+    # instead of silently measuring a stale store
+    store_dir = os.path.join(tmpdir, 'store_{}rows'.format(args.rows))
+    url = 'file://' + store_dir
+    if not os.path.exists(os.path.join(store_dir, '_common_metadata')):
+        build_store(url, args.rows)
+
+    for pool in args.pools.split(','):
+        for w in (int(x) for x in args.workers.split(',')):
+            rate = measure(url, pool.strip(), w, measure_rows=args.measure_rows)
+            print(json.dumps({'metric': 'scaling', 'pool': pool.strip(), 'workers': w,
+                              'samples_per_sec': round(rate, 1),
+                              'host_cores': os.cpu_count()}), flush=True)
+
+
+if __name__ == '__main__':
+    main()

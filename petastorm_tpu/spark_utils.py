@@ -1,7 +1,10 @@
 """PySpark ingestion helpers (reference: petastorm/spark_utils.py:23-52).
 
-pyspark is an optional dependency: these helpers import it lazily and raise a
-clear error when absent. The local analog — reading a dataset into a pandas
+pyspark is an optional dependency: :func:`dataset_as_rdd` never imports it —
+it duck-types the session object it is handed (anything exposing
+``sparkContext.defaultParallelism``/``parallelize`` works, which also keeps the
+shard arithmetic unit-testable without a pyspark install) and raises TypeError
+for non-session arguments. The local analog — reading a dataset into a pandas
 DataFrame — needs no Spark and is provided as :func:`dataset_as_dataframe`.
 """
 
@@ -14,17 +17,21 @@ def dataset_as_rdd(dataset_url, spark_session, schema_fields=None):
     Each Spark partition opens its own reader over one shard of the dataset
     (share-nothing, matching the reader's ``cur_shard`` arithmetic).
     """
-    try:
-        import pyspark  # noqa: F401
-    except ImportError:
-        raise ImportError('dataset_as_rdd requires pyspark, which is not installed. '
-                          'Use dataset_as_dataframe (pandas) or make_reader directly.')
+    # duck-typed: anything exposing sparkContext.{defaultParallelism,
+    # parallelize} works, which keeps the shard arithmetic unit-testable
+    # without a pyspark install (tests/test_tools.py stubs the session)
+    sc = getattr(spark_session, 'sparkContext', None)
+    if sc is None:
+        raise TypeError(
+            'dataset_as_rdd needs a SparkSession-like object with a sparkContext '
+            '(got {!r}). If pyspark is not installed, use dataset_as_dataframe '
+            '(pandas) or make_reader directly.'.format(type(spark_session).__name__))
 
     from petastorm_tpu.etl.dataset_metadata import get_schema_from_dataset_url
 
     schema = get_schema_from_dataset_url(dataset_url)
     fields = schema_fields if schema_fields is not None else list(schema.fields)
-    num_partitions = spark_session.sparkContext.defaultParallelism
+    num_partitions = sc.defaultParallelism
 
     def _read_shard(shard_index):
         from petastorm_tpu import make_reader
@@ -33,9 +40,7 @@ def dataset_as_rdd(dataset_url, spark_session, schema_fields=None):
                          num_epochs=1) as reader:
             return list(reader)
 
-    return spark_session.sparkContext \
-        .parallelize(range(num_partitions), num_partitions) \
-        .flatMap(_read_shard)
+    return sc.parallelize(range(num_partitions), num_partitions).flatMap(_read_shard)
 
 
 def dataset_as_dataframe(dataset_url, schema_fields=None):

@@ -62,3 +62,25 @@ class SetupArgsEchoWorker(WorkerBase):
 
     def process(self, value):
         self.publish((value, self.args))
+
+
+class BlobWorker(WorkerBase):
+    """Publishes ``args['count']`` deterministic blobs of ``args['size']``
+    bytes per item — sized-payload stress for the results transport."""
+
+    def process(self, item):
+        size = self.args['size']
+        for j in range(self.args.get('count', 1)):
+            self.publish({'item': item, 'j': j,
+                          'blob': bytes([(item + j) % 251]) * size})
+
+
+class HardExitWorker(WorkerBase):
+    """Simulates a worker CRASH (``os._exit``, no exception forwarding) on a
+    chosen item; other items pass through."""
+
+    def process(self, item):
+        import os
+        if item == self.args.get('crash_on', 0):
+            os._exit(13)
+        self.publish([item])

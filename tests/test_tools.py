@@ -129,3 +129,65 @@ def test_duty_cycle_measurement(synthetic_dataset):
                        'reader_pool_type': 'thread', 'workers_count': 2})
     assert result.samples == 160
     assert 0.0 <= result.input_stall_fraction <= 1.0
+
+
+class _StubRDD(object):
+    """Executes the pyspark RDD chain locally (reference-style mock testing:
+    the reference exercised HDFS failover with MockHdfs the same way)."""
+
+    def __init__(self, items, num_slices):
+        self.items = list(items)
+        self.num_slices = num_slices
+
+    def flatMap(self, fn):
+        out = []
+        for item in self.items:
+            out.extend(fn(item))
+        return _StubRDD(out, self.num_slices)
+
+    def collect(self):
+        return list(self.items)
+
+
+class _StubSparkContext(object):
+    def __init__(self, parallelism):
+        self.defaultParallelism = parallelism
+        self.parallelize_calls = []
+
+    def parallelize(self, seq, num_slices):
+        self.parallelize_calls.append((list(seq), num_slices))
+        return _StubRDD(seq, num_slices)
+
+
+class _StubSparkSession(object):
+    def __init__(self, parallelism):
+        self.sparkContext = _StubSparkContext(parallelism)
+
+
+def test_dataset_as_rdd_shard_math_with_stub_spark(synthetic_dataset):
+    """dataset_as_rdd partitions the dataset by cur_shard/shard_count and the
+    union of all partitions covers every row exactly once
+    (reference spark_utils.py:23-52 semantics, no pyspark needed)."""
+    from petastorm_tpu.spark_utils import dataset_as_rdd
+
+    session = _StubSparkSession(parallelism=4)
+    rdd = dataset_as_rdd(synthetic_dataset.url, session, schema_fields=['id'])
+    rows = rdd.collect()
+    # one parallelize over exactly shard indices 0..3, 4 slices
+    assert session.sparkContext.parallelize_calls == [([0, 1, 2, 3], 4)]
+    assert sorted(int(r.id) for r in rows) == sorted(r['id'] for r in synthetic_dataset.data)
+
+
+def test_dataset_as_rdd_rejects_non_spark_session(synthetic_dataset):
+    from petastorm_tpu.spark_utils import dataset_as_rdd
+    with pytest.raises(TypeError, match='SparkSession'):
+        dataset_as_rdd(synthetic_dataset.url, object())
+
+
+def test_throughput_fresh_process_respawn(synthetic_dataset):
+    """--fresh-process re-executes the measurement in a spawned interpreter so
+    RSS excludes the caller (reference benchmark/throughput.py:146-151)."""
+    from petastorm_tpu.tools import throughput
+    rc = throughput.main([synthetic_dataset.url, '-m', '2', '-n', '10', '-w', '1',
+                          '--fresh-process'])
+    assert rc == 0

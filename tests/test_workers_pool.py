@@ -309,3 +309,71 @@ class TestNumpyBlockSerializer:
         assert sorted(seen) == sorted(expected)
         for k in expected:
             np.testing.assert_array_equal(seen[k], expected[k])
+
+
+@pytest.mark.skipif(
+    not __import__('petastorm_tpu.native.shm_ring', fromlist=['is_available']).is_available(),
+    reason='shm ring unavailable')
+class TestShmRingStress:
+    """Round-3 stress coverage of the default process-pool transport: ring
+    wrap-around under sustained load, payloads exceeding ring capacity,
+    worker crash mid-run, and /dev/shm exhaustion -> zmq fallback."""
+
+    def test_wraparound_many_payloads_intact(self):
+        from petastorm_tpu.test_util.stub_workers import BlobWorker
+        # 30 items x 3 blobs x 200KB = ~18MB through a 1MB ring
+        pool = ProcessPool(1, transport='shm', ring_bytes=1 << 20)
+        pool.start(BlobWorker, {'size': 200 * 1024, 'count': 3})
+        try:
+            for i in range(30):
+                pool.ventilate(i)
+            got = []
+            for _ in range(90):
+                r = pool.get_results(timeout_s=60)
+                assert r['blob'] == bytes([(r['item'] + r['j']) % 251]) * (200 * 1024)
+                got.append((r['item'], r['j']))
+            assert sorted(got) == [(i, j) for i in range(30) for j in range(3)]
+        finally:
+            pool.stop()
+            pool.join()
+
+    def test_payload_larger_than_ring_raises_not_hangs(self):
+        from petastorm_tpu.test_util.stub_workers import BlobWorker
+        pool = ProcessPool(1, transport='shm', ring_bytes=1 << 20)
+        pool.start(BlobWorker, {'size': 2 << 20})  # 2MB > 1MB ring
+        try:
+            pool.ventilate(0)
+            with pytest.raises(ValueError, match='exceeds ring capacity'):
+                pool.get_results(timeout_s=60)
+        finally:
+            pool.stop()
+            pool.join()
+
+    def test_worker_crash_mid_run_times_out_cleanly(self):
+        from petastorm_tpu.test_util.stub_workers import HardExitWorker
+        from petastorm_tpu.workers.process_pool import TimeoutWaitingForResultError
+        pool = ProcessPool(1, transport='shm', ring_bytes=1 << 20, results_timeout_s=3)
+        pool.start(HardExitWorker, {'crash_on': 1})
+        try:
+            pool.ventilate(0)
+            assert pool.get_results() == [0]
+            pool.ventilate(1)  # worker dies here
+            with pytest.raises(TimeoutWaitingForResultError):
+                while True:
+                    pool.get_results()
+        finally:
+            pool.stop()
+            pool.join()
+
+    def test_dev_shm_exhaustion_falls_back_to_zmq(self):
+        from petastorm_tpu.test_util.stub_workers import IdentityWorker
+        # absurd ring size: statvfs guard trips, pool degrades to zmq
+        pool = ProcessPool(1, transport='shm', ring_bytes=1 << 45)
+        pool.start(IdentityWorker)
+        try:
+            assert pool.transport == 'zmq'
+            pool.ventilate(7)
+            assert pool.get_results(timeout_s=30) == 7
+        finally:
+            pool.stop()
+            pool.join()
