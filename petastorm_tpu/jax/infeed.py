@@ -42,10 +42,25 @@ def stage_batch(batch, target):
     return put(batch)
 
 
-def prefetch_to_device(iterator, target=None, size=2):
+def prefetch_to_device(iterator, target=None, size=2, background=True):
     """Yield batches from ``iterator`` staged onto ``target`` (a device or a
     ``Sharding``; default: the default device), keeping ``size`` transfers in
     flight ahead of the consumer.
+
+    ``background=True`` (default) pulls + stages on a dedicated thread, so the
+    loader's batch assembly and the host-side cost of ``device_put`` overlap
+    with whatever the consumer thread does between ``next()`` calls (dispatching
+    the train step) — on a multi-core host the consumer's wait collapses to a
+    queue pop when the pipeline keeps up. ``background=False`` keeps the
+    original synchronous refill (deterministic single-thread execution, e.g.
+    for profiling the pipeline itself).
+
+    Checkpointing: ``JaxDataLoader.state_dict()`` is safe to call while this
+    prefetcher is pumping (the loader serializes batch production against
+    snapshots), but batches already staged into the prefetch queue count as
+    delivered — a resume continues AFTER them, so a checkpoint taken mid-step
+    skips up to ``size`` in-flight batches. Checkpoint at step boundaries with
+    the queue drained (or use ``background=False, size=1``) for exact resume.
 
     :param iterator: iterable of batch dicts (possibly nested, e.g. NGram)
     :param target: ``jax.Device`` | ``jax.sharding.Sharding`` | None
@@ -58,17 +73,67 @@ def prefetch_to_device(iterator, target=None, size=2):
     if size < 1:
         raise ValueError('size must be >= 1')
 
-    queue = deque()
-    it = iter(iterator)
+    if not background:
+        queue = deque()
+        it = iter(iterator)
+        try:
+            while True:
+                while len(queue) < size:
+                    try:
+                        queue.append(stage_batch(next(it), target))
+                    except StopIteration:
+                        while queue:
+                            yield queue.popleft()
+                        return
+                yield queue.popleft()
+        finally:
+            queue.clear()
+        return
+
+    import queue as queue_mod
+    import threading
+
+    q = queue_mod.Queue(maxsize=size)
+    stop = threading.Event()
+
+    class _Final(object):  # private sentinel: no user batch can be this type
+        def __init__(self, exc=None):
+            self.exc = exc
+
+    def _pump():
+        try:
+            for batch in iterator:
+                staged = stage_batch(batch, target)
+                while not stop.is_set():
+                    try:
+                        q.put(staged, timeout=0.1)
+                        break
+                    except queue_mod.Full:
+                        continue
+                if stop.is_set():
+                    return
+            _put_final(_Final())
+        except BaseException as exc:  # noqa: BLE001 - re-raised on the consumer thread
+            _put_final(_Final(exc))
+
+    def _put_final(item):
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return
+            except queue_mod.Full:
+                continue
+
+    thread = threading.Thread(target=_pump, daemon=True, name='pstpu-prefetch')
+    thread.start()
     try:
         while True:
-            while len(queue) < size:
-                try:
-                    queue.append(stage_batch(next(it), target))
-                except StopIteration:
-                    while queue:
-                        yield queue.popleft()
-                    return
-            yield queue.popleft()
+            item = q.get()
+            if isinstance(item, _Final):
+                if item.exc is not None:
+                    raise item.exc
+                return
+            yield item
     finally:
-        queue.clear()
+        stop.set()
+        thread.join(timeout=5)

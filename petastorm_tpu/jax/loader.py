@@ -16,6 +16,7 @@ TPU-first differences:
 from __future__ import annotations
 
 import logging
+import threading
 from decimal import Decimal
 
 import numpy as np
@@ -151,15 +152,19 @@ class JaxDataLoader(object):
         self._drop_last = drop_last
         self._to_device = to_device
         self._ngram = getattr(reader, 'ngram', None)
+        # serializes batch production against state_dict(): prefetch_to_device
+        # (background=True) iterates this loader from a pump thread while a
+        # checkpoint may be taken from the training thread
+        self._state_lock = threading.Lock()
         # columnar fast path: readers that emit column blocks (make_batch_reader,
         # make_reader(output='columnar')) never materialize rows — batches are
         # numpy slices/gathers of whole blocks
         self._columnar = bool(reader.batched_output) and self._ngram is None
         if self._columnar:
             from petastorm_tpu.columnar import FifoColumnarBuffer, ShuffledColumnarBuffer
+            from petastorm_tpu.shuffling_buffer import default_min_after
             if shuffling_queue_capacity > 0:
-                floor = (min_after_retrieve if min_after_retrieve is not None
-                         else max(1, shuffling_queue_capacity // 2))
+                floor = default_min_after(shuffling_queue_capacity, min_after_retrieve)
                 self._make_buffer = lambda: ShuffledColumnarBuffer(
                     shuffling_queue_capacity, floor, seed)
             else:
@@ -208,9 +213,21 @@ class JaxDataLoader(object):
         # clear even when empty: a leftover [] would permanently re-route
         # state_dict() to the (now stale) resume branch
         self._resume_rows = None
-        if self._columnar:
-            return self._iterate_columnar(buffer)
-        return self._iterate(buffer, self._pending)
+        gen = (self._iterate_columnar(buffer) if self._columnar
+               else self._iterate(buffer, self._pending))
+        return self._locked_steps(gen)
+
+    def _locked_steps(self, gen):
+        """Each batch production holds the state lock, so a ``state_dict()``
+        taken from another thread (background prefetch pumping this loader)
+        sees a consistent between-batches snapshot."""
+        while True:
+            with self._state_lock:
+                try:
+                    batch = next(gen)
+                except StopIteration:
+                    return
+            yield batch
 
     def _iterate_columnar(self, buffer):
         import time
@@ -301,24 +318,25 @@ class JaxDataLoader(object):
             reader = make_reader(url, ..., resume_state=state['reader'])
             loader = JaxDataLoader(reader, ..., resume_state=state)
         """
-        if self._resume_rows is not None:
-            # resume-constructed but not yet iterated: the restored rows/RNG
-            # still await injection — re-checkpoint them, don't lose them
-            rows = list(self._resume_rows)
-            rng = self._resume_rng
-        else:
-            rows = []
-            if self._buffer is not None:
-                if self._columnar:
-                    rows.extend(self._buffer.snapshot_rows())
-                else:
-                    rows.extend(getattr(self._buffer, '_items', []))
-            rows.extend(self._pending)
-            rng = getattr(self._buffer, 'rng_state', None)
-        return {'version': 1,
-                'reader': self.reader.state_dict(),
-                'buffer_rng': rng,
-                'rows': [_to_plain_row(r) for r in rows]}
+        with self._state_lock:
+            if self._resume_rows is not None:
+                # resume-constructed but not yet iterated: the restored rows/RNG
+                # still await injection — re-checkpoint them, don't lose them
+                rows = list(self._resume_rows)
+                rng = self._resume_rng
+            else:
+                rows = []
+                if self._buffer is not None:
+                    if self._columnar:
+                        rows.extend(self._buffer.snapshot_rows())
+                    else:
+                        rows.extend(getattr(self._buffer, '_items', []))
+                rows.extend(self._pending)
+                rng = getattr(self._buffer, 'rng_state', None)
+            return {'version': 1,
+                    'reader': self.reader.state_dict(),
+                    'buffer_rng': rng,
+                    'rows': [_to_plain_row(r) for r in rows]}
 
     def _emit(self, rows):
         self._rows_out += len(rows)

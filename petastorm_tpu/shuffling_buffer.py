@@ -15,6 +15,12 @@ from collections import deque
 import numpy as np
 
 
+def default_min_after(capacity, min_after_retrieve=None):
+    """The ONE definition of the decorrelation floor, shared by the row buffer
+    factory and the columnar buffers in the JAX/torch loaders."""
+    return min_after_retrieve if min_after_retrieve is not None else max(1, capacity // 2)
+
+
 def make_shuffling_buffer_factory(capacity, min_after_retrieve=None, seed=None,
                                   batch_size=1, batched_reader=False):
     """Factory-of-factories shared by the JAX and torch loaders.
@@ -25,7 +31,7 @@ def make_shuffling_buffer_factory(capacity, min_after_retrieve=None, seed=None,
     the same way)."""
     if capacity <= 0:
         return NoopShufflingBuffer
-    floor = min_after_retrieve if min_after_retrieve is not None else max(1, capacity // 2)
+    floor = default_min_after(capacity, min_after_retrieve)
     extra = 10 ** 8 if batched_reader else max(1000, batch_size)
     return lambda: RandomShufflingBuffer(capacity, floor, extra_capacity=extra, seed=seed)
 

@@ -53,20 +53,69 @@ def decimal_friendly_collate(batch):
     return default_collate(batch)
 
 
+def _collate_columns_to_torch(batch_columns):
+    """Column block -> dict of torch tensors, one ``from_numpy`` per column
+    (the columnar analog of ``decimal_friendly_collate`` on row dicts).
+
+    Dtype handling is delegated to the JAX loader's shared column sanitizer
+    (datetime -> int64 ns ticks incl. object datetime columns, Decimal ->
+    float64, ``None`` cells preserved as object columns) so the two loaders
+    cannot drift; columns torch fundamentally cannot hold (strings, nullable
+    anything) raise with guidance."""
+    import torch
+    from petastorm_tpu.jax.loader import _sanitize_batch_columns
+    batch = _sanitize_batch_columns(dict(batch_columns))
+    out = {}
+    for name, col in batch.items():
+        if not isinstance(col, np.ndarray):
+            raise TypeError('Field {!r} is not a numpy column'.format(name))
+        if col.dtype == object or col.dtype.kind in ('U', 'S'):
+            raise TypeError(
+                'Field {!r} is a string/object/nullable column; torch tensors cannot hold '
+                'it. Exclude it via schema_fields or convert it in a '
+                'TransformSpec.'.format(name))
+        if col.dtype in _TORCH_HOSTILE_PROMOTIONS:
+            col = col.astype(_TORCH_HOSTILE_PROMOTIONS[col.dtype])
+        # 'W': process-pool blocks arrive as read-only views over the IPC
+        # message; torch.from_numpy needs writable memory (copies only then)
+        out[name] = torch.from_numpy(np.require(col, requirements=['C', 'W']))
+    return out
+
+
 class DataLoader(object):
     """Iterates a reader, accumulates ``batch_size`` rows, collates to torch
-    tensors; optional client-side shuffling buffer."""
+    tensors; optional client-side shuffling buffer.
+
+    Columnar readers (``make_batch_reader``, ``make_reader(output='columnar')``)
+    with the default collate ride the block fast path: no per-row Python, one
+    ``torch.from_numpy`` per column (same architecture as the JAX loader). A
+    custom ``collate_fn`` keeps the row path — its contract is a list of row
+    dicts."""
 
     def __init__(self, reader, batch_size=1, collate_fn=decimal_friendly_collate,
                  shuffling_queue_capacity=0, min_after_retrieve=None, seed=None):
         self.reader = reader
         self.batch_size = batch_size
         self.collate_fn = collate_fn
-        self._make_buffer = make_shuffling_buffer_factory(
-            shuffling_queue_capacity, min_after_retrieve, seed, batch_size,
-            batched_reader=reader.batched_output)
+        self._columnar = (reader.batched_output and collate_fn is decimal_friendly_collate)
+        if self._columnar:
+            from petastorm_tpu.columnar import FifoColumnarBuffer, ShuffledColumnarBuffer
+            from petastorm_tpu.shuffling_buffer import default_min_after
+            if shuffling_queue_capacity > 0:
+                floor = default_min_after(shuffling_queue_capacity, min_after_retrieve)
+                self._make_buffer = lambda: ShuffledColumnarBuffer(
+                    shuffling_queue_capacity, floor, seed)
+            else:
+                self._make_buffer = FifoColumnarBuffer
+        else:
+            self._make_buffer = make_shuffling_buffer_factory(
+                shuffling_queue_capacity, min_after_retrieve, seed, batch_size,
+                batched_reader=reader.batched_output)
 
     def __iter__(self):
+        if self._columnar:
+            yield from self._iter_columnar()
+            return
         buffer = self._make_buffer()
         pending = []
         for item in self.reader:
@@ -88,6 +137,19 @@ class DataLoader(object):
                 pending = []
         if pending:  # partial final batch (reference pytorch.py:182-192)
             yield self.collate_fn(pending)
+
+    def _iter_columnar(self):
+        buffer = self._make_buffer()
+        bs = self.batch_size
+        for item in self.reader:
+            buffer.add_block(dict(item._asdict()))
+            while buffer.can_emit(bs):
+                yield _collate_columns_to_torch(buffer.emit(bs))
+        buffer.finish()
+        while buffer.size >= bs:
+            yield _collate_columns_to_torch(buffer.emit(bs))
+        if buffer.size:  # partial final batch (reference pytorch.py:182-192)
+            yield _collate_columns_to_torch(buffer.emit(buffer.size))
 
     def stop(self):
         self.reader.stop()

@@ -147,3 +147,155 @@ class TestTfDataset:
                                schema_fields=['id'], shuffle_row_groups=False) as reader:
             with pytest.raises(ValueError, match='batched reader'):
                 make_petastorm_dataset(reader, shuffle_buffer_size=10)
+
+
+class TestTorchColumnarFastPath:
+    """Round 3: block fast path for columnar readers under the default collate."""
+
+    def test_columnar_matches_row_path_values(self, synthetic_dataset):
+        from petastorm_tpu import make_reader
+        from petastorm_tpu.torch_utils import DataLoader
+        fields = ['id', 'matrix', 'decimal']
+        with make_reader(synthetic_dataset.url, reader_pool_type='dummy',
+                         schema_fields=fields, shuffle_row_groups=False) as reader:
+            row_batches = list(DataLoader(reader, batch_size=20))
+        with make_reader(synthetic_dataset.url, reader_pool_type='dummy', output='columnar',
+                         schema_fields=fields, shuffle_row_groups=False) as reader:
+            loader = DataLoader(reader, batch_size=20)
+            assert loader._columnar
+            col_batches = list(loader)
+        assert len(row_batches) == len(col_batches)
+        for rb, cb in zip(row_batches, col_batches):
+            for k in rb:
+                np.testing.assert_array_equal(rb[k].numpy(), cb[k].numpy())
+                assert rb[k].dtype == cb[k].dtype
+
+    def test_columnar_shuffled_covers_all_rows(self, scalar_dataset):
+        import torch
+        from petastorm_tpu import make_batch_reader
+        from petastorm_tpu.torch_utils import DataLoader
+        with make_batch_reader(scalar_dataset.url, reader_pool_type='dummy',
+                               schema_fields=['id', 'float64'],
+                               shuffle_row_groups=False) as reader:
+            loader = DataLoader(reader, batch_size=16, shuffling_queue_capacity=40, seed=3)
+            ids = torch.cat([b['id'] for b in loader])
+        assert sorted(ids.tolist()) == list(range(100))
+
+    def test_custom_collate_keeps_row_path(self, scalar_dataset):
+        from petastorm_tpu import make_batch_reader
+        from petastorm_tpu.torch_utils import DataLoader
+
+        def my_collate(rows):
+            return len(rows)
+
+        with make_batch_reader(scalar_dataset.url, reader_pool_type='dummy',
+                               schema_fields=['id'], shuffle_row_groups=False) as reader:
+            loader = DataLoader(reader, batch_size=25, collate_fn=my_collate)
+            assert not loader._columnar
+            assert list(loader) == [25, 25, 25, 25]
+
+    def test_readonly_columns_copied_for_torch(self):
+        import torch
+        from petastorm_tpu.torch_utils import _collate_columns_to_torch
+        col = np.arange(6, dtype=np.int64)
+        col.setflags(write=False)
+        out = _collate_columns_to_torch({'x': col})
+        out['x'][0] = 99  # writable: a copy was made, source untouched
+        assert col[0] == 0 and out['x'][0] == 99
+        assert isinstance(out['x'], torch.Tensor)
+
+
+class TestBackgroundPrefetch:
+    def test_background_prefetch_yields_all_and_stages(self, synthetic_dataset):
+        import jax
+        from petastorm_tpu import make_reader
+        from petastorm_tpu.jax import JaxDataLoader, prefetch_to_device
+        with make_reader(synthetic_dataset.url, reader_pool_type='dummy', output='columnar',
+                         schema_fields=['id'], shuffle_row_groups=False) as reader:
+            loader = JaxDataLoader(reader, batch_size=10, drop_last=False)
+            batches = list(prefetch_to_device(iter(loader), jax.devices()[0], size=2))
+        assert sum(len(b['id']) for b in batches) == 100
+        assert all(isinstance(b['id'], jax.Array) for b in batches)
+
+    def test_background_prefetch_propagates_errors(self):
+        import jax
+        from petastorm_tpu.jax import prefetch_to_device
+
+        def boom():
+            yield {'x': np.ones(2, np.float32)}
+            raise RuntimeError('pipeline exploded')
+
+        it = prefetch_to_device(boom(), jax.devices()[0], size=2)
+        next(it)
+        with pytest.raises(RuntimeError, match='pipeline exploded'):
+            next(it)
+
+    def test_background_prefetch_early_abandon_stops_thread(self):
+        import itertools
+        import threading
+        import jax
+        from petastorm_tpu.jax import prefetch_to_device
+
+        def infinite():
+            for i in itertools.count():
+                yield {'x': np.full(4, i, np.float32)}
+
+        before = threading.active_count()
+        it = prefetch_to_device(infinite(), jax.devices()[0], size=2)
+        next(it)
+        it.close()  # GeneratorExit -> stop event -> pump thread joins
+        import time
+        deadline = time.monotonic() + 5
+        while threading.active_count() > before and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert not any(t.name == 'pstpu-prefetch' and t.is_alive()
+                       for t in threading.enumerate())
+
+    def test_synchronous_mode_still_works(self, synthetic_dataset):
+        import jax
+        from petastorm_tpu import make_reader
+        from petastorm_tpu.jax import JaxDataLoader, prefetch_to_device
+        with make_reader(synthetic_dataset.url, reader_pool_type='dummy', output='columnar',
+                         schema_fields=['id'], shuffle_row_groups=False) as reader:
+            loader = JaxDataLoader(reader, batch_size=10, drop_last=False)
+            batches = list(prefetch_to_device(iter(loader), jax.devices()[0], size=2,
+                                              background=False))
+        assert sum(len(b['id']) for b in batches) == 100
+
+
+def test_torch_columnar_datetime_promoted(scalar_dataset):
+    """Regression: datetime columns (object or 'M' dtype) through the torch
+    columnar fast path come out as int64 ns tensors, like the row path."""
+    import torch
+    from petastorm_tpu import make_batch_reader
+    from petastorm_tpu.torch_utils import DataLoader
+    from petastorm_tpu.test_util.dataset_utils import create_scalar_dataset  # noqa: F401
+    with make_batch_reader(scalar_dataset.url, reader_pool_type='dummy',
+                           schema_fields=['id', 'datetime'],
+                           shuffle_row_groups=False) as reader:
+        loader = DataLoader(reader, batch_size=20)
+        assert loader._columnar
+        batch = next(iter(loader))
+    assert batch['datetime'].dtype == torch.int64
+    assert batch['datetime'].shape == (20,)
+
+
+def test_loader_state_dict_safe_under_background_prefetch(synthetic_dataset):
+    """Regression: state_dict() from the training thread while the background
+    prefetch pump iterates the loader must neither crash nor lose rows."""
+    import jax
+    from petastorm_tpu import make_reader
+    from petastorm_tpu.jax import JaxDataLoader, prefetch_to_device
+    reader = make_reader(synthetic_dataset.url, reader_pool_type='thread',
+                         output='columnar', schema_fields=['id'],
+                         shuffle_row_groups=False, seed=5, num_epochs=None)
+    loader = JaxDataLoader(reader, batch_size=7, shuffling_queue_capacity=30, seed=5)
+    it = prefetch_to_device(iter(loader), jax.devices()[0], size=2)
+    states = []
+    for i in range(6):
+        next(it)
+        states.append(loader.state_dict())  # concurrent with the pump thread
+    it.close()
+    reader.stop(); reader.join()
+    for s in states:
+        assert s['version'] == 1 and isinstance(s['rows'], list)
