@@ -41,7 +41,10 @@ def _ensure_devices(n):
     import __graft_entry__ as g
     os.environ['XLA_FLAGS'] = g._force_device_count_flag(os.environ.get('XLA_FLAGS', ''), n)
     import jax
-    if os.environ.get('_PSTPU_POD_CHILD'):
+    if os.environ.get('_PSTPU_POD_CHILD') or os.environ.get('JAX_PLATFORMS') == 'cpu':
+        # sitecustomize pins the TPU platform via jax.config, overriding the
+        # env var — honor an explicit CPU request so off-pod runs never block
+        # on an unavailable chip/tunnel
         jax.config.update('jax_platforms', 'cpu')
     try:
         have = len(jax.devices())
@@ -134,7 +137,8 @@ def main(argv=None):
         ngram = NGram(fields, delta_threshold=1,
                       timestamp_field=UnischemaField('ts', np.int64, ()))
         with make_reader(url, reader_pool_type='thread', workers_count=args.workers,
-                         ngram=ngram, cur_shard=host, shard_count=args.hosts,
+                         ngram=ngram, output='columnar',
+                         cur_shard=host, shard_count=args.hosts,
                          shuffle_row_groups=True, seed=13, num_epochs=None) as reader:
             loader = JaxDataLoader(reader, batch_size=args.batch_size, seed=13)
             it = iter(loader)

@@ -18,6 +18,7 @@ from collections import deque
 import numpy as np
 import pyarrow as pa
 
+from petastorm_tpu.columnar import BlockResultsReaderBase
 from petastorm_tpu.row_worker import _cache_key, select_row_drop_indices
 from petastorm_tpu.native import open_parquet
 from petastorm_tpu.workers.worker_base import WorkerBase
@@ -147,29 +148,10 @@ class ArrowBatchWorker(WorkerBase):
         return {k: v[mask] for k, v in batch.items()}
 
 
-class BatchResultsQueueReader(object):
+class BatchResultsQueueReader(BlockResultsReaderBase):
     """Consumer-side: one namedtuple-of-arrays per published batch
     (reference arrow_reader_worker.py:39-79, ``batched_output=True``).
+    Delivered/checkpoint bookkeeping lives in the shared base."""
 
-    Checkpoint support: a batch counts as delivered the moment ``read_next``
-    returns it (see row_worker.RowResultsQueueReader)."""
-
-    def __init__(self, schema):
-        self._schema = schema
-        self.delivered_callback = None
-
-    @property
-    def batched_output(self):
-        return True
-
-    def on_item_done(self, seq):
-        # covers items that published nothing (e.g. fully predicate-filtered)
-        if self.delivered_callback is not None:
-            self.delivered_callback(seq)
-
-    def read_next(self, pool):
-        batch = pool.get_results()
-        seq = getattr(pool, 'last_result_seq', None)
-        if seq is not None and self.delivered_callback is not None:
-            self.delivered_callback(seq)
+    def _convert(self, batch):
         return self._schema.make_namedtuple(**batch)

@@ -128,6 +128,34 @@ def concat_blocks(blocks):
     return {name: concat_columns([b[name] for b in blocks]) for name in blocks[0]}
 
 
+class BlockResultsReaderBase(object):
+    """Shared consumer-side reader for block-per-item pools: one published
+    payload per ``read_next``, delivered-callback checkpoint bookkeeping (an
+    item counts as delivered the moment its payload is returned; items that
+    published nothing deliver via the pool's completion sentinel). Subclasses
+    override :meth:`_convert` for their output shape."""
+
+    batched_output = True
+
+    def __init__(self, schema):
+        self._schema = schema
+        self.delivered_callback = None
+
+    def on_item_done(self, seq):
+        if self.delivered_callback is not None:
+            self.delivered_callback(seq)
+
+    def _convert(self, payload):
+        return payload
+
+    def read_next(self, pool):
+        payload = pool.get_results()
+        seq = getattr(pool, 'last_result_seq', None)
+        if seq is not None and self.delivered_callback is not None:
+            self.delivered_callback(seq)
+        return self._convert(payload)
+
+
 class BatchingColumnQueue(object):
     """FIFO queue of column blocks re-chunked to a fixed row count — the ONE
     implementation of block buffering/slicing, shared by

@@ -104,6 +104,21 @@ def _sanitize_batch_columns(batch):
     return batch
 
 
+def _flatten_ngram_block(nested):
+    """Nested window block {offset: {field: col}} -> flat {(offset, field): col}
+    so the columnar buffers (which only see dicts of equal-length columns) can
+    shuffle/slice windows like any other rows."""
+    return {(off, name): col for off, fields in nested.items()
+            for name, col in fields.items()}
+
+
+def _unflatten_ngram_batch(flat):
+    out = {}
+    for (off, name), col in flat.items():
+        out.setdefault(off, {})[name] = col
+    return out
+
+
 def _rows_from_columnar_batch(batch_namedtuple):
     """Transpose a batched reader's columnar output into row dicts
     (reference pytorch.py:163-175)."""
@@ -158,8 +173,10 @@ class JaxDataLoader(object):
         self._state_lock = threading.Lock()
         # columnar fast path: readers that emit column blocks (make_batch_reader,
         # make_reader(output='columnar')) never materialize rows — batches are
-        # numpy slices/gathers of whole blocks
-        self._columnar = bool(reader.batched_output) and self._ngram is None
+        # numpy slices/gathers of whole blocks. NGram columnar readers emit
+        # nested window blocks, buffered under flat (offset, field) keys.
+        self._columnar = bool(reader.batched_output)
+        self._columnar_ngram = self._columnar and self._ngram is not None
         if self._columnar:
             from petastorm_tpu.columnar import FifoColumnarBuffer, ShuffledColumnarBuffer
             from petastorm_tpu.shuffling_buffer import default_min_after
@@ -215,21 +232,14 @@ class JaxDataLoader(object):
         self._resume_rows = None
         gen = (self._iterate_columnar(buffer) if self._columnar
                else self._iterate(buffer, self._pending))
-        return self._locked_steps(gen)
-
-    def _locked_steps(self, gen):
-        """Each batch production holds the state lock, so a ``state_dict()``
-        taken from another thread (background prefetch pumping this loader)
-        sees a consistent between-batches snapshot."""
-        while True:
-            with self._state_lock:
-                try:
-                    batch = next(gen)
-                except StopIteration:
-                    return
-            yield batch
+        return gen
 
     def _iterate_columnar(self, buffer):
+        # Locking: the state lock is held only around buffer mutation + batch
+        # extraction — NEVER across the blocking next(reader_it) — so a
+        # state_dict() taken from another thread (background prefetch pumping
+        # this loader) sees a consistent snapshot and cannot hang behind a
+        # starved reader.
         import time
         self._iter_start = time.perf_counter()
         self._reader_wait_s = 0.0
@@ -244,21 +254,32 @@ class JaxDataLoader(object):
                 self._reader_wait_s += time.perf_counter() - w0
                 break
             self._reader_wait_s += time.perf_counter() - w0
-            buffer.add_block(dict(item._asdict()))
-            while buffer.can_emit(bs):
-                yield self._emit_columnar(buffer.emit(bs))
-        buffer.finish()
-        while buffer.size >= bs:
-            yield self._emit_columnar(buffer.emit(bs))
-        if buffer.size and not self._drop_last:
-            yield self._emit_columnar(buffer.emit(buffer.size))
-        # drop_last leftovers are intentionally dropped — clear them so an
-        # exhausted loader can be iterated again (multi-epoch pattern)
-        buffer.clear()
+            emitted = []
+            with self._state_lock:
+                if self._columnar_ngram:
+                    buffer.add_block(_flatten_ngram_block(item))
+                else:
+                    buffer.add_block(dict(item._asdict()))
+                while buffer.can_emit(bs):
+                    emitted.append(self._emit_columnar(buffer.emit(bs)))
+            yield from emitted
+        with self._state_lock:
+            buffer.finish()
+            emitted = []
+            while buffer.size >= bs:
+                emitted.append(self._emit_columnar(buffer.emit(bs)))
+            if buffer.size and not self._drop_last:
+                emitted.append(self._emit_columnar(buffer.emit(buffer.size)))
+            # drop_last leftovers are intentionally dropped — clear them so an
+            # exhausted loader can be iterated again (multi-epoch pattern)
+            buffer.clear()
+        yield from emitted
 
     def _emit_columnar(self, batch):
         self._rows_out += len(next(iter(batch.values()))) if batch else 0
         batch = _sanitize_batch_columns(batch)
+        if self._columnar_ngram:
+            batch = _unflatten_ngram_batch(batch)
         if self._to_device is not None:
             batch = self._stage(batch)
         return batch
@@ -277,32 +298,36 @@ class JaxDataLoader(object):
                 self._reader_wait_s += time.perf_counter() - w0
                 break
             self._reader_wait_s += time.perf_counter() - w0
-            if self.reader.batched_output:
-                buffer.add_many(_rows_from_columnar_batch(item))
-            else:
-                buffer.add_many([item])
+            emitted = []
+            with self._state_lock:  # mutation only — never across the reader wait
+                if self.reader.batched_output:
+                    buffer.add_many(_rows_from_columnar_batch(item))
+                else:
+                    buffer.add_many([item])
+                while buffer.can_retrieve():
+                    pending.append(buffer.retrieve())
+                    if len(pending) == self.batch_size:
+                        # collate+clear BEFORE yield: a state_dict() taken while
+                        # the consumer holds this batch must not count its rows
+                        # as pending
+                        emitted.append(self._emit(pending))
+                        pending.clear()
+            yield from emitted
+        with self._state_lock:
+            buffer.finish()
+            emitted = []
             while buffer.can_retrieve():
                 pending.append(buffer.retrieve())
                 if len(pending) == self.batch_size:
-                    # collate+clear BEFORE yield: a state_dict() taken while the
-                    # consumer holds this batch must not count its rows as pending
-                    batch = self._emit(pending)
+                    emitted.append(self._emit(pending))
                     pending.clear()
-                    yield batch
-        buffer.finish()
-        while buffer.can_retrieve():
-            pending.append(buffer.retrieve())
-            if len(pending) == self.batch_size:
-                batch = self._emit(pending)
+            if pending and not self._drop_last:
+                emitted.append(self._emit(list(pending)))
                 pending.clear()
-                yield batch
-        if pending and not self._drop_last:
-            batch = self._emit(list(pending))
+            # drop_last leftovers are intentionally dropped — clear them so an
+            # exhausted loader can be iterated again (multi-epoch pattern)
             pending.clear()
-            yield batch
-        # drop_last leftovers are intentionally dropped — clear them so an
-        # exhausted loader can be iterated again (multi-epoch pattern)
-        pending.clear()
+        yield from emitted
 
     # -- checkpoint ---------------------------------------------------------
 

@@ -122,6 +122,70 @@ class NGram(object):
                 start += 1
         return ngrams
 
+    def form_ngram_columnar(self, block):
+        """Assemble windows from ONE row group's decoded *column block* —
+        the columnar analog of :meth:`form_ngram`, with identical window
+        semantics (stable timestamp sort, delta_threshold filtering, greedy
+        non-overlap selection) but no per-row Python: window membership is a
+        vectorized cumsum over the sorted timestamp deltas and each timestep's
+        fields are one numpy gather.
+
+        :param block: dict ``field -> [N, ...]`` column (must include the
+            timestamp field)
+        :return: dict ``offset -> {field: [W, ...]}`` for W windows, or ``None``
+            when no window qualifies
+        """
+        import numpy as np
+
+        ts = block[self._timestamp_field_name]
+        n = len(ts)
+        length = self.length
+        if n < length:
+            return None
+        if isinstance(ts, np.ndarray) and ts.dtype != object:
+            order = np.argsort(ts, kind='stable')
+            ts_sorted = ts[order]
+            if self._delta_threshold is None or n < 2:
+                bad = np.zeros(max(n - 1, 0), dtype=bool)
+            else:
+                bad = np.diff(ts_sorted) > self._delta_threshold
+        else:
+            # object timestamps (Decimal, datetime objects): python compare,
+            # same semantics as the row path
+            ts_list = list(ts)
+            order = np.array(sorted(range(n), key=ts_list.__getitem__), dtype=np.int64)
+            ts_sorted = [ts_list[i] for i in order]
+            if self._delta_threshold is None or n < 2:
+                bad = np.zeros(max(n - 1, 0), dtype=bool)
+            else:
+                bad = np.array([b - a > self._delta_threshold
+                                for a, b in zip(ts_sorted, ts_sorted[1:])], dtype=bool)
+        # window starting at s is valid iff no over-threshold delta occurs
+        # among sorted positions [s, s+length-1): prefix-sum the bad deltas
+        cs = np.concatenate([[0], np.cumsum(bad)])
+        num_starts = n - length + 1
+        ok = (cs[length - 1:length - 1 + num_starts] - cs[:num_starts]) == 0
+        if self._timestamp_overlap:
+            starts = np.flatnonzero(ok)
+        else:
+            picked = []
+            s = 0
+            while s < num_starts:  # greedy, like the row path's start += length
+                if ok[s]:
+                    picked.append(s)
+                    s += length
+                else:
+                    s += 1
+            starts = np.asarray(picked, dtype=np.int64)
+        if len(starts) == 0:
+            return None
+        out = {}
+        for offset in range(self._min_offset, self._max_offset + 1):
+            idx = order[starts + (offset - self._min_offset)]
+            wanted = [k for k in self.get_field_names_at_timestep(offset) if k in block]
+            out[offset] = {k: block[k][idx] for k in wanted}
+        return out
+
     def _window_within_threshold(self, window):
         if self._delta_threshold is None:
             return True

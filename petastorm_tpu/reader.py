@@ -29,7 +29,8 @@ from petastorm_tpu.etl import dataset_metadata
 from petastorm_tpu.etl.rowgroup_indexing import get_row_group_indexes
 from petastorm_tpu.fs import FilesystemResolver
 from petastorm_tpu.local_disk_cache import LocalDiskCache
-from petastorm_tpu.row_worker import RowGroupDecoderWorker, RowResultsQueueReader
+from petastorm_tpu.row_worker import (NgramBlockResultsQueueReader, RowGroupDecoderWorker,
+                                      RowResultsQueueReader)
 from petastorm_tpu.serializers import NumpyBlockSerializer
 from petastorm_tpu.transform import transform_schema
 from petastorm_tpu.workers import DummyPool, EmptyResultError, ProcessPool, ThreadPool
@@ -131,7 +132,10 @@ def make_reader(dataset_url,
         hot path: no per-row Python objects ever exist, and ``JaxDataLoader``
         slices device batches straight out of the blocks. A capability the
         reference only offered for plain Parquet stores (``make_batch_reader``),
-        here available with full Unischema codec decode.
+        here available with full Unischema codec decode. With ``ngram``,
+        columnar output yields nested window blocks
+        ``{offset: {field: [W, ...]}}`` per row group, assembled with zero
+        per-row Python (``NGram.form_ngram_columnar``).
     :param batch_size: (columnar only) rebatch blocks to exactly this many rows
     :param drop_last: (columnar + batch_size only) drop the ragged final batch
     :param resume_state: dict from :meth:`Reader.state_dict` — continue reading
@@ -149,12 +153,20 @@ def make_reader(dataset_url,
     if output == 'rows' and batch_size is not None:
         raise ValueError("batch_size requires output='columnar' (row output is one row "
                          'per iteration; batch with JaxDataLoader instead)')
-    if output == 'columnar' and ngram is not None:
-        raise ValueError("output='columnar' does not support ngram (windows are row-"
-                         'structured); use the default row output')
-    results_queue_reader_factory = _columnar_results_reader_factory(
-        output, batch_size, drop_last,
-        lambda out_schema: RowResultsQueueReader(out_schema, ngram))
+    columnar_ngram = output == 'columnar' and ngram is not None
+    if columnar_ngram:
+        if batch_size is not None:
+            raise ValueError('batch_size rebatching is not supported with ngram (window '
+                             'blocks are nested); batch with JaxDataLoader instead')
+        if drop_last:
+            raise ValueError('drop_last requires batch_size (without rebatching there is '
+                             'no "last short batch" to drop)')
+        results_queue_reader_factory = (
+            lambda out_schema: NgramBlockResultsQueueReader(out_schema, ngram))
+    else:
+        results_queue_reader_factory = _columnar_results_reader_factory(
+            output, batch_size, drop_last,
+            lambda out_schema: RowResultsQueueReader(out_schema, ngram))
 
     cache = _make_cache(cache_type, cache_location, cache_size_limit, cache_row_size_estimate)
     pool = _make_pool(reader_pool_type, workers_count, results_queue_size)
@@ -167,6 +179,7 @@ def make_reader(dataset_url,
                   predicate=predicate, rowgroup_selector=rowgroup_selector,
                   num_epochs=num_epochs, cur_shard=cur_shard, shard_count=shard_count,
                   cache=cache, transform_spec=transform_spec, ngram=ngram,
+                  columnar_ngram=columnar_ngram,
                   resume_state=resume_state)
 
 
@@ -219,7 +232,7 @@ class Reader(object):
                  schema_fields=None, seed=None, shuffle_row_groups=True,
                  shuffle_row_drop_partitions=1, predicate=None, rowgroup_selector=None,
                  num_epochs=1, cur_shard=None, shard_count=None, cache=None,
-                 transform_spec=None, ngram=None, resume_state=None):
+                 transform_spec=None, ngram=None, columnar_ngram=False, resume_state=None):
         if (cur_shard is None) != (shard_count is None):
             raise ValueError('cur_shard and shard_count must be specified together')
         if cur_shard is not None and not 0 <= cur_shard < shard_count:
@@ -295,6 +308,7 @@ class Reader(object):
             'transform_spec': transform_spec,
             'transformed_schema': self.transformed_schema,
             'ngram': ngram,
+            'columnar_ngram': columnar_ngram,
             'cache': cache or NullCache(),
         }
         self._pool = pool

@@ -24,8 +24,8 @@ from collections import deque
 
 import numpy as np
 
-from petastorm_tpu.columnar import (block_num_rows, block_to_rows, column_cells,
-                                    rows_to_block, stack_cells, take_block)
+from petastorm_tpu.columnar import (BlockResultsReaderBase, block_num_rows, block_to_rows,
+                                    column_cells, rows_to_block, stack_cells, take_block)
 from petastorm_tpu.native import open_parquet
 from petastorm_tpu.workers.worker_base import WorkerBase
 
@@ -125,6 +125,11 @@ class RowGroupDecoderWorker(WorkerBase):
                 return
 
         if ngram is not None:
+            if args.get('columnar_ngram'):
+                windows = ngram.form_ngram_columnar(block)
+                if windows is not None:
+                    self.publish(windows)
+                return
             rows = block_to_rows(block)
             windows = ngram.form_ngram(rows, args['transformed_schema'] or out_schema)
             if windows:
@@ -250,6 +255,18 @@ class RowGroupDecoderWorker(WorkerBase):
         kept_pred = take_block(pred_block, kept_local)
         return {name: (kept_pred[name] if name in kept_pred else rem_block[name])
                 for name in column_names if name in kept_pred or name in rem_block}
+
+
+class NgramBlockResultsQueueReader(BlockResultsReaderBase):
+    """Consumer-side reader for ``make_reader(output='columnar', ngram=...)``:
+    yields one nested window block per published item — a plain dict
+    ``offset -> {field: [W, ...]}`` (namedtuples cannot key on integer offsets,
+    so no conversion). ``batched_output=True``: W varies per row group like
+    any columnar batch."""
+
+    def __init__(self, schema, ngram):
+        super().__init__(schema)
+        self._ngram = ngram
 
 
 class RowResultsQueueReader(object):

@@ -107,6 +107,11 @@ def make_petastorm_dataset(reader, shuffle_buffer_size=0, seed=None):
     tf_utils.py:327-331).
     """
     tf = _tf()
+    if reader.batched_output and getattr(reader, 'ngram', None) is not None:
+        raise ValueError(
+            'make_petastorm_dataset does not support columnar NGram readers (nested '
+            "window blocks); use make_reader(output='rows', ngram=...) for the TF "
+            'surface, or JaxDataLoader for the columnar window path.')
     ngram = getattr(reader, 'ngram', None)
 
     if shuffle_buffer_size and reader.batched_output:

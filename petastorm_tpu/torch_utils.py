@@ -94,6 +94,11 @@ class DataLoader(object):
 
     def __init__(self, reader, batch_size=1, collate_fn=decimal_friendly_collate,
                  shuffling_queue_capacity=0, min_after_retrieve=None, seed=None):
+        if reader.batched_output and getattr(reader, 'ngram', None) is not None:
+            raise ValueError(
+                'torch DataLoader does not support columnar NGram readers (nested window '
+                "blocks); use make_reader(output='rows', ngram=...) here, or JaxDataLoader "
+                'for the columnar window path.')
         self.reader = reader
         self.batch_size = batch_size
         self.collate_fn = collate_fn

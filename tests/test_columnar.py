@@ -190,8 +190,9 @@ def test_columnar_reader_rejects_ngram_and_bad_args(synthetic_dataset):
     from petastorm_tpu.ngram import NGram
     from petastorm_tpu.test_util.dataset_utils import TestSchema
     ngram = NGram({0: [TestSchema.id]}, delta_threshold=1, timestamp_field=TestSchema.id)
-    with pytest.raises(ValueError, match='columnar'):
-        make_reader(synthetic_dataset.url, output='columnar', ngram=ngram)
+    # columnar + ngram is supported; rebatching of nested window blocks is not
+    with pytest.raises(ValueError, match='ngram'):
+        make_reader(synthetic_dataset.url, output='columnar', ngram=ngram, batch_size=4)
     with pytest.raises(ValueError, match='batch_size'):
         make_reader(synthetic_dataset.url, output='rows', batch_size=4)
     with pytest.raises(ValueError, match='output'):
@@ -366,3 +367,131 @@ def test_loader_columnar_multi_epoch_after_drop_last(synthetic_dataset):
         it = iter(loader)
         for _ in range(7):  # crosses the 100-row epoch boundary
             assert len(next(it)['id']) == 30
+
+
+# -- columnar NGram (round 3) ------------------------------------------------
+
+def _make_ngram(length=3, delta=1, overlap=True):
+    from petastorm_tpu.ngram import NGram
+    from petastorm_tpu.test_util.dataset_utils import TestSchema
+    fields = {i: [TestSchema.id, TestSchema.matrix] if i == 0 else [TestSchema.id]
+              for i in range(length)}
+    return NGram(fields, delta_threshold=delta, timestamp_field=TestSchema.id,
+                 timestamp_overlap=overlap)
+
+
+def test_form_ngram_columnar_matches_row_path():
+    from petastorm_tpu.ngram import NGram
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+    ts_field = UnischemaField('t', np.int64, ())
+    val_field = UnischemaField('v', np.float32, (2,))
+    schema = Unischema('S', [ts_field, val_field])
+    ngram = NGram({0: [ts_field, val_field], 1: [ts_field]},
+                  delta_threshold=2, timestamp_field=ts_field)
+    rng = np.random.default_rng(0)
+    # unsorted timestamps with gaps that exceed the threshold
+    t = np.array([5, 1, 2, 9, 4, 14, 15, 3], dtype=np.int64)
+    v = rng.standard_normal((8, 2)).astype(np.float32)
+    rows = [{'t': t[i], 'v': v[i]} for i in range(8)]
+    row_windows = ngram.form_ngram(rows, schema)
+    col_windows = ngram.form_ngram_columnar({'t': t, 'v': v})
+    assert len(row_windows) == len(col_windows[0]['t'])
+    for w, rw in enumerate(row_windows):
+        assert col_windows[0]['t'][w] == rw[0]['t']
+        assert col_windows[1]['t'][w] == rw[1]['t']
+        np.testing.assert_array_equal(col_windows[0]['v'][w], rw[0]['v'])
+
+
+@pytest.mark.parametrize('overlap', [True, False])
+def test_form_ngram_columnar_overlap_semantics(overlap):
+    from petastorm_tpu.ngram import NGram
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+    ts_field = UnischemaField('t', np.int64, ())
+    schema = Unischema('S', [ts_field])
+    ngram = NGram({0: [ts_field], 1: [ts_field], 2: [ts_field]},
+                  delta_threshold=1, timestamp_field=ts_field,
+                  timestamp_overlap=overlap)
+    t = np.arange(10, dtype=np.int64)
+    rows = [{'t': x} for x in t]
+    expected = [w[0]['t'] for w in ngram.form_ngram(rows, schema)]
+    got = ngram.form_ngram_columnar({'t': t})[0]['t'].tolist()
+    assert got == expected
+
+
+def test_form_ngram_columnar_no_windows_returns_none():
+    from petastorm_tpu.ngram import NGram
+    from petastorm_tpu.unischema import UnischemaField
+    ts_field = UnischemaField('t', np.int64, ())
+    ngram = NGram({0: [ts_field], 1: [ts_field]}, delta_threshold=1,
+                  timestamp_field=ts_field)
+    assert ngram.form_ngram_columnar({'t': np.array([0], dtype=np.int64)}) is None
+    assert ngram.form_ngram_columnar({'t': np.array([0, 5], dtype=np.int64)}) is None
+
+
+def test_columnar_ngram_reader_matches_row_reader(synthetic_dataset):
+    ngram = _make_ngram(length=2, delta=1)
+    with make_reader(synthetic_dataset.url, reader_pool_type='dummy', ngram=ngram,
+                     shuffle_row_groups=False) as reader:
+        row_windows = list(reader)
+    ngram2 = _make_ngram(length=2, delta=1)
+    with make_reader(synthetic_dataset.url, reader_pool_type='dummy', ngram=ngram2,
+                     output='columnar', shuffle_row_groups=False) as reader:
+        assert reader.batched_output
+        blocks = list(reader)
+    col_ids_t0 = [int(i) for b in blocks for i in b[0]['id']]
+    row_ids_t0 = [int(w[0].id) for w in row_windows]
+    assert col_ids_t0 == row_ids_t0
+    col_ids_t1 = [int(i) for b in blocks for i in b[1]['id']]
+    assert col_ids_t1 == [int(w[1].id) for w in row_windows]
+    # per-offset field sets respected: matrix only at offset 0
+    assert 'matrix' in blocks[0][0] and 'matrix' not in blocks[0][1]
+    first_row_matrix = row_windows[0][0].matrix
+    np.testing.assert_array_equal(blocks[0][0]['matrix'][0], first_row_matrix)
+
+
+def test_loader_columnar_ngram_time_major_batches(synthetic_dataset):
+    from petastorm_tpu.jax.loader import stack_ngram_time_axis
+    ngram = _make_ngram(length=3, delta=1)
+    with make_reader(synthetic_dataset.url, reader_pool_type='dummy', ngram=ngram,
+                     output='columnar', shuffle_row_groups=False) as reader:
+        loader = JaxDataLoader(reader, batch_size=4)
+        batch = next(iter(loader))
+    assert sorted(batch.keys()) == [0, 1, 2]
+    assert batch[0]['matrix'].shape == (4, 32, 16, 3)
+    np.testing.assert_array_equal(batch[1]['id'], batch[0]['id'] + 1)
+    stacked = stack_ngram_time_axis(batch)
+    assert stacked['id'].shape == (4, 3)
+
+
+def test_loader_columnar_ngram_shuffled_covers_all_windows(synthetic_dataset):
+    ngram = _make_ngram(length=2, delta=1)
+    with make_reader(synthetic_dataset.url, reader_pool_type='dummy', ngram=ngram,
+                     shuffle_row_groups=False) as reader:
+        expected = sorted(int(w[0].id) for w in reader)
+    ngram2 = _make_ngram(length=2, delta=1)
+    with make_reader(synthetic_dataset.url, reader_pool_type='dummy', ngram=ngram2,
+                     output='columnar', shuffle_row_groups=False) as reader:
+        loader = JaxDataLoader(reader, batch_size=8, shuffling_queue_capacity=24,
+                               seed=4, drop_last=False)
+        got = sorted(int(i) for b in loader for i in b[0]['id'])
+    assert got == expected
+
+
+def test_columnar_ngram_rejected_by_torch_and_tf_surfaces(synthetic_dataset):
+    """Nested window blocks are a JaxDataLoader shape; the torch/TF adapters
+    reject them with guidance instead of crashing on the first block."""
+    from petastorm_tpu.torch_utils import DataLoader as TorchDataLoader
+    ngram = _make_ngram(length=2, delta=1)
+    with make_reader(synthetic_dataset.url, reader_pool_type='dummy', ngram=ngram,
+                     output='columnar', shuffle_row_groups=False) as reader:
+        with pytest.raises(ValueError, match='columnar NGram'):
+            TorchDataLoader(reader, batch_size=4)
+        from petastorm_tpu.tf_utils import make_petastorm_dataset
+        with pytest.raises(ValueError, match='columnar NGram'):
+            make_petastorm_dataset(reader)
+
+
+def test_columnar_ngram_rejects_drop_last(synthetic_dataset):
+    ngram = _make_ngram(length=2, delta=1)
+    with pytest.raises(ValueError, match='drop_last'):
+        make_reader(synthetic_dataset.url, output='columnar', ngram=ngram, drop_last=True)

@@ -67,3 +67,19 @@ def test_shuffling_buffer_reaches_near_zero_correlation(synthetic_dataset):
         assert sorted(ids) == list(range(100))
         corrs.append(abs(rank_correlation(ids)))
     assert np.mean(corrs) < 0.35, corrs
+
+
+def test_columnar_shuffling_buffer_reaches_near_zero_correlation(synthetic_dataset):
+    # the index-permutation columnar buffer must match the row buffer's
+    # decorrelation contract (same capacity -> comparable rank correlation)
+    corrs = []
+    for seed in range(5):
+        with make_reader(synthetic_dataset.url, reader_pool_type='dummy',
+                         output='columnar', shuffle_row_groups=True, seed=seed,
+                         schema_fields=['id']) as reader:
+            loader = JaxDataLoader(reader, batch_size=10, shuffling_queue_capacity=60,
+                                   seed=seed, drop_last=False)
+            ids = [int(i) for b in loader for i in b['id']]
+        assert sorted(ids) == list(range(100))
+        corrs.append(abs(rank_correlation(ids)))
+    assert np.mean(corrs) < 0.35, corrs
