@@ -113,6 +113,16 @@ def main(argv=None):
     schema = build_sequence_store(url, args.rows, args.feature_dim)
 
     seq_axis = 2 if args.devices % 2 == 0 else 1
+    data_axis = args.devices // seq_axis
+    # SPMD divisibility (shard_map): fail fast with a clear message instead of
+    # a deep jax error inside the transformer's attention
+    if args.batch_size % data_axis:
+        parser.error('--batch-size {} must be divisible by the data mesh axis ({}; '
+                     '--devices {} / seq {})'.format(args.batch_size, data_axis,
+                                                     args.devices, seq_axis))
+    if args.seq_len % seq_axis:
+        parser.error('--seq-len {} must be divisible by the seq mesh axis ({})'.format(
+            args.seq_len, seq_axis))
     mesh = make_mesh(('data', 'seq'), axis_shapes=(-1, seq_axis),
                      devices=jax.devices()[:args.devices])
     batch_sharding = NamedSharding(mesh, P('data', 'seq'))
@@ -121,50 +131,62 @@ def main(argv=None):
                   UnischemaField('features', np.float32, (args.feature_dim,))]
               for i in range(args.seq_len)}
 
-    # a small jitted sequence step: per-timestep projection + cross-time mix,
-    # sharded over ('data','seq') — the data-side half of context parallelism
-    w = jnp.ones((args.feature_dim, args.feature_dim), jnp.float32) / args.feature_dim
+    # the REAL long-context training load: a ring-attention sequence
+    # transformer (petastorm_tpu.models.transformer) — attention sharded over
+    # mesh['seq'] (context parallelism), dp over mesh['data']
+    from petastorm_tpu.models import make_sequence_transformer
+    from petastorm_tpu.models.train import (create_train_state, make_train_step,
+                                            shard_train_state)
 
-    @jax.jit
-    def seq_step(x, w):  # x: [B, T, F]
-        h = jnp.einsum('btf,fg->btg', x, w)
-        h = h + jnp.roll(h, 1, axis=1)  # cross-timestep dependency
-        return jnp.mean(h * h)
+    num_classes = 16
+    model = make_sequence_transformer(num_classes=num_classes, mesh=mesh,
+                                      d_model=64, num_layers=2)
+    state = create_train_state(
+        model, jax.random.PRNGKey(0),
+        jnp.zeros((args.batch_size, args.seq_len, args.feature_dim)))
 
     total_rate = 0.0
     worst_stall = 0.0
-    for host in range(args.hosts):
-        ngram = NGram(fields, delta_threshold=1,
-                      timestamp_field=UnischemaField('ts', np.int64, ()))
-        with make_reader(url, reader_pool_type='thread', workers_count=args.workers,
-                         ngram=ngram, output='columnar',
-                         cur_shard=host, shard_count=args.hosts,
-                         shuffle_row_groups=True, seed=13, num_epochs=None) as reader:
-            loader = JaxDataLoader(reader, batch_size=args.batch_size, seed=13)
-            it = iter(loader)
-            out = None
-            for _ in range(3):  # warmup + compile
-                batch = stack_ngram_time_axis(next(it))
-                x = jax.device_put(batch['features'], batch_sharding)
-                out = seq_step(x, w)
-            jax.block_until_ready(out)
-            wait = 0.0
-            t0 = time.perf_counter()
-            for _ in range(args.steps):
-                w0 = time.perf_counter()
-                batch = stack_ngram_time_axis(next(it))
-                wait += time.perf_counter() - w0
-                x = jax.device_put(batch['features'], batch_sharding)
-                out = seq_step(x, w)
-            jax.block_until_ready(out)
-            dt = time.perf_counter() - t0
-        rate = args.steps * args.batch_size / dt
-        stall = wait / dt
-        total_rate += rate
-        worst_stall = max(worst_stall, stall)
-        print(json.dumps({'metric': 'pod_host', 'host': host,
-                          'examples_per_sec': round(rate, 1),
-                          'stall': round(stall, 4)}), flush=True)
+    with mesh:
+        state = shard_train_state(state, mesh)
+        step = make_train_step(donate=False)
+        for host in range(args.hosts):
+            ngram = NGram(fields, delta_threshold=1,
+                          timestamp_field=UnischemaField('ts', np.int64, ()))
+            with make_reader(url, reader_pool_type='thread', workers_count=args.workers,
+                             ngram=ngram, output='columnar',
+                             cur_shard=host, shard_count=args.hosts,
+                             shuffle_row_groups=True, seed=13, num_epochs=None) as reader:
+                loader = JaxDataLoader(reader, batch_size=args.batch_size, seed=13)
+                it = iter(loader)
+
+                def next_batch():
+                    stacked = stack_ngram_time_axis(next(it))
+                    x = jax.device_put(stacked['features'], batch_sharding)
+                    labels = jnp.asarray(np.asarray(stacked['ts'][:, 0]) % num_classes)
+                    return x, labels
+
+                metrics = None
+                for _ in range(3):  # warmup + compile
+                    x, labels = next_batch()
+                    state, metrics = step(state, x, labels)
+                jax.block_until_ready(metrics['loss'])
+                wait = 0.0
+                t0 = time.perf_counter()
+                for _ in range(args.steps):
+                    w0 = time.perf_counter()
+                    x, labels = next_batch()
+                    wait += time.perf_counter() - w0
+                    state, metrics = step(state, x, labels)
+                jax.block_until_ready(metrics['loss'])
+                dt = time.perf_counter() - t0
+            rate = args.steps * args.batch_size / dt
+            stall = wait / dt
+            total_rate += rate
+            worst_stall = max(worst_stall, stall)
+            print(json.dumps({'metric': 'pod_host', 'host': host,
+                              'examples_per_sec': round(rate, 1),
+                              'stall': round(stall, 4)}), flush=True)
     print(json.dumps({'metric': 'pod_aggregate', 'hosts': args.hosts,
                       'devices': args.devices, 'seq_len': args.seq_len,
                       'examples_per_sec_total': round(total_rate, 1),

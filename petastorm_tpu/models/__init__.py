@@ -2,9 +2,13 @@
 
 The framework's job is feeding TPUs (BASELINE.md: ImageNet-Parquet ResNet-50
 examples/sec/chip and input-stall %); these models are the measurement loads:
-ResNet-50 (flagship, mirrors the reference's imagenet example) and a small
-MNIST convnet (mirrors examples/mnist).
+ResNet-50 (flagship, mirrors the reference's imagenet example), a small MNIST
+convnet (mirrors examples/mnist), and a sequence transformer with pluggable
+ring attention (the long-context load: NGram windows over a ('data','seq')
+mesh).
 """
 
 from petastorm_tpu.models.resnet import ResNet, resnet18, resnet50  # noqa: F401
 from petastorm_tpu.models.mnist import MnistCNN  # noqa: F401
+from petastorm_tpu.models.transformer import (SequenceTransformer,  # noqa: F401
+                                              make_sequence_transformer)

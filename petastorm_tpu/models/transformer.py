@@ -1,0 +1,118 @@
+"""Sequence transformer over NGram windows — the long-context model family.
+
+The reference is a data library with no model side; its long-sequence story
+ends at NGram readout (reference ngram.py, SURVEY.md §5). This module closes
+the framework's long-context loop on the model side: a compact flax
+transformer whose attention is PLUGGABLE — plain softmax attention on one
+device, or the framework's exact blockwise **ring attention**
+(petastorm_tpu.ops.ring_attention) when the sequence axis is sharded over a
+mesh ('context parallelism': each device holds T/n keys, k/v shards rotate on
+the ICI ring via ppermute, attention stays exact).
+
+End-to-end: ``make_reader(output='columnar', ngram=...)`` -> JaxDataLoader ->
+``stack_ngram_time_axis`` -> [B, T, F] batches staged with
+``NamedSharding(mesh, P('data', 'seq', None))`` -> this model under jit; XLA
+inserts the data/seq collectives. ``bench_pod.py`` runs exactly this stack.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+def plain_attention(q, k, v):
+    """Reference full softmax attention for unsharded runs; [B, H, T, D]."""
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum('bhqd,bhkd->bhqk', q * scale, k)
+    return jnp.einsum('bhqk,bhkd->bhqd', jax.nn.softmax(logits, axis=-1), v)
+
+
+class TransformerBlock(nn.Module):
+    """Pre-norm block: attention + MLP with residuals. ``attention_fn`` is any
+    ``(q, k, v) -> out`` on [B, H, T, D] — plain or ring."""
+
+    d_model: int
+    num_heads: int
+    mlp_ratio: int = 4
+    attention_fn: callable = None
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):  # x: [B, T, d_model]
+        if self.d_model % self.num_heads:
+            raise ValueError('d_model ({}) must be divisible by num_heads ({})'.format(
+                self.d_model, self.num_heads))
+        attn_fn = self.attention_fn or plain_attention
+        head_dim = self.d_model // self.num_heads
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        qkv = nn.Dense(3 * self.d_model, dtype=self.dtype, name='qkv')(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):  # [B, T, d_model] -> [B, H, T, head_dim]
+            b, s, _ = t.shape
+            return t.reshape(b, s, self.num_heads, head_dim).transpose(0, 2, 1, 3)
+
+        out = attn_fn(heads(q), heads(k), heads(v))
+        out = out.transpose(0, 2, 1, 3).reshape(x.shape[0], x.shape[1], self.d_model)
+        x = x + nn.Dense(self.d_model, dtype=self.dtype, name='attn_out')(out)
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        h = nn.Dense(self.mlp_ratio * self.d_model, dtype=self.dtype, name='mlp_up')(h)
+        h = nn.gelu(h)
+        x = x + nn.Dense(self.d_model, dtype=self.dtype, name='mlp_down')(h)
+        return x
+
+
+class SequenceTransformer(nn.Module):
+    """[B, T, F] continuous features (NGram window stacks) -> [B, num_classes].
+
+    Mean-pools over time for the head; with a seq-sharded input the pool is a
+    cross-shard reduction XLA lowers to a psum on the mesh.
+    """
+
+    num_classes: int
+    d_model: int = 64
+    num_heads: int = 4
+    num_layers: int = 2
+    mlp_ratio: int = 4
+    attention_fn: callable = None
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train=True):  # noqa: ARG002 - train kept for train-step parity
+        x = x.astype(self.dtype)
+        x = nn.Dense(self.d_model, dtype=self.dtype, name='embed')(x)
+        # learned positional embedding over the window length (NGram windows
+        # are fixed-length, so T is static under jit)
+        pos = self.param('pos_embed', nn.initializers.normal(0.02),
+                         (1, x.shape[1], self.d_model))
+        x = x + pos.astype(self.dtype)
+        for i in range(self.num_layers):
+            x = TransformerBlock(self.d_model, self.num_heads, self.mlp_ratio,
+                                 attention_fn=self.attention_fn, dtype=self.dtype,
+                                 name='block{}'.format(i))(x)
+        x = nn.LayerNorm(dtype=self.dtype)(x)
+        x = jnp.mean(x, axis=1)  # [B, d_model]; psum across seq shards
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name='head')(x)
+
+
+def make_sequence_transformer(num_classes, mesh=None, seq_axis='seq', batch_axis='data',
+                              d_model=64, num_heads=4, num_layers=2, dtype=jnp.float32):
+    """Build the model; with ``mesh`` the attention runs as exact ring
+    attention sharded over ``mesh[seq_axis]`` (context parallelism), else plain
+    full attention. The returned module drops into
+    ``models.train.create_train_state`` / ``make_train_step`` unchanged.
+
+    SPMD shape constraint (standard shard_map divisibility): every batch fed
+    through the mesh-built model — including the ``create_train_state`` sample
+    input — must have B divisible by the ``batch_axis`` size and T divisible
+    by the ``seq_axis`` size."""
+    attention_fn = None
+    if mesh is not None:
+        from petastorm_tpu.ops.ring_attention import make_sharded_ring_attention
+        attention_fn = make_sharded_ring_attention(mesh, seq_axis=seq_axis,
+                                                   batch_axis=batch_axis)
+    return SequenceTransformer(num_classes=num_classes, d_model=d_model,
+                               num_heads=num_heads, num_layers=num_layers,
+                               attention_fn=attention_fn, dtype=dtype)

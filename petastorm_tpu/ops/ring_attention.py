@@ -100,12 +100,11 @@ def ring_attention(q, k, v, axis_name, causal=False):
     return out.astype(q.dtype)
 
 
-def make_ring_attention(mesh, seq_axis='seq', batch_axis=None, causal=False):
-    """A jitted ``(q, k, v) -> out`` computing exact attention with the
-    sequence axis sharded over ``mesh[seq_axis]`` (and optionally batch over
-    ``batch_axis``). Inputs/outputs are global arrays of shape [B, H, T, D]."""
-    from jax.sharding import NamedSharding
-
+def make_sharded_ring_attention(mesh, seq_axis='seq', batch_axis=None, causal=False):
+    """The un-jitted shard_map'd ``(q, k, v) -> out`` on [B, H, T, D] with the
+    sequence axis sharded over ``mesh[seq_axis]`` — composable inside a larger
+    jitted computation (e.g. a transformer's attention_fn). The ONE place the
+    partition spec + shard_map wiring lives."""
     spec = P(batch_axis, None, seq_axis, None)
 
     @functools.partial(
@@ -113,7 +112,17 @@ def make_ring_attention(mesh, seq_axis='seq', batch_axis=None, causal=False):
     def _sharded(q, k, v):
         return ring_attention(q, k, v, seq_axis, causal=causal)
 
-    fn = jax.jit(_sharded)
+    return _sharded
+
+
+def make_ring_attention(mesh, seq_axis='seq', batch_axis=None, causal=False):
+    """A jitted ``(q, k, v) -> out`` computing exact attention with the
+    sequence axis sharded over ``mesh[seq_axis]`` (and optionally batch over
+    ``batch_axis``). Inputs/outputs are global arrays of shape [B, H, T, D]."""
+    from jax.sharding import NamedSharding
+
+    spec = P(batch_axis, None, seq_axis, None)
+    fn = jax.jit(make_sharded_ring_attention(mesh, seq_axis, batch_axis, causal))
 
     def apply(q, k, v):
         sharding = NamedSharding(mesh, spec)

@@ -151,3 +151,97 @@ def test_train_step_with_device_preprocess():
     new_state, metrics = step(state, images, labels)
     assert np.isfinite(float(metrics['loss']))
     assert int(new_state.step) == 1
+
+
+class TestSequenceTransformer:
+    """Long-context model family: pluggable ring attention over a seq-sharded
+    mesh, fed by NGram window stacks."""
+
+    def _data(self, b=8, t=4, f=16, classes=6, seed=0):
+        rng = np.random.default_rng(seed)
+        x = rng.standard_normal((b, t, f)).astype(np.float32)
+        y = rng.integers(0, classes, b)
+        return x, y
+
+    def test_forward_shapes(self):
+        from petastorm_tpu.models import make_sequence_transformer
+        from petastorm_tpu.models.train import create_train_state
+        x, _ = self._data()
+        model = make_sequence_transformer(num_classes=6)
+        state = create_train_state(model, jax.random.PRNGKey(0), jnp.asarray(x))
+        logits = state.apply_fn({'params': state.params}, jnp.asarray(x))
+        assert logits.shape == (8, 6)
+
+    def test_ring_attention_model_matches_plain(self):
+        """Same params, seq-sharded ring attention == single-device full
+        attention (ring attention is exact, not an approximation)."""
+        from petastorm_tpu.models import make_sequence_transformer
+        from petastorm_tpu.parallel import make_mesh
+        x, _ = self._data(b=4, t=8, f=16)
+        mesh = make_mesh(('data', 'seq'), axis_shapes=(-1, 2))
+        plain = make_sequence_transformer(num_classes=6)
+        ring = make_sequence_transformer(num_classes=6, mesh=mesh)
+        params = plain.init(jax.random.PRNGKey(1), jnp.asarray(x))['params']
+        out_plain = plain.apply({'params': params}, jnp.asarray(x))
+        with mesh:
+            out_ring = jax.jit(lambda p, xx: ring.apply({'params': p}, xx))(
+                params, jnp.asarray(x))
+        np.testing.assert_allclose(np.asarray(out_plain), np.asarray(out_ring),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_sharded_train_step_from_columnar_ngram(self, tmp_path):
+        """The full long-context stack: columnar NGram reader -> time-major
+        stacks -> ('data','seq') sharded batches -> ring-attention transformer
+        train steps; loss finite and decreasing over a few steps."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from petastorm_tpu import make_reader
+        from petastorm_tpu.codecs import NdarrayCodec, ScalarCodec
+        from petastorm_tpu.etl.dataset_metadata import write_petastorm_dataset
+        from petastorm_tpu.jax import JaxDataLoader
+        from petastorm_tpu.jax.loader import stack_ngram_time_axis
+        from petastorm_tpu.models import make_sequence_transformer
+        from petastorm_tpu.models.train import (create_train_state, make_train_step,
+                                                shard_train_state)
+        from petastorm_tpu.ngram import NGram
+        from petastorm_tpu.parallel import make_mesh
+        from petastorm_tpu.unischema import Unischema, UnischemaField
+
+        ts = UnischemaField('ts', np.int64, (), ScalarCodec(), False)
+        feat = UnischemaField('f', np.float32, (16,), NdarrayCodec(), False)
+        schema = Unischema('Seq', [ts, feat])
+        url = 'file://' + str(tmp_path / 'seq')
+        rng = np.random.default_rng(0)
+        write_petastorm_dataset(
+            url, schema,
+            ({'ts': i, 'f': rng.standard_normal(16).astype(np.float32)}
+             for i in range(200)), rows_per_row_group=25)
+
+        mesh = make_mesh(('data', 'seq'), axis_shapes=(-1, 2))
+        window = 4
+        ngram = NGram({i: [ts, feat] for i in range(window)}, delta_threshold=1,
+                      timestamp_field=ts)
+        model = make_sequence_transformer(num_classes=4, mesh=mesh, d_model=32,
+                                          num_layers=1)
+        # SPMD: init/apply shapes must divide the mesh axes (B by 'data', T by 'seq')
+        state = create_train_state(model, jax.random.PRNGKey(0),
+                                   jnp.zeros((8, window, 16)), learning_rate=0.05)
+        batch_sharding = NamedSharding(mesh, P('data', 'seq', None))
+        with mesh:
+            state = shard_train_state(state, mesh)
+            step = make_train_step(donate=False)
+            losses = []
+            with make_reader(url, reader_pool_type='dummy', ngram=ngram,
+                             output='columnar', shuffle_row_groups=False,
+                             num_epochs=None, seed=1) as reader:
+                loader = JaxDataLoader(reader, batch_size=8, drop_last=True)
+                it = iter(loader)
+                for _ in range(8):
+                    nested = next(it)
+                    stacked = stack_ngram_time_axis(nested)
+                    x = jax.device_put(stacked['f'], batch_sharding)
+                    labels = jnp.asarray(
+                        np.asarray(stacked['ts'][:, 0]) % 4)  # derived labels
+                    state, metrics = step(state, x, labels)
+                    losses.append(float(metrics['loss']))
+        assert all(np.isfinite(losses))
+        assert losses[-1] < losses[0]  # it learns the ts%4 rule a bit
