@@ -1,0 +1,25 @@
+"""Timestamped telemetry schema — the NGram/long-context example dataset.
+
+The reference's examples stop at images (hello_world/mnist/imagenet); its NGram
+feature has no example. This schema is the shape NGram was built for
+(reference ngram.py:20-125): timestamp-ordered sensor rows windowed into
+fixed-length sequences.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from petastorm_tpu.codecs import NdarrayCodec, ScalarCodec
+from petastorm_tpu.unischema import Unischema, UnischemaField
+
+
+def make_telemetry_schema(feature_dim=64):
+    return Unischema('TelemetrySchema', [
+        UnischemaField('timestamp', np.int64, (), ScalarCodec(), False),
+        UnischemaField('features', np.float32, (feature_dim,), NdarrayCodec(), False),
+        UnischemaField('sensor_id', np.int32, (), ScalarCodec(), False),
+    ])
+
+
+TelemetrySchema = make_telemetry_schema()
