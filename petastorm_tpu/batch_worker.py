@@ -13,7 +13,6 @@ columns as stacked 2-D arrays when lengths are uniform.
 
 from __future__ import annotations
 
-from collections import deque
 
 import numpy as np
 import pyarrow as pa

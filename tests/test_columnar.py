@@ -515,3 +515,28 @@ def test_columnar_reader_pool_matrix(synthetic_dataset, pool):
     assert sorted(got) == sorted(expected)
     for k in (0, 42, 99):
         np.testing.assert_array_equal(got[k], expected[k])
+
+
+def test_weighted_sampling_mixes_columnar_readers(synthetic_dataset):
+    """WeightedSamplingReader over columnar readers: blocks sample per draw,
+    schemas/batched-ness enforced (reference weighted_sampling_reader.py:64-77)."""
+    from petastorm_tpu.weighted_sampling_reader import WeightedSamplingReader
+    r1 = make_reader(synthetic_dataset.url, reader_pool_type='dummy', output='columnar',
+                     schema_fields=['id'], shuffle_row_groups=False)
+    r2 = make_reader(synthetic_dataset.url, reader_pool_type='dummy', output='columnar',
+                     schema_fields=['id'], shuffle_row_groups=False)
+    with WeightedSamplingReader([r1, r2], [0.5, 0.5], seed=3) as mixed:
+        assert mixed.batched_output
+        blocks = [next(mixed) for _ in range(6)]
+    assert all(len(b.id) > 0 for b in blocks)
+    # mixing a columnar with a row reader is rejected
+    r3 = make_reader(synthetic_dataset.url, reader_pool_type='dummy',
+                     schema_fields=['id'], shuffle_row_groups=False)
+    r4 = make_reader(synthetic_dataset.url, reader_pool_type='dummy', output='columnar',
+                     schema_fields=['id'], shuffle_row_groups=False)
+    try:
+        with pytest.raises(Exception, match='batched_output'):
+            WeightedSamplingReader([r3, r4], [0.5, 0.5])
+    finally:
+        for r in (r3, r4):
+            r.stop(); r.join()

@@ -89,3 +89,39 @@ def test_loader_diagnostics_counters(synthetic_dataset):
         assert d['reader_wait_s'] >= 0.0
         list(it)
         assert loader.diagnostics['rows_emitted'] == 100
+
+
+def test_prefetch_checkpoint_churn_no_deadlock(synthetic_dataset):
+    """Soak the round-3 concurrency: background prefetch pump + loader state
+    lock + thread pool, with state_dict() hammered from the consumer thread and
+    early iterator abandonment — must neither deadlock nor leak pump threads."""
+    import threading
+    import jax
+    from petastorm_tpu import make_reader
+    from petastorm_tpu.jax import JaxDataLoader, prefetch_to_device
+
+    before_threads = threading.active_count()
+    for round_i in range(3):
+        reader = make_reader(synthetic_dataset.url, reader_pool_type='thread',
+                             workers_count=2, output='columnar',
+                             schema_fields=['id', 'matrix'],
+                             shuffle_row_groups=True, seed=round_i, num_epochs=None)
+        loader = JaxDataLoader(reader, batch_size=8, shuffling_queue_capacity=32,
+                               seed=round_i)
+        it = prefetch_to_device(iter(loader), jax.devices()[0], size=2)
+        for _ in range(5):
+            next(it)
+            state = loader.state_dict()
+            assert state['version'] == 1
+        it.close()  # abandon mid-stream
+        reader.stop()
+        reader.join()
+    # give daemon pump threads a moment to exit, then check for leaks
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        leaked = [t for t in threading.enumerate() if t.name == 'pstpu-prefetch']
+        if not leaked:
+            break
+        time.sleep(0.05)
+    assert not [t for t in threading.enumerate() if t.name == 'pstpu-prefetch']
+    assert threading.active_count() <= before_threads + 2

@@ -191,3 +191,14 @@ def test_throughput_fresh_process_respawn(synthetic_dataset):
     rc = throughput.main([synthetic_dataset.url, '-m', '2', '-n', '10', '-w', '1',
                           '--fresh-process'])
     assert rc == 0
+
+
+def test_reader_throughput_jax_method_columnar(synthetic_dataset):
+    """read_method='jax' measures the device-feed pipeline (columnar default)
+    and reports a stall fraction."""
+    from petastorm_tpu.tools.throughput import reader_throughput
+    res = reader_throughput(synthetic_dataset.url, field_regex=['id', 'matrix'],
+                            warmup_cycles=10, measure_cycles=40, workers_count=2,
+                            read_method='jax', batch_size=10)
+    assert res.samples_per_second > 0
+    assert 0.0 <= res.input_stall_fraction <= 1.0
