@@ -62,11 +62,17 @@ class TestGenerateMetadata:
         assert row.image_png.shape == (128, 256, 3)
 
     def test_infer_for_plain_store(self, scalar_dataset, tmp_path):
-        # copy the plain store path, then add metadata by inference
-        schema, n_rg = generate_metadata(scalar_dataset.url)
+        # COPY the plain store first: generate_metadata writes into the store,
+        # and mutating the session-scoped fixture makes make_reader-on-plain-
+        # parquet tests pass/fail depending on execution order
+        import shutil
+        store = tmp_path / 'plain_copy'
+        shutil.copytree(scalar_dataset.path, store)
+        url = path_to_url(store)
+        schema, n_rg = generate_metadata(url)
         assert 'id' in schema.fields
         assert n_rg == 10
-        assert get_schema(scalar_dataset.url) is not None
+        assert get_schema(url) is not None
 
     def test_bad_class_path(self, scalar_dataset):
         with pytest.raises(ValueError):
