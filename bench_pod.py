@@ -36,24 +36,14 @@ if REPO_ROOT not in sys.path:
 
 
 def _ensure_devices(n):
-    """Force an n-device CPU platform when the ambient backend is smaller
-    (same respawn trick as __graft_entry__.dryrun_multichip)."""
+    """Shared bring-up with __graft_entry__._ensure_devices (killable ambient
+    probe off-pod, inline trust on managed pod runtimes, forced-CPU respawn
+    otherwise — a wedged TPU tunnel cannot hang the benchmark)."""
     import __graft_entry__ as g
-    os.environ['XLA_FLAGS'] = g._force_device_count_flag(os.environ.get('XLA_FLAGS', ''), n)
-    import jax
-    if os.environ.get('_PSTPU_POD_CHILD') or os.environ.get('JAX_PLATFORMS') == 'cpu':
-        # sitecustomize pins the TPU platform via jax.config, overriding the
-        # env var — honor an explicit CPU request so off-pod runs never block
-        # on an unavailable chip/tunnel
-        jax.config.update('jax_platforms', 'cpu')
-    try:
-        have = len(jax.devices())
-    except RuntimeError:
-        have = 0
-    if have >= n:
+    if g._ensure_devices(n, '_PSTPU_POD_CHILD'):
         return True
     if os.environ.get('_PSTPU_POD_CHILD'):
-        raise RuntimeError('need {} devices, found {}'.format(n, have))
+        raise RuntimeError('need {} devices; forced-CPU child came up short'.format(n))
     import subprocess
     env = dict(os.environ, JAX_PLATFORMS='cpu', _PSTPU_POD_CHILD='1')
     env['XLA_FLAGS'] = g._force_device_count_flag(env.get('XLA_FLAGS', ''), n)
