@@ -6,7 +6,7 @@ import numpy as np
 import pytest
 
 from petastorm_tpu.codecs import (CompressedImageCodec, CompressedNdarrayCodec, NdarrayCodec,
-                                  ScalarCodec, codec_from_json)
+                                  RawTensorCodec, ScalarCodec, codec_from_json)
 from petastorm_tpu.errors import SchemaError
 from petastorm_tpu.unischema import UnischemaField
 
@@ -79,6 +79,75 @@ class TestNdarrayCodec:
         field = _field(dtype=np.float32, shape=(2,), codec=codec)
         with pytest.raises(SchemaError):
             codec.encode(field, np.zeros(2, dtype=np.float64))
+
+
+class TestRawTensorCodec:
+    def _codec_field(self, dtype=np.float32, shape=(3, 4)):
+        codec = RawTensorCodec()
+        return codec, _field(dtype=dtype, shape=shape, codec=codec)
+
+    def test_roundtrip(self):
+        codec, field = self._codec_field()
+        arr = np.arange(12, dtype=np.float32).reshape(3, 4)
+        encoded = codec.encode(field, arr)
+        assert len(encoded) == arr.nbytes  # raw payload, no header
+        out = codec.decode(field, encoded)
+        np.testing.assert_array_equal(out, arr)
+        assert out.flags.writeable
+
+    def test_wildcard_shape_rejected(self):
+        codec, field = self._codec_field(shape=(None, 2))
+        with pytest.raises(SchemaError, match='fully-specified'):
+            codec.encode(field, np.zeros((7, 2), dtype=np.float32))
+
+    def test_non_numeric_dtype_rejected(self):
+        codec, field = self._codec_field(dtype=np.str_, shape=(2,))
+        with pytest.raises(SchemaError):
+            codec.encode(field, np.array(['a', 'b']))
+
+    def test_wrong_cell_length_raises(self):
+        codec, field = self._codec_field(dtype=np.int16, shape=(4,))
+        with pytest.raises(SchemaError, match='expected'):
+            codec.decode(field, b'\x00' * 7)
+
+    def test_decode_column_is_zero_copy_view(self):
+        import pyarrow as pa
+        codec, field = self._codec_field(dtype=np.uint8, shape=(2, 5))
+        cells = [codec.encode(field, np.full((2, 5), i, dtype=np.uint8)) for i in range(6)]
+        column = pa.chunked_array([pa.array(cells, type=pa.binary())])
+        out = codec.decode_column(field, column)
+        assert out.shape == (6, 2, 5)
+        for i in range(6):
+            assert (out[i] == i).all()
+        base = np.frombuffer(column.chunk(0).buffers()[2], dtype=np.uint8)
+        assert np.shares_memory(out, base)
+
+    def test_decode_column_sliced_array(self):
+        import pyarrow as pa
+        codec, field = self._codec_field(dtype=np.int32, shape=(3,))
+        cells = [codec.encode(field, np.array([i, i, i], dtype=np.int32)) for i in range(8)]
+        column = pa.chunked_array([pa.array(cells, type=pa.binary()).slice(2, 5)])
+        out = codec.decode_column(field, column)
+        assert out.shape == (5, 3)
+        np.testing.assert_array_equal(out[:, 0], np.arange(2, 7))
+
+    def test_decode_column_bad_cell_falls_back(self):
+        import pyarrow as pa
+        codec, field = self._codec_field(dtype=np.int32, shape=(3,))
+        cells = [codec.encode(field, np.zeros(3, dtype=np.int32)), b'short']
+        column = pa.chunked_array([pa.array(cells, type=pa.binary())])
+        assert codec.decode_column(field, column) is None
+
+    def test_decode_column_nulls_fall_back(self):
+        import pyarrow as pa
+        codec, field = self._codec_field(dtype=np.int32, shape=(3,))
+        cells = [codec.encode(field, np.zeros(3, dtype=np.int32)), None]
+        column = pa.chunked_array([pa.array(cells, type=pa.binary())])
+        assert codec.decode_column(field, column) is None
+
+    def test_json_roundtrip(self):
+        codec = RawTensorCodec()
+        assert codec_from_json(codec.to_json()) == codec
 
 
 class TestCompressedNdarrayCodec:

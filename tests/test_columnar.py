@@ -622,3 +622,62 @@ def test_loader_columnar_resume_through_thread_pool(synthetic_dataset):
                        seed=9, drop_last=False, resume_state=state) as resumed:
         rest = [i for b in resumed for i in b['id'].tolist()]
     assert sorted(seen + rest) == sorted(r['id'] for r in synthetic_dataset.data)
+
+
+# -- RawTensorCodec end-to-end (the zero-copy store format) ------------------
+
+@pytest.fixture(scope='module')
+def raw_tensor_dataset(tmp_path_factory):
+    from petastorm_tpu.codecs import RawTensorCodec, ScalarCodec
+    from petastorm_tpu.etl.dataset_metadata import write_petastorm_dataset
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+
+    path = tmp_path_factory.mktemp('raw_tensor_store')
+    url = 'file://' + str(path)
+    schema = Unischema('RawTensor', [
+        UnischemaField('id', np.int64, (), ScalarCodec(), False),
+        UnischemaField('vec', np.float32, (4, 3), RawTensorCodec(), False),
+    ])
+    rng = np.random.default_rng(7)
+    data = [{'id': i, 'vec': rng.standard_normal((4, 3)).astype(np.float32)}
+            for i in range(50)]
+    write_petastorm_dataset(url, schema, iter(data), rows_per_row_group=10)
+    return url, data
+
+
+def test_raw_tensor_columnar_round_trip(raw_tensor_dataset):
+    url, data = raw_tensor_dataset
+    seen = {}
+    with make_reader(url, reader_pool_type='dummy', output='columnar',
+                     shuffle_row_groups=False) as reader:
+        for block in reader:
+            for i, row_id in enumerate(block.id.tolist()):
+                seen[row_id] = np.asarray(block.vec[i])
+    assert len(seen) == len(data)
+    for row in data:
+        np.testing.assert_array_equal(seen[row['id']], row['vec'])
+
+
+def test_raw_tensor_row_reader_round_trip(raw_tensor_dataset):
+    url, data = raw_tensor_dataset
+    by_id = {row['id']: row['vec'] for row in data}
+    n = 0
+    with make_reader(url, reader_pool_type='dummy', shuffle_row_groups=False) as reader:
+        for row in reader:
+            np.testing.assert_array_equal(row.vec, by_id[row.id])
+            assert row.vec.dtype == np.float32
+            n += 1
+    assert n == len(data)
+
+
+def test_raw_tensor_loader_shuffled_covers_all_rows(raw_tensor_dataset):
+    url, data = raw_tensor_dataset
+    ids = []
+    with make_reader(url, output='columnar', reader_pool_type='thread',
+                     workers_count=2, seed=3) as reader:
+        with JaxDataLoader(reader, 8, shuffling_queue_capacity=32, seed=3,
+                           drop_last=False) as loader:
+            for batch in loader:
+                ids.extend(batch['id'].tolist())
+                assert batch['vec'].shape[1:] == (4, 3)
+    assert sorted(ids) == [row['id'] for row in data]
