@@ -166,13 +166,17 @@ def main(argv=None):
         # (e.g. the NdarrayCodec -> RawTensorCodec switch) must be rebuilt, not
         # silently measured under the new label
         raw_stamp = os.path.join(raw_dir, '.format_stamp')
+        # layout version + build params: a stale --keep-dir store (older codec
+        # OR different rows/size/classes) is rebuilt, never silently measured
+        raw_spec = '{}:rows={}:image_size={}:num_classes={}'.format(
+            RAW_STORE_FORMAT, args.rows, args.image_size, args.num_classes)
         raw_fresh = (os.path.exists(raw_stamp) and
-                     open(raw_stamp).read().strip() == RAW_STORE_FORMAT)
+                     open(raw_stamp).read().strip() == raw_spec)
         if 'raw' in variants and not raw_fresh:
             shutil.rmtree(raw_dir, ignore_errors=True)
             build_raw_store(raw_url, args.rows, args.image_size, args.num_classes)
             with open(raw_stamp, 'w') as f:
-                f.write(RAW_STORE_FORMAT)
+                f.write(raw_spec)
         if not os.path.exists(jpeg_dir) and 'jpeg' in variants:
             # realistic ImageNet photo sizes; scaled DCT decode shines here
             build_png_store(jpeg_url, args.rows, image_codec='jpeg',

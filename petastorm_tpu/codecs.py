@@ -536,6 +536,34 @@ class CompressedImageCodec(DataFieldCodec):
             image = cv2.cvtColor(image, cv2.COLOR_BGR2RGB)
         return image.astype(np.dtype(field.numpy_dtype), copy=False)
 
+    #: _decode_table passes ``min_size`` (from TransformSpec.image_decode_hints)
+    #: to decode_column — the only codec whose columnar decode takes a hint
+    decode_column_accepts_hints = True
+
+    def decode_column(self, field, column, min_size=None):
+        """Whole-column decode with ONE native header probe: straight into one
+        ``[N, H, W(, C)]`` block when every cell probes to the same dims (skips
+        the per-image allocations AND the column-stack copy of the
+        ``decode_batch`` + ``stack_cells`` path), else per-image arrays stacked
+        to an object column — still a single probe. ``None`` defers to the
+        generic path (nulls, unsupported flavors, native codec unavailable)."""
+        from petastorm_tpu.columnar import column_cells, stack_cells
+        from petastorm_tpu.native import image_codec
+
+        if column.null_count or not image_codec.is_available():
+            return None
+        cells = column_cells(column)
+        if not cells:
+            return None
+        try:
+            decoded = image_codec.decode_images_auto(cells, min_size=min_size)
+        except (image_codec.NativeDecodeError, MemoryError):
+            return None
+        dtype = np.dtype(field.numpy_dtype)
+        if isinstance(decoded, np.ndarray):
+            return decoded.astype(dtype, copy=False)
+        return stack_cells([img.astype(dtype, copy=False) for img in decoded])
+
     def decode_batch(self, field, encoded_list, min_size=None):
         """Decode a whole column of image cells in one native call (GIL
         released, pixels land in numpy memory in RGB order with no BGR swap

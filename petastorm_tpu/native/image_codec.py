@@ -126,3 +126,68 @@ def decode_images(buffers, threads=None, min_size=None):
         raise NativeDecodeError('image decode failed at index {}: {}'.format(
             rc, lib.pstpu_img_last_error().decode(errors='replace')), index=rc)
     return outs
+
+
+def decode_images_auto(buffers, threads=None, min_size=None):
+    """Decode a column of image cells with ONE header probe, into the best
+    output layout the column admits:
+
+      * every cell probes to the same dims/depth (the normal case for a
+        prepared training store) -> ONE ``[N, H, W(, C)]`` array; the
+        per-image out pointers simply walk the rows of a single allocation,
+        so the per-image allocations and the column-stack copy that would
+        follow them disappear;
+      * mixed dims -> a list of per-image arrays (same outputs as
+        :func:`decode_images`) WITHOUT re-probing the headers.
+
+    Raises :class:`NativeDecodeError` like :func:`decode_images` for
+    unsupported cells."""
+    lib = _load_library()
+    if lib is None:
+        raise NativeDecodeError('native image codec not available')
+    n = len(buffers)
+    if n == 0:
+        return []
+    min_h, min_w = (int(min_size[0]), int(min_size[1])) if min_size else (0, 0)
+    views = [np.frombuffer(b, dtype=np.uint8) for b in buffers]
+    ptrs = (ctypes.c_void_p * n)(*[v.ctypes.data for v in views])
+    lens = (ctypes.c_uint64 * n)(*[v.size for v in views])
+    infos = np.empty((n, 4), dtype=np.int32)
+    infos_p = infos.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+    rc = lib.pstpu_img_probe_batch2(n, ptrs, lens, infos_p, min_w, min_h)
+    if rc != -1:
+        raise NativeDecodeError('unsupported or corrupt image at index {}'.format(rc), index=rc)
+
+    uniform = n == 1 or not (infos != infos[0]).any()
+    if uniform:
+        w, h, c, depth = (int(x) for x in infos[0])
+        dtype = np.uint16 if depth == 16 else np.uint8
+        shape = (n, h, w) if c == 1 else (n, h, w, c)
+        result = np.empty(shape, dtype=dtype)
+        stride = result.strides[0]
+        base = result.ctypes.data
+        out_ptrs = (ctypes.c_void_p * n)(*[base + i * stride for i in range(n)])
+    else:
+        result = []
+        out_ptrs = (ctypes.c_void_p * n)()
+        for i in range(n):
+            w, h, c, depth = (int(x) for x in infos[i])
+            dtype = np.uint16 if depth == 16 else np.uint8
+            arr = np.empty((h, w) if c == 1 else (h, w, c), dtype=dtype)
+            result.append(arr)
+            out_ptrs[i] = arr.ctypes.data
+    rc = lib.pstpu_img_decode_batch2(n, ptrs, lens, out_ptrs, infos_p,
+                                     threads if threads is not None else _default_threads(),
+                                     min_w, min_h)
+    if rc != -1:
+        raise NativeDecodeError('image decode failed at index {}: {}'.format(
+            rc, lib.pstpu_img_last_error().decode(errors='replace')), index=rc)
+    return result
+
+
+def decode_images_block(buffers, threads=None, min_size=None):
+    """:func:`decode_images_auto` restricted to the single-block layout:
+    returns the ``[N, H, W(, C)]`` array, or ``None`` when dims differ."""
+    result = decode_images_auto(buffers, threads=threads, min_size=min_size)
+    return result if isinstance(result, np.ndarray) else None

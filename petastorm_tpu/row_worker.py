@@ -200,7 +200,11 @@ class RowGroupDecoderWorker(WorkerBase):
             column = table.column(name)
             decoded = None
             if hasattr(codec, 'decode_column'):
-                decoded = codec.decode_column(field, column)
+                if getattr(codec, 'decode_column_accepts_hints', False):
+                    decoded = codec.decode_column(field, column,
+                                                  min_size=decode_hints.get(name))
+                else:
+                    decoded = codec.decode_column(field, column)
             if decoded is None:
                 cells = column_cells(column)
                 if hasattr(codec, 'decode_batch'):
@@ -210,6 +214,13 @@ class RowGroupDecoderWorker(WorkerBase):
                 else:
                     values = [None if v is None else codec.decode(field, v) for v in cells]
                 decoded = stack_cells(values)
+            elif (transform is not None and transform.func is not None
+                  and isinstance(decoded, np.ndarray) and not decoded.flags.writeable):
+                # zero-copy columnar decodes (RawTensorCodec) may be read-only
+                # views of the Arrow buffer; user transform funcs are entitled
+                # to mutate in place (decode()'s writable-array contract), so
+                # give them their own copy
+                decoded = decoded.copy()
             block[name] = decoded
         return block
 

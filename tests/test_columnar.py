@@ -681,3 +681,20 @@ def test_raw_tensor_loader_shuffled_covers_all_rows(raw_tensor_dataset):
                 ids.extend(batch['id'].tolist())
                 assert batch['vec'].shape[1:] == (4, 3)
     assert sorted(ids) == [row['id'] for row in data]
+
+
+def test_raw_tensor_transform_can_mutate_in_place(raw_tensor_dataset):
+    # zero-copy columnar decode hands out read-only Arrow-buffer views; a user
+    # TransformSpec is entitled to mutate rows in place (decode()'s writable
+    # contract), so the worker must copy before applying transforms
+    url, data = raw_tensor_dataset
+    by_id = {row['id']: row['vec'] for row in data}
+
+    def double(row):
+        row['vec'] *= 2.0
+        return row
+
+    with make_reader(url, reader_pool_type='dummy', shuffle_row_groups=False,
+                     transform_spec=TransformSpec(double)) as reader:
+        for row in reader:
+            np.testing.assert_array_equal(row.vec, by_id[row.id] * 2.0)

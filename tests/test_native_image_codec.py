@@ -252,3 +252,97 @@ def test_transform_decode_hints_end_to_end(tmp_path):
     assert images.shape == (16, 96, 96, 3)
     labels = np.concatenate([b['label'] for b in blocks])
     assert set(labels.tolist()) <= set(range(10))
+
+
+# -- decode_images_block: whole-column decode into one allocation ------------
+
+def test_block_decode_matches_per_image():
+    rng = np.random.default_rng(11)
+    imgs = [rng.integers(0, 255, (40, 56, 3), dtype=np.uint8) for _ in range(7)]
+    blobs = [_png(im) for im in imgs[:4]] + [_jpeg(im) for im in imgs[4:]]
+    block = image_codec.decode_images_block(blobs)
+    singles = image_codec.decode_images(blobs)
+    assert block.shape == (7, 40, 56, 3) and block.dtype == np.uint8
+    for i in range(7):
+        np.testing.assert_array_equal(block[i], singles[i])
+
+
+def test_block_decode_mixed_dims_returns_none():
+    rng = np.random.default_rng(12)
+    blobs = [_png(rng.integers(0, 255, (16, 16, 3), dtype=np.uint8)),
+             _png(rng.integers(0, 255, (16, 20, 3), dtype=np.uint8))]
+    assert image_codec.decode_images_block(blobs) is None
+
+
+def test_block_decode_grayscale():
+    rng = np.random.default_rng(13)
+    imgs = [rng.integers(0, 255, (24, 24), dtype=np.uint8) for _ in range(3)]
+    block = image_codec.decode_images_block([_png(im) for im in imgs])
+    assert block.shape == (3, 24, 24)
+    for i, im in enumerate(imgs):
+        np.testing.assert_array_equal(block[i], im)
+
+
+def test_block_decode_bad_cell_raises():
+    with pytest.raises(image_codec.NativeDecodeError):
+        image_codec.decode_images_block([b'not an image'])
+
+
+def test_codec_decode_column_matches_batch():
+    import pyarrow as pa
+    rng = np.random.default_rng(14)
+    codec = CompressedImageCodec('png')
+    field = UnischemaField('im', np.uint8, (18, 22, 3), codec, False)
+    imgs = [rng.integers(0, 255, (18, 22, 3), dtype=np.uint8) for _ in range(5)]
+    cells = [codec.encode(field, im) for im in imgs]
+    column = pa.chunked_array([pa.array(cells, type=pa.binary())])
+    block = codec.decode_column(field, column)
+    assert block.shape == (5, 18, 22, 3)
+    for i, im in enumerate(imgs):
+        np.testing.assert_array_equal(block[i], im)
+
+
+def test_codec_decode_column_nulls_defer():
+    import pyarrow as pa
+    codec = CompressedImageCodec('png')
+    field = UnischemaField('im', np.uint8, (8, 8, 3), codec, True)
+    cells = [codec.encode(field, np.zeros((8, 8, 3), np.uint8)), None]
+    column = pa.chunked_array([pa.array(cells, type=pa.binary())])
+    assert codec.decode_column(field, column) is None
+
+
+def test_codec_decode_column_scaled_jpeg_hint():
+    import pyarrow as pa
+    codec = CompressedImageCodec('jpeg')
+    field = UnischemaField('im', np.uint8, (None, None, 3), codec, False)
+    cells = [_jpeg_bytes(400, 600, seed=i) for i in range(3)]
+    column = pa.chunked_array([pa.array(cells, type=pa.binary())])
+    block = codec.decode_column(field, column, min_size=(100, 150))
+    assert block is not None
+    n, h, w, c = block.shape
+    assert 100 <= h < 400 and 150 <= w < 600  # decoded at a reduced DCT scale
+
+
+def test_auto_decode_mixed_dims_returns_per_image_list():
+    rng = np.random.default_rng(15)
+    imgs = [rng.integers(0, 255, (16, 16, 3), dtype=np.uint8),
+            rng.integers(0, 255, (16, 20, 3), dtype=np.uint8)]
+    out = image_codec.decode_images_auto([_png(im) for im in imgs])
+    assert isinstance(out, list) and len(out) == 2
+    for got, want in zip(out, imgs):
+        np.testing.assert_array_equal(got, want)
+
+
+def test_codec_decode_column_mixed_dims_single_probe_object_column():
+    import pyarrow as pa
+    rng = np.random.default_rng(16)
+    codec = CompressedImageCodec('png')
+    field = UnischemaField('im', np.uint8, (None, None, 3), codec, False)
+    imgs = [rng.integers(0, 255, (10, 12, 3), dtype=np.uint8),
+            rng.integers(0, 255, (14, 12, 3), dtype=np.uint8)]
+    cells = [codec.encode(field, im) for im in imgs]
+    column = pa.chunked_array([pa.array(cells, type=pa.binary())])
+    out = codec.decode_column(field, column)
+    assert out is not None and out.dtype == object
+    for got, want in zip(out, imgs):
+        np.testing.assert_array_equal(got, want)
