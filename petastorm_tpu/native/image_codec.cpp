@@ -22,6 +22,7 @@
 // Build: python -m petastorm_tpu.native.build (third target; links -ljpeg -lpng).
 
 #include <atomic>
+#include <cmath>
 #include <csetjmp>
 #include <cstdint>
 #include <cstring>
@@ -516,6 +517,102 @@ int decode_jpeg(const uint8_t* data, uint64_t len, const int32_t* info, uint8_t*
 }
 
 int decode_one(const uint8_t* data, uint64_t len, const int32_t* info, uint8_t* out,
+               std::string* err, int min_w, int min_h);
+
+// -- area resampling (separable, contribution-based; the cv2 INTER_AREA
+// analog) -- used by the fused decode+resize path so the per-row Python
+// resize transform disappears from the host hot loop.
+
+void area_contribs(int in_len, int out_len, std::vector<int>& starts,
+                   std::vector<int>& counts, std::vector<float>& weights,
+                   int& max_count) {
+  const double scale = double(in_len) / out_len;
+  starts.resize(out_len);
+  counts.resize(out_len);
+  max_count = int(std::ceil(scale)) + 1;
+  weights.assign(size_t(out_len) * max_count, 0.0f);
+  for (int o = 0; o < out_len; o++) {
+    const double lo = o * scale;
+    const double hi = std::min(double(in_len), (o + 1) * scale);
+    int s = std::min(in_len - 1, int(lo));
+    int e = std::max(s + 1, std::min(in_len, int(std::ceil(hi))));
+    starts[o] = s;
+    counts[o] = e - s;
+    const double span = hi - lo;
+    float wsum = 0.0f;
+    for (int p = s; p < e; p++) {
+      // overlap of input pixel [p, p+1) with the output footprint [lo, hi)
+      const double ov = std::min(double(p + 1), hi) - std::max(double(p), lo);
+      const float w = float(std::max(0.0, ov) / (span > 0.0 ? span : 1.0));
+      weights[size_t(o) * max_count + (p - s)] = w;
+      wsum += w;
+    }
+    if (wsum > 0.0f) {  // normalize away float drift
+      for (int k = 0; k < e - s; k++) weights[size_t(o) * max_count + k] /= wsum;
+    }
+  }
+}
+
+void resize_area(const uint8_t* src, int sw, int sh, int c, uint8_t* dst, int dw, int dh) {
+  std::vector<int> xs, xc, ys, yc;
+  std::vector<float> xw, yw;
+  int xmax = 0, ymax = 0;
+  area_contribs(sw, dw, xs, xc, xw, xmax);
+  area_contribs(sh, dh, ys, yc, yw, ymax);
+  // horizontal pass: [sh, sw, c] -> float [sh, dw, c]
+  std::vector<float> tmp(size_t(sh) * dw * c);
+  for (int y = 0; y < sh; y++) {
+    const uint8_t* row = src + size_t(y) * sw * c;
+    float* trow = tmp.data() + size_t(y) * dw * c;
+    for (int ox = 0; ox < dw; ox++) {
+      const int s = xs[ox], cnt = xc[ox];
+      const float* w = xw.data() + size_t(ox) * xmax;
+      for (int ch = 0; ch < c; ch++) {
+        float acc = 0.0f;
+        for (int k = 0; k < cnt; k++) acc += w[k] * row[(s + k) * c + ch];
+        trow[ox * c + ch] = acc;
+      }
+    }
+  }
+  // vertical pass: float [sh, dw, c] -> uint8 [dh, dw, c]
+  for (int oy = 0; oy < dh; oy++) {
+    const int s = ys[oy], cnt = yc[oy];
+    const float* w = yw.data() + size_t(oy) * ymax;
+    uint8_t* drow = dst + size_t(oy) * dw * c;
+    for (int x = 0; x < dw * c; x++) {
+      float acc = 0.0f;
+      for (int k = 0; k < cnt; k++) acc += w[k] * tmp[size_t(s + k) * dw * c + x];
+      const int v = int(acc + 0.5f);
+      drow[x] = uint8_t(v < 0 ? 0 : (v > 255 ? 255 : v));
+    }
+  }
+}
+
+int decode_resize_one(const uint8_t* data, uint64_t len, const int32_t* info, uint8_t* out,
+                      std::string* err, int min_w, int min_h, int out_w, int out_h) {
+  try {
+    const int sw = info[0], sh = info[1], c = info[2];
+    if (info[3] != 8) {
+      *err = "fused resize supports 8-bit images only";
+      return -1;
+    }
+    if (sw == out_w && sh == out_h) {
+      return decode_one(data, len, info, out, err, min_w, min_h);
+    }
+    std::vector<uint8_t> scratch(size_t(sw) * sh * c);
+    if (decode_one(data, len, info, scratch.data(), err, min_w, min_h) != 0) return -1;
+    resize_area(scratch.data(), sw, sh, c, out, out_w, out_h);
+    return 0;
+  } catch (const std::exception& e) {
+    *err = e.what();
+    return -1;
+  } catch (...) {
+    *err = "unknown C++ exception during image decode+resize";
+    return -1;
+  }
+}
+
+int decode_one(const uint8_t* data, uint64_t len, const int32_t* info, uint8_t* out,
                std::string* err, int min_w, int min_h) {
   // C++ exceptions (bad_alloc from the scratch vectors, etc.) must not cross
   // the extern "C" boundary — that would std::terminate the worker process
@@ -537,6 +634,69 @@ int decode_one(const uint8_t* data, uint64_t len, const int32_t* info, uint8_t* 
 }
 
 thread_local std::string g_error;
+
+// Shared fan-out scaffolding for the batch entry points: run fn(i, &err) for
+// every index, inline when threads <= 1, else over an internal pool with
+// first-failure reporting. Returns -1 on success, else the lowest failed index
+// (g_error carries its message).
+template <typename Fn>
+int64_t run_batch(int64_t n, int threads, Fn&& fn) {
+  if (n <= 0) return -1;
+  if (threads <= 1 || n == 1) {
+    for (int64_t i = 0; i < n; i++) {
+      std::string err;
+      if (fn(i, &err) != 0) {
+        g_error = err;
+        return i;
+      }
+    }
+    return -1;
+  }
+  const int nt = int(std::min<int64_t>(threads, n));
+  std::atomic<int64_t> next{0};
+  std::atomic<bool> any_fail{false};
+  std::mutex fail_mutex;
+  int64_t fail_idx = -1;
+  std::string fail_err;
+  std::vector<std::thread> pool;
+  pool.reserve(nt);
+  try {
+    for (int t = 0; t < nt; t++) {
+      pool.emplace_back([&]() {
+        for (;;) {
+          const int64_t i = next.fetch_add(1, std::memory_order_relaxed);
+          if (i >= n) return;
+          if (any_fail.load(std::memory_order_relaxed)) return;  // stop early
+          std::string err;
+          if (fn(i, &err) != 0) {
+            any_fail.store(true, std::memory_order_relaxed);
+            std::lock_guard<std::mutex> lock(fail_mutex);
+            if (fail_idx < 0 || i < fail_idx) {
+              fail_idx = i;
+              fail_err = err;
+            }
+          }
+        }
+      });
+    }
+  } catch (...) {  // thread spawn failed: join what started, run inline
+    for (auto& th : pool) th.join();
+    for (int64_t i = 0; i < n; i++) {
+      std::string err;
+      if (fn(i, &err) != 0) {
+        g_error = err;
+        return i;
+      }
+    }
+    return -1;
+  }
+  for (auto& th : pool) th.join();
+  if (fail_idx >= 0) {
+    g_error = fail_err.empty() ? "image decode failed" : fail_err;
+    return fail_idx;
+  }
+  return -1;
+}
 
 }  // namespace
 
@@ -570,61 +730,39 @@ int64_t pstpu_img_probe_batch(int64_t n, const uint8_t* const* datas, const uint
 int64_t pstpu_img_decode_batch2(int64_t n, const uint8_t* const* datas, const uint64_t* lens,
                                 uint8_t* const* outs, const int32_t* infos, int threads,
                                 int32_t min_w, int32_t min_h) {
-  if (n <= 0) return -1;
-  if (threads <= 1 || n == 1) {
-    for (int64_t i = 0; i < n; i++) {
-      std::string err;
-      if (decode_one(datas[i], lens[i], infos + i * 4, outs[i], &err, min_w, min_h) != 0) {
-        g_error = err;
-        return i;
-      }
-    }
-    return -1;
-  }
-  const int nt = int(std::min<int64_t>(threads, n));
-  std::atomic<int64_t> next{0};
-  std::atomic<bool> any_fail{false};
-  std::mutex fail_mutex;
-  int64_t fail_idx = -1;
-  std::string fail_err;
-  std::vector<std::thread> pool;
-  pool.reserve(nt);
+  return run_batch(n, threads, [&](int64_t i, std::string* err) {
+    return decode_one(datas[i], lens[i], infos + i * 4, outs[i], err, min_w, min_h);
+  });
+}
+
+// Standalone area resample of one decoded 8-bit image (OpenCV-less
+// deployments use this where cv2.resize would run). Returns 0, or -1 on
+// invalid dims.
+int64_t pstpu_img_resize_area(const uint8_t* src, int32_t sw, int32_t sh, int32_t c,
+                              uint8_t* dst, int32_t dw, int32_t dh) {
+  if (sw < 1 || sh < 1 || dw < 1 || dh < 1 || c < 1) return -1;
   try {
-  for (int t = 0; t < nt; t++) {
-    pool.emplace_back([&]() {
-      for (;;) {
-        const int64_t i = next.fetch_add(1, std::memory_order_relaxed);
-        if (i >= n) return;
-        if (any_fail.load(std::memory_order_relaxed)) return;  // stop early
-        std::string err;
-        if (decode_one(datas[i], lens[i], infos + i * 4, outs[i], &err, min_w, min_h) != 0) {
-          any_fail.store(true, std::memory_order_relaxed);
-          std::lock_guard<std::mutex> lock(fail_mutex);
-          if (fail_idx < 0 || i < fail_idx) {
-            fail_idx = i;
-            fail_err = err;
-          }
-        }
-      }
-    });
-  }
-  } catch (...) {  // thread spawn failed: join what started, decode inline
-    for (auto& th : pool) th.join();
-    for (int64_t i = 0; i < n; i++) {
-      std::string err;
-      if (decode_one(datas[i], lens[i], infos + i * 4, outs[i], &err, min_w, min_h) != 0) {
-        g_error = err;
-        return i;
-      }
-    }
+    resize_area(src, sw, sh, c, dst, dw, dh);
+    return 0;
+  } catch (...) {
+    g_error = "resize failed";
     return -1;
   }
-  for (auto& th : pool) th.join();
-  if (fail_idx >= 0) {
-    g_error = fail_err.empty() ? "image decode failed" : fail_err;
-    return fail_idx;
-  }
-  return -1;
+}
+
+// Fused decode+resize: each image is decoded at its probed dims (JPEG: the
+// min_w/min_h DCT scale, matching the probe) then area-resampled into its
+// caller-allocated out_h x out_w output — one GIL-released call replaces the
+// per-row Python resize transform. 8-bit images only.
+int64_t pstpu_img_decode_resize_batch(int64_t n, const uint8_t* const* datas,
+                                      const uint64_t* lens, uint8_t* const* outs,
+                                      const int32_t* infos, int threads,
+                                      int32_t min_w, int32_t min_h,
+                                      int32_t out_w, int32_t out_h) {
+  return run_batch(n, threads, [&](int64_t i, std::string* err) {
+    return decode_resize_one(datas[i], lens[i], infos + i * 4, outs[i], err,
+                             min_w, min_h, out_w, out_h);
+  });
 }
 
 int64_t pstpu_img_decode_batch(int64_t n, const uint8_t* const* datas, const uint64_t* lens,

@@ -61,6 +61,15 @@ def _load_library():
             ctypes.c_int64, ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_uint64),
             ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
             ctypes.c_int32, ctypes.c_int32]
+        lib.pstpu_img_decode_resize_batch.restype = ctypes.c_int64
+        lib.pstpu_img_decode_resize_batch.argtypes = [
+            ctypes.c_int64, ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32]
+        lib.pstpu_img_resize_area.restype = ctypes.c_int64
+        lib.pstpu_img_resize_area.argtypes = [
+            ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32]
         _lib = lib
         return _lib
 
@@ -191,3 +200,77 @@ def decode_images_block(buffers, threads=None, min_size=None):
     returns the ``[N, H, W(, C)]`` array, or ``None`` when dims differ."""
     result = decode_images_auto(buffers, threads=threads, min_size=min_size)
     return result if isinstance(result, np.ndarray) else None
+
+
+def resize_area_image(img, size):
+    """Area-resample one decoded uint8 image to ``size=(out_h, out_w)`` with
+    the native resampler — the cv2 ``INTER_AREA`` stand-in for OpenCV-less
+    deployments. Returns a new array; raises :class:`NativeDecodeError` when
+    the native library is unavailable."""
+    lib = _load_library()
+    if lib is None:
+        raise NativeDecodeError('native image codec not available')
+    if img.dtype != np.uint8:
+        raise ValueError('resize_area_image supports uint8, got {}'.format(img.dtype))
+    out_h, out_w = int(size[0]), int(size[1])
+    c = img.shape[2] if img.ndim == 3 else 1
+    src = np.ascontiguousarray(img)
+    out = np.empty((out_h, out_w) + ((c,) if img.ndim == 3 else ()), np.uint8)
+    rc = lib.pstpu_img_resize_area(src.ctypes.data, img.shape[1], img.shape[0], c,
+                                   out.ctypes.data, out_w, out_h)
+    if rc != 0:
+        raise NativeDecodeError('native resize failed: {}'.format(
+            lib.pstpu_img_last_error().decode(errors='replace')))
+    return out
+
+
+def decode_images_resized(buffers, size, threads=None, min_size=None):
+    """Fused decode + area resize of a whole column into ONE
+    ``[N, out_h, out_w(, C)]`` allocation. ``size`` is ``(out_h, out_w)``.
+    Each image decodes at its probed dims (JPEG: at the smallest m/8 DCT scale
+    covering the target, so most pixels of a large photo never exist) and is
+    then area-resampled (cv2 ``INTER_AREA`` analog) into its output row — one
+    GIL-released native call replaces a per-row Python resize transform.
+
+    ``min_size=(min_h, min_w)`` overrides the DCT-scale floor (an explicit
+    ``image_decode_hints`` entry wins over the resize target — e.g. decode at
+    >= 2x the target for a supersampled downscale); default is the target
+    itself.
+
+    Returns ``None`` when the column mixes channel counts or carries 16-bit
+    images (callers fall back to their per-image path); raises
+    :class:`NativeDecodeError` for unsupported/corrupt cells."""
+    lib = _load_library()
+    if lib is None:
+        raise NativeDecodeError('native image codec not available')
+    n = len(buffers)
+    if n == 0:
+        return None
+    out_h, out_w = int(size[0]), int(size[1])
+    if out_h < 1 or out_w < 1:
+        raise ValueError('resize target must be positive, got {}'.format(size))
+    min_h, min_w = (int(min_size[0]), int(min_size[1])) if min_size else (out_h, out_w)
+    views = [np.frombuffer(b, dtype=np.uint8) for b in buffers]
+    ptrs = (ctypes.c_void_p * n)(*[v.ctypes.data for v in views])
+    lens = (ctypes.c_uint64 * n)(*[v.size for v in views])
+    infos = np.empty((n, 4), dtype=np.int32)
+    infos_p = infos.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+    rc = lib.pstpu_img_probe_batch2(n, ptrs, lens, infos_p, min_w, min_h)
+    if rc != -1:
+        raise NativeDecodeError('unsupported or corrupt image at index {}'.format(rc), index=rc)
+    if (infos[:, 3] != 8).any() or (infos[:, 2] != infos[0, 2]).any():
+        return None  # 16-bit or mixed gray/RGB column: per-image path
+    c = int(infos[0, 2])
+    shape = (n, out_h, out_w) if c == 1 else (n, out_h, out_w, c)
+    out = np.empty(shape, dtype=np.uint8)
+    stride = out.strides[0]
+    base = out.ctypes.data
+    out_ptrs = (ctypes.c_void_p * n)(*[base + i * stride for i in range(n)])
+    rc = lib.pstpu_img_decode_resize_batch(n, ptrs, lens, out_ptrs, infos_p,
+                                           threads if threads is not None else _default_threads(),
+                                           min_w, min_h, out_w, out_h)
+    if rc != -1:
+        raise NativeDecodeError('image decode+resize failed at index {}: {}'.format(
+            rc, lib.pstpu_img_last_error().decode(errors='replace')), index=rc)
+    return out

@@ -30,12 +30,16 @@ from petastorm_tpu.native import open_parquet
 from petastorm_tpu.workers.worker_base import WorkerBase
 
 
-def _cache_key(dataset_path, piece, column_names, decode_hints=None):
+def _cache_key(dataset_path, piece, column_names, decode_hints=None, resize_hints=None):
     cols = ','.join(sorted(column_names))
     if decode_hints:
         # scaled-decode output differs per hint: readers with different hints
         # must not share cached decoded blocks
         cols += '|' + repr(sorted(decode_hints.items()))
+    if resize_hints:
+        # decode-time resize bakes the target size into the cached block —
+        # a reader with a different (or no) resize must not read it back
+        cols += '|rsz' + repr(sorted(resize_hints.items()))
     cols = hashlib.md5(cols.encode()).hexdigest()[:8]
     # 'b1': cache payloads are column blocks (round 3) — never mix with the
     # row-list payloads an older on-disk cache may hold
@@ -107,7 +111,8 @@ class RowGroupDecoderWorker(WorkerBase):
         cache = args['cache']
         if worker_predicate is None and shuffle_row_drop_partition is None:
             key = _cache_key(args['dataset_path'], piece, needed,
-                             getattr(args['transform_spec'], 'image_decode_hints', None))
+                             getattr(args['transform_spec'], 'image_decode_hints', None),
+                             getattr(args['transform_spec'], 'image_resize', None))
             block = cache.get(key, lambda: self._load_block(piece, needed))
         elif worker_predicate is not None:
             block = self._load_block_with_predicate(piece, needed, worker_predicate,
@@ -177,6 +182,7 @@ class RowGroupDecoderWorker(WorkerBase):
         schema = self.args['schema']
         transform = self.args.get('transform_spec')
         decode_hints = getattr(transform, 'image_decode_hints', None) or {}
+        resize_hints = getattr(transform, 'image_resize', None) or {}
         n = table.num_rows
         block = {}
         for name in column_names:
@@ -202,15 +208,17 @@ class RowGroupDecoderWorker(WorkerBase):
             if hasattr(codec, 'decode_column'):
                 if getattr(codec, 'decode_column_accepts_hints', False):
                     decoded = codec.decode_column(field, column,
-                                                  min_size=decode_hints.get(name))
+                                                  min_size=decode_hints.get(name),
+                                                  resize=resize_hints.get(name))
                 else:
                     decoded = codec.decode_column(field, column)
             if decoded is None:
                 cells = column_cells(column)
                 if hasattr(codec, 'decode_batch'):
                     hint = decode_hints.get(name)
-                    values = (codec.decode_batch(field, cells, min_size=hint) if hint
-                              else codec.decode_batch(field, cells))
+                    resize = resize_hints.get(name)
+                    values = (codec.decode_batch(field, cells, min_size=hint, resize=resize)
+                              if (hint or resize) else codec.decode_batch(field, cells))
                 else:
                     values = [None if v is None else codec.decode(field, v) for v in cells]
                 decoded = stack_cells(values)

@@ -36,16 +36,34 @@ class TransformSpec(object):
         accept images of any size >= the hint (or the original size, if
         smaller) — exactly what a resize-to-target transform does. PNG fields
         are unaffected (no scaled decode exists for the format).
+    :param image_resize: ``{field_name: (out_h, out_w)}`` — resize these image
+        fields to EXACTLY that size during decode, before ``func`` runs (which
+        therefore doesn't need its own resize). The whole column decodes +
+        area-resamples in one GIL-released native call straight into a single
+        ``[N, out_h, out_w, C]`` allocation (OpenCV per-image fallback when the
+        native codec is unavailable), removing the per-row Python resize from
+        the host hot loop. Implies the scaled-JPEG-decode hint for the field.
+        The post-transform schema's shape for the field is updated
+        automatically unless ``edit_fields`` overrides it.
     """
 
     def __init__(self, func=None, edit_fields=None, removed_fields=None, selected_fields=None,
-                 batched=False, image_decode_hints=None):
+                 batched=False, image_decode_hints=None, image_resize=None):
         self.func = func
         self.edit_fields = [self._as_field(f) for f in (edit_fields or [])]
         self.removed_fields = list(removed_fields or [])
         self.selected_fields = list(selected_fields) if selected_fields is not None else None
         self.batched = batched
         self.image_decode_hints = dict(image_decode_hints or {})
+        self.image_resize = {}
+        for name, size in (image_resize or {}).items():
+            if len(size) != 2 or int(size[0]) < 1 or int(size[1]) < 1:
+                raise ValueError('image_resize[{!r}] must be a positive (out_h, out_w), '
+                                 'got {!r}'.format(name, size))
+            self.image_resize[name] = (int(size[0]), int(size[1]))
+            # resizing to the target IS the downscale promise scaled JPEG
+            # decode needs; an explicit hint (if any) wins
+            self.image_decode_hints.setdefault(name, self.image_resize[name])
 
     @staticmethod
     def _as_field(field_or_tuple):
@@ -61,6 +79,25 @@ def transform_schema(schema, transform_spec):
     edited = {f.name: f for f in transform_spec.edit_fields}
     fields = {f.name: f for f in schema if f.name not in removed}
     fields.update(edited)
+    for name, (out_h, out_w) in getattr(transform_spec, 'image_resize', {}).items():
+        # validate against the ORIGINAL schema (a resized field may legitimately
+        # be consumed/removed by func): decode-time resize only happens for
+        # codecs that implement it, so anything else must fail loudly here
+        # instead of silently yielding unresized data against a lying schema
+        src = schema.fields.get(name)
+        if src is None:
+            raise ValueError('image_resize refers to unknown field {!r}'.format(name))
+        if not getattr(src.codec, 'supports_image_resize', False):
+            raise ValueError(
+                'image_resize[{!r}]: field is stored with {}, which does not support '
+                'decode-time resize (only image codecs do); resize it in the transform '
+                'func instead'.format(name, type(src.codec).__name__))
+        # decode-time resize pins the leading H, W dims; explicit edits win
+        f = fields.get(name)
+        if f is not None and name not in edited and f.shape is not None and len(f.shape) >= 2:
+            fields[name] = UnischemaField(f.name, f.numpy_dtype,
+                                          (out_h, out_w) + tuple(f.shape[2:]),
+                                          f.codec, f.nullable)
     if transform_spec.selected_fields is not None:
         missing = [n for n in transform_spec.selected_fields if n not in fields]
         if missing:

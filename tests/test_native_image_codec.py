@@ -346,3 +346,165 @@ def test_codec_decode_column_mixed_dims_single_probe_object_column():
     assert out is not None and out.dtype == object
     for got, want in zip(out, imgs):
         np.testing.assert_array_equal(got, want)
+
+
+# -- fused decode+resize (TransformSpec.image_resize) ------------------------
+
+def test_decode_images_resized_matches_cv2_area():
+    rng = np.random.default_rng(17)
+    imgs = [rng.integers(0, 255, (90, 120, 3), dtype=np.uint8) for _ in range(4)]
+    out = image_codec.decode_images_resized([_png(im) for im in imgs], (32, 48))
+    assert out.shape == (4, 32, 48, 3) and out.dtype == np.uint8
+    for got, src in zip(out, imgs):
+        ref = cv2.resize(src, (48, 32), interpolation=cv2.INTER_AREA)
+        assert np.abs(got.astype(int) - ref.astype(int)).max() <= 1
+
+
+def test_decode_images_resized_grayscale_and_identity():
+    rng = np.random.default_rng(18)
+    img = rng.integers(0, 255, (20, 24), dtype=np.uint8)
+    out = image_codec.decode_images_resized([_png(img)], (20, 24))
+    assert out.shape == (1, 20, 24)
+    np.testing.assert_array_equal(out[0], img)  # identity resize = plain decode
+
+
+@pytest.fixture(scope='module')
+def mixed_size_png_dataset(tmp_path_factory):
+    from petastorm_tpu.codecs import CompressedImageCodec, ScalarCodec
+    from petastorm_tpu.etl.dataset_metadata import write_petastorm_dataset
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+
+    path = tmp_path_factory.mktemp('mixed_png_store')
+    url = 'file://' + str(path)
+    schema = Unischema('MixedPng', [
+        UnischemaField('id', np.int64, (), ScalarCodec(), False),
+        UnischemaField('image', np.uint8, (None, None, 3), CompressedImageCodec('png'), False),
+    ])
+    rng = np.random.default_rng(19)
+    data = [{'id': i,
+             'image': rng.integers(0, 255, (40 + 8 * (i % 4), 50 + 4 * (i % 3), 3),
+                                   dtype=np.uint8)}
+            for i in range(24)]
+    write_petastorm_dataset(url, schema, iter(data), rows_per_row_group=8)
+    return url, data
+
+
+def _resize_ref(img, size):
+    return cv2.resize(img, (size[1], size[0]), interpolation=cv2.INTER_AREA)
+
+
+def test_image_resize_end_to_end_row_reader(mixed_size_png_dataset):
+    from petastorm_tpu import TransformSpec, make_reader
+    url, data = mixed_size_png_dataset
+    by_id = {r['id']: r['image'] for r in data}
+    spec = TransformSpec(image_resize={'image': (32, 32)})
+    n = 0
+    with make_reader(url, reader_pool_type='dummy', shuffle_row_groups=False,
+                     transform_spec=spec) as reader:
+        for row in reader:
+            assert row.image.shape == (32, 32, 3)
+            ref = _resize_ref(by_id[row.id], (32, 32))
+            assert np.abs(row.image.astype(int) - ref.astype(int)).max() <= 1
+            n += 1
+    assert n == len(data)
+
+
+def test_image_resize_end_to_end_columnar_uniform_blocks(mixed_size_png_dataset):
+    from petastorm_tpu import TransformSpec, make_reader
+    url, data = mixed_size_png_dataset
+    spec = TransformSpec(image_resize={'image': (28, 36)})
+    ids = []
+    with make_reader(url, reader_pool_type='dummy', output='columnar',
+                     shuffle_row_groups=False, transform_spec=spec) as reader:
+        for block in reader:
+            assert block.image.shape[1:] == (28, 36, 3)  # one uniform block
+            assert block.image.dtype == np.uint8
+            ids.extend(block.id.tolist())
+    assert sorted(ids) == [r['id'] for r in data]
+
+
+def test_image_resize_opencv_fallback_same_contract(mixed_size_png_dataset, monkeypatch):
+    from petastorm_tpu import TransformSpec, make_reader
+    url, data = mixed_size_png_dataset
+    monkeypatch.setattr(image_codec, '_load_failed', True)  # native codec "absent"
+    monkeypatch.setattr(image_codec, '_lib', None)
+    assert not image_codec.is_available()
+    spec = TransformSpec(image_resize={'image': (32, 32)})
+    by_id = {r['id']: r['image'] for r in data}
+    with make_reader(url, reader_pool_type='dummy', shuffle_row_groups=False,
+                     transform_spec=spec) as reader:
+        for row in reader:
+            assert row.image.shape == (32, 32, 3)
+            ref = _resize_ref(by_id[row.id], (32, 32))
+            np.testing.assert_array_equal(row.image, ref)  # same cv2 path = exact
+
+
+def test_image_resize_transform_schema_autoedit():
+    from petastorm_tpu import TransformSpec
+    from petastorm_tpu.codecs import CompressedImageCodec
+    from petastorm_tpu.transform import transform_schema
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+
+    schema = Unischema('S', [
+        UnischemaField('image', np.uint8, (None, None, 3), CompressedImageCodec('png'), False)])
+    out = transform_schema(schema, TransformSpec(image_resize={'image': (64, 48)}))
+    assert out.fields['image'].shape == (64, 48, 3)
+    # explicit edit wins over the auto-derived shape
+    out2 = transform_schema(schema, TransformSpec(
+        image_resize={'image': (64, 48)},
+        edit_fields=[UnischemaField('image', np.uint8, (10, 10, 3), None, False)]))
+    assert out2.fields['image'].shape == (10, 10, 3)
+
+
+def test_image_resize_rejects_bad_target():
+    from petastorm_tpu import TransformSpec
+    with pytest.raises(ValueError):
+        TransformSpec(image_resize={'image': (0, 10)})
+    with pytest.raises(ValueError):
+        TransformSpec(image_resize={'image': (10,)})
+
+
+def test_native_resize_area_image_matches_cv2():
+    rng = np.random.default_rng(20)
+    img = rng.integers(0, 255, (60, 80, 3), dtype=np.uint8)
+    out = image_codec.resize_area_image(img, (30, 40))
+    ref = cv2.resize(img, (40, 30), interpolation=cv2.INTER_AREA)
+    assert np.abs(out.astype(int) - ref.astype(int)).max() <= 1
+
+
+def test_image_resize_rejects_non_image_codec():
+    from petastorm_tpu import TransformSpec
+    from petastorm_tpu.codecs import NdarrayCodec
+    from petastorm_tpu.transform import transform_schema
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+
+    schema = Unischema('S', [
+        UnischemaField('arr', np.uint8, (None, None, 3), NdarrayCodec(), False)])
+    with pytest.raises(ValueError, match='does not support decode-time resize'):
+        transform_schema(schema, TransformSpec(image_resize={'arr': (8, 8)}))
+    with pytest.raises(ValueError, match='unknown field'):
+        transform_schema(schema, TransformSpec(image_resize={'nope': (8, 8)}))
+
+
+def test_decode_hint_overrides_resize_scale():
+    # explicit image_decode_hints wins: jpeg decodes at a scale covering the
+    # hint (2x supersample), not just the resize target
+    blob = _jpeg_bytes(800, 1200, seed=3)
+    small = image_codec.decode_images_resized([blob], (100, 150))
+    big = image_codec.decode_images_resized([blob], (100, 150), min_size=(400, 600))
+    assert small.shape == big.shape == (1, 100, 150, 3)
+    # both valid; a supersampled source reduces aliasing so outputs differ
+    assert not np.array_equal(small, big)
+
+
+def test_cache_key_distinguishes_resize(tmp_path):
+    from petastorm_tpu.row_worker import _cache_key
+
+    class Piece:
+        path = 'p.parquet'
+        row_group = 0
+    k_plain = _cache_key('/d', Piece, ['image'])
+    k_hint = _cache_key('/d', Piece, ['image'], decode_hints={'image': (32, 32)})
+    k_resize = _cache_key('/d', Piece, ['image'], decode_hints={'image': (32, 32)},
+                          resize_hints={'image': (32, 32)})
+    assert len({k_plain, k_hint, k_resize}) == 3
