@@ -482,6 +482,72 @@ class ScalarListCodec(DataFieldCodec):
         return pa.list_(arrow_type_for_numpy(field.numpy_dtype))
 
 
+def _area_weights(in_len, out_len):
+    """``[out_len, in_len]`` row-stochastic pixel-coverage matrix (the area
+    resampling kernel as an explicit matmul — slow-path fallback only)."""
+    scale = in_len / out_len
+    w = np.zeros((out_len, in_len), np.float32)
+    for o in range(out_len):
+        lo, hi = o * scale, min((o + 1) * scale, in_len)
+        s = min(in_len - 1, int(lo))
+        e = min(in_len, max(s + 1, int(np.ceil(hi))))
+        for p in range(s, e):
+            w[o, p] = max(0.0, min(p + 1, hi) - max(p, lo))
+        total = w[o].sum()
+        if total:
+            w[o] /= total
+    return w
+
+
+def _area_resize_numpy(img, out_h, out_w):
+    """Pure-numpy area resample for dtypes the native resampler declines
+    (e.g. uint16) on OpenCV-less hosts. Rare path; clarity over speed."""
+    wy = _area_weights(img.shape[0], out_h)
+    wx = _area_weights(img.shape[1], out_w)
+    arr = img.astype(np.float32)
+    squeeze = arr.ndim == 2
+    if squeeze:
+        arr = arr[..., None]
+    out = np.einsum('yh,hwc,xw->yxc', wy, arr, wx)
+    if img.dtype.kind in 'iu':
+        out = np.clip(np.rint(out), 0, np.iinfo(img.dtype).max)
+    out = out.astype(img.dtype)
+    return out[..., 0] if squeeze else out
+
+
+def _resize_image(img, out_h, out_w, dst=None):
+    """THE ``INTER_AREA`` resize policy, shared by every decode path so they
+    cannot drift: cv2 (SIMD) when available, else the native area resampler
+    (uint8), else the numpy resampler (any dtype). ``dst`` writes the result
+    into a preallocated row of a block."""
+    if img.shape[:2] == (out_h, out_w):
+        if dst is None:
+            return img
+        dst[...] = img
+        return dst
+    try:
+        cv2 = _import_cv2()
+    except ImportError:
+        cv2 = None
+    if cv2 is not None:
+        if dst is not None:
+            cv2.resize(img, (out_w, out_h), dst=dst, interpolation=cv2.INTER_AREA)
+            return dst
+        return cv2.resize(img, (out_w, out_h), interpolation=cv2.INTER_AREA)
+    if img.dtype == np.uint8:
+        from petastorm_tpu.native import image_codec
+        if image_codec.is_available():
+            out = image_codec.resize_area_image(img, (out_h, out_w))
+        else:
+            out = _area_resize_numpy(img, out_h, out_w)
+    else:
+        out = _area_resize_numpy(img, out_h, out_w)
+    if dst is None:
+        return out
+    dst[...] = out
+    return dst
+
+
 @register_codec
 class CompressedImageCodec(DataFieldCodec):
     """png/jpeg image compression (reference codecs.py:53-118).
@@ -585,8 +651,10 @@ class CompressedImageCodec(DataFieldCodec):
 
         out_h, out_w = int(resize[0]), int(resize[1])
         try:
-            cv2 = _import_cv2()
+            _import_cv2()
         except ImportError:
+            # no SIMD resize: the fully-native fused decode+resize is faster
+            # than decode + scalar resample in two steps
             block = image_codec.decode_images_resized(cells, resize, min_size=min_size)
             return None if block is None else block.astype(dtype, copy=False)
         decoded = image_codec.decode_images_auto(cells, min_size=min_size or resize)
@@ -604,10 +672,7 @@ class CompressedImageCodec(DataFieldCodec):
         c = channels.pop()
         out = np.empty((len(imgs), out_h, out_w) + ((c,) if c > 1 else ()), np.uint8)
         for i, img in enumerate(imgs):
-            if img.shape[:2] == (out_h, out_w):
-                out[i] = img
-            else:
-                cv2.resize(img, (out_w, out_h), dst=out[i], interpolation=cv2.INTER_AREA)
+            _resize_image(img, out_h, out_w, dst=out[i])
         return out.astype(dtype, copy=False)
 
     def decode_batch(self, field, encoded_list, min_size=None, resize=None):
@@ -652,18 +717,8 @@ class CompressedImageCodec(DataFieldCodec):
             dtype = np.dtype(field.numpy_dtype)
             decoded = [img.astype(dtype, copy=False) for img in decoded]
         if resize is not None:
-            try:
-                cv2 = _import_cv2()
-                resize_one = lambda img: cv2.resize(  # noqa: E731
-                    img, (int(resize[1]), int(resize[0])), interpolation=cv2.INTER_AREA)
-            except ImportError:
-                # OpenCV-less deployment: if decode got here natively, the
-                # native resampler is present too
-                from petastorm_tpu.native import image_codec as _ic
-                resize_one = lambda img: _ic.resize_area_image(img, resize)  # noqa: E731
             out_h, out_w = int(resize[0]), int(resize[1])
-            decoded = [img if img.shape[:2] == (out_h, out_w) else resize_one(img)
-                       for img in decoded]
+            decoded = [_resize_image(img, out_h, out_w) for img in decoded]
         for (i, _), img in zip(present, decoded):
             out[i] = img
         return out

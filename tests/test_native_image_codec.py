@@ -508,3 +508,44 @@ def test_cache_key_distinguishes_resize(tmp_path):
     k_resize = _cache_key('/d', Piece, ['image'], decode_hints={'image': (32, 32)},
                           resize_hints={'image': (32, 32)})
     assert len({k_plain, k_hint, k_resize}) == 3
+
+
+def test_image_resize_uint16_without_opencv_uses_numpy_fallback(tmp_path, monkeypatch):
+    # 16-bit PNG column + image_resize on an OpenCV-less host: the native fast
+    # path declines (depth != 8) and decode_batch's resize must fall back to
+    # the numpy area resampler instead of crashing
+    import petastorm_tpu.codecs as codecs_mod
+    from petastorm_tpu import TransformSpec, make_reader
+    from petastorm_tpu.codecs import CompressedImageCodec, ScalarCodec
+    from petastorm_tpu.etl.dataset_metadata import write_petastorm_dataset
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+
+    url = 'file://' + str(tmp_path)
+    schema = Unischema('U16', [
+        UnischemaField('id', np.int64, (), ScalarCodec(), False),
+        UnischemaField('image', np.uint16, (None, None, 3), CompressedImageCodec('png'), False),
+    ])
+    rng = np.random.default_rng(21)
+    data = [{'id': i, 'image': rng.integers(0, 65535, (20 + 4 * i, 24, 3), dtype=np.uint16)}
+            for i in range(6)]
+    write_petastorm_dataset(url, schema, iter(data), rows_per_row_group=3)
+
+    def no_cv2():
+        raise ImportError('cv2 disabled for test')
+    monkeypatch.setattr(codecs_mod, '_import_cv2', no_cv2)
+
+    spec = TransformSpec(image_resize={'image': (16, 16)})
+    with make_reader(url, reader_pool_type='dummy', shuffle_row_groups=False,
+                     transform_spec=spec) as reader:
+        rows = list(reader)
+    assert len(rows) == 6
+    assert all(r.image.shape == (16, 16, 3) and r.image.dtype == np.uint16 for r in rows)
+
+
+def test_numpy_area_resize_matches_cv2():
+    from petastorm_tpu.codecs import _area_resize_numpy
+    rng = np.random.default_rng(22)
+    img = rng.integers(0, 255, (50, 70, 3), dtype=np.uint8)
+    out = _area_resize_numpy(img, 25, 35)
+    ref = cv2.resize(img, (35, 25), interpolation=cv2.INTER_AREA)
+    assert np.abs(out.astype(int) - ref.astype(int)).max() <= 1
