@@ -139,9 +139,20 @@ class ArrowBatchWorker(WorkerBase):
             raise ValueError('Predicate fields {} not available in batch columns {}'.format(
                 missing, sorted(batch)))
         n = len(next(iter(batch.values())))
-        mask = np.empty(n, dtype=bool)
-        for i in range(n):
-            mask[i] = predicate.do_include({f: batch[f][i] for f in fields})
+        mask = None
+        if hasattr(predicate, 'do_include_batch'):
+            mask = predicate.do_include_batch({f: batch[f] for f in fields})
+            if mask is not None:
+                mask = np.asarray(mask)
+                if mask.ndim != 1 or len(mask) != n:
+                    raise ValueError(
+                        'do_include_batch must return a 1-D mask with one entry per row; '
+                        'got shape {} for {} rows'.format(mask.shape, n))
+                mask = mask.astype(bool, copy=False)
+        if mask is None:  # vectorized path declined: per-row semantics
+            mask = np.empty(n, dtype=bool)
+            for i in range(n):
+                mask[i] = predicate.do_include({f: batch[f][i] for f in fields})
         if not mask.any():
             return None
         return {k: v[mask] for k, v in batch.items()}

@@ -4,10 +4,13 @@ The reference is a data library with no model side; its long-sequence story
 ends at NGram readout (reference ngram.py, SURVEY.md §5). This module closes
 the framework's long-context loop on the model side: a compact flax
 transformer whose attention is PLUGGABLE — plain softmax attention on one
-device, or the framework's exact blockwise **ring attention**
-(petastorm_tpu.ops.ring_attention) when the sequence axis is sharded over a
-mesh ('context parallelism': each device holds T/n keys, k/v shards rotate on
-the ICI ring via ppermute, attention stays exact).
+device, or either of the framework's context-parallel strategies when the
+sequence axis is sharded over a mesh: exact blockwise **ring attention**
+(petastorm_tpu.ops.ring_attention — each device holds T/n keys, k/v shards
+rotate on the ICI ring via ppermute) or **Ulysses all-to-all**
+(petastorm_tpu.ops.ulysses_attention — one all_to_all redistributes sequence
+shards into head shards, local attention sees the full sequence). Both exact;
+pick with ``context_parallelism='ring'|'ulysses'``.
 
 End-to-end: ``make_reader(output='columnar', ngram=...)`` -> JaxDataLoader ->
 ``stack_ngram_time_axis`` -> [B, T, F] batches staged with
@@ -98,11 +101,19 @@ class SequenceTransformer(nn.Module):
 
 
 def make_sequence_transformer(num_classes, mesh=None, seq_axis='seq', batch_axis='data',
-                              d_model=64, num_heads=4, num_layers=2, dtype=jnp.float32):
-    """Build the model; with ``mesh`` the attention runs as exact ring
-    attention sharded over ``mesh[seq_axis]`` (context parallelism), else plain
-    full attention. The returned module drops into
-    ``models.train.create_train_state`` / ``make_train_step`` unchanged.
+                              d_model=64, num_heads=4, num_layers=2, dtype=jnp.float32,
+                              context_parallelism='ring'):
+    """Build the model; with ``mesh`` the attention runs context-parallel over
+    ``mesh[seq_axis]``, else plain full attention. The returned module drops
+    into ``models.train.create_train_state`` / ``make_train_step`` unchanged.
+
+    ``context_parallelism`` picks the sharded strategy:
+      * ``'ring'`` — blockwise ring attention (O(T/n) memory per device,
+        k/v shards rotate on the ICI ring; scales to extreme T);
+      * ``'ulysses'`` — all-to-all head redistribution (2 collectives total,
+        full-T k/v per device for H/n heads; needs ``num_heads`` divisible by
+        the ``seq_axis`` size).
+    Both compute exact attention — they are interchangeable and tested equal.
 
     SPMD shape constraint (standard shard_map divisibility): every batch fed
     through the mesh-built model — including the ``create_train_state`` sample
@@ -110,9 +121,22 @@ def make_sequence_transformer(num_classes, mesh=None, seq_axis='seq', batch_axis
     by the ``seq_axis`` size."""
     attention_fn = None
     if mesh is not None:
-        from petastorm_tpu.ops.ring_attention import make_sharded_ring_attention
-        attention_fn = make_sharded_ring_attention(mesh, seq_axis=seq_axis,
-                                                   batch_axis=batch_axis)
+        if context_parallelism == 'ring':
+            from petastorm_tpu.ops.ring_attention import make_sharded_ring_attention
+            attention_fn = make_sharded_ring_attention(mesh, seq_axis=seq_axis,
+                                                       batch_axis=batch_axis)
+        elif context_parallelism == 'ulysses':
+            if num_heads % mesh.shape[seq_axis]:
+                raise ValueError(
+                    "context_parallelism='ulysses' needs num_heads ({}) divisible by "
+                    'the {} axis size ({}); use ring'.format(
+                        num_heads, seq_axis, mesh.shape[seq_axis]))
+            from petastorm_tpu.ops.ulysses_attention import make_sharded_ulysses_attention
+            attention_fn = make_sharded_ulysses_attention(mesh, seq_axis=seq_axis,
+                                                          batch_axis=batch_axis)
+        else:
+            raise ValueError("context_parallelism must be 'ring' or 'ulysses', "
+                             'got {!r}'.format(context_parallelism))
     return SequenceTransformer(num_classes=num_classes, d_model=d_model,
                                num_heads=num_heads, num_layers=num_layers,
                                attention_fn=attention_fn, dtype=dtype)

@@ -57,12 +57,14 @@ def reader_throughput(dataset_url, field_regex=None, warmup_cycles=200, measure_
     """Measure read throughput in samples/sec.
 
     :param read_method: 'python' — iterate raw reader rows (reference parity);
-        'jax' — JaxDataLoader + device staging with stall accounting.
+        'columnar' — JaxDataLoader batches on the host block path, no device
+        staging (the per-core host rate the ``cores_needed`` budget formula
+        uses); 'jax' — JaxDataLoader + device staging with stall accounting.
     """
     from petastorm_tpu import make_reader
 
     extra = {}
-    if read_method == 'jax' and make_reader_fn is None:
+    if read_method in ('jax', 'columnar') and make_reader_fn is None:
         # device-feed benchmarks ride the columnar hot path: blocks, not rows
         extra['output'] = 'columnar'
     make_reader_fn = make_reader_fn or make_reader
@@ -83,6 +85,20 @@ def reader_throughput(dataset_url, field_regex=None, warmup_cycles=200, measure_
                 next(it)
             duration = time.perf_counter() - t0
             samples = measure_cycles
+            stall = None
+        elif read_method == 'columnar':
+            from petastorm_tpu.jax import JaxDataLoader
+            loader = JaxDataLoader(reader, batch_size=batch_size)
+            warmup_batches = max(1, warmup_cycles // batch_size)
+            measure_batches = max(1, measure_cycles // batch_size)
+            it = iter(loader)
+            for _ in range(warmup_batches):
+                next(it)
+            t0 = time.perf_counter()
+            for _ in range(measure_batches):
+                next(it)
+            duration = time.perf_counter() - t0
+            samples = measure_batches * batch_size
             stall = None
         elif read_method == 'jax':
             import jax
@@ -167,7 +183,8 @@ def main(argv=None):
     parser.add_argument('-p', '--pool-type', choices=('thread', 'process', 'dummy'),
                         default='thread')
     parser.add_argument('-w', '--workers-count', type=int, default=3)
-    parser.add_argument('-d', '--read-method', choices=('python', 'jax'), default='python')
+    parser.add_argument('-d', '--read-method', choices=('python', 'columnar', 'jax'),
+                        default='python')
     parser.add_argument('--batch-size', type=int, default=64)
     parser.add_argument('--no-shuffle', action='store_true')
     parser.add_argument('--fresh-process', action='store_true',

@@ -698,3 +698,22 @@ def test_raw_tensor_transform_can_mutate_in_place(raw_tensor_dataset):
                      transform_spec=TransformSpec(double)) as reader:
         for row in reader:
             np.testing.assert_array_equal(row.vec, by_id[row.id] * 2.0)
+
+
+def test_columnar_ngram_composes_with_image_resize(synthetic_dataset):
+    # decode-time resize runs before NGram window assembly: every timestep's
+    # image field arrives uniformly resized inside the vectorized window path
+    from petastorm_tpu import TransformSpec
+    from petastorm_tpu.ngram import NGram
+
+    fields = {0: ['id', 'image_png'], 1: ['id', 'image_png']}
+    ngram = NGram(fields=fields, delta_threshold=10, timestamp_field='id')
+    spec = TransformSpec(image_resize={'image_png': (20, 26)})
+    with make_reader(synthetic_dataset.url, reader_pool_type='dummy', output='columnar',
+                     ngram=ngram, transform_spec=spec, shuffle_row_groups=False) as reader:
+        saw = 0
+        for window_block in reader:
+            for offset, fields_block in window_block.items():
+                assert fields_block['image_png'].shape[1:] == (20, 26, 3)
+            saw += 1
+        assert saw > 0

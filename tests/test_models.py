@@ -189,6 +189,32 @@ class TestSequenceTransformer:
         np.testing.assert_allclose(np.asarray(out_plain), np.asarray(out_ring),
                                    rtol=2e-4, atol=2e-5)
 
+    def test_ulysses_attention_model_matches_plain(self):
+        """Same params, Ulysses all-to-all context parallelism == single-device
+        full attention (exact, like ring — the two strategies interchange)."""
+        from petastorm_tpu.models import make_sequence_transformer
+        from petastorm_tpu.parallel import make_mesh
+        x, _ = self._data(b=4, t=8, f=16)
+        mesh = make_mesh(('data', 'seq'), axis_shapes=(-1, 2))
+        plain = make_sequence_transformer(num_classes=6)
+        uly = make_sequence_transformer(num_classes=6, mesh=mesh,
+                                        context_parallelism='ulysses')
+        params = plain.init(jax.random.PRNGKey(1), jnp.asarray(x))['params']
+        out_plain = plain.apply({'params': params}, jnp.asarray(x))
+        with mesh:
+            out_uly = jax.jit(lambda p, xx: uly.apply({'params': p}, xx))(
+                params, jnp.asarray(x))
+        np.testing.assert_allclose(np.asarray(out_plain), np.asarray(out_uly),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_ulysses_indivisible_heads_rejected(self):
+        from petastorm_tpu.models import make_sequence_transformer
+        from petastorm_tpu.parallel import make_mesh
+        mesh = make_mesh(('data', 'seq'), axis_shapes=(-1, 4))
+        with pytest.raises(ValueError, match='divisible'):
+            make_sequence_transformer(num_classes=6, mesh=mesh, num_heads=6,
+                                      context_parallelism='ulysses')
+
     def test_sharded_train_step_from_columnar_ngram(self, tmp_path):
         """The full long-context stack: columnar NGram reader -> time-major
         stacks -> ('data','seq') sharded batches -> ring-attention transformer

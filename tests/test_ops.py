@@ -156,3 +156,70 @@ def test_ring_attention_with_data_and_seq_axes(rng):
     out = np.asarray(attn(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)))
     np.testing.assert_allclose(out, _reference_attention(q, k, v, True),
                                rtol=2e-4, atol=2e-4)
+
+
+# -- Ulysses all-to-all sequence parallelism ---------------------------------
+
+@pytest.mark.parametrize('causal', [False, True])
+@pytest.mark.parametrize('shards', [2, 4])
+def test_ulysses_attention_matches_full_attention(causal, shards, rng):
+    from jax.sharding import Mesh
+    from petastorm_tpu.ops.ulysses_attention import make_ulysses_attention
+
+    b, h, t, d = 2, 4, 32, 8  # h divisible by both shard counts
+    q = rng.standard_normal((b, h, t, d), dtype=np.float32)
+    k = rng.standard_normal((b, h, t, d), dtype=np.float32)
+    v = rng.standard_normal((b, h, t, d), dtype=np.float32)
+
+    mesh = Mesh(np.array(jax.devices()[:shards]), ('seq',))
+    attn = make_ulysses_attention(mesh, seq_axis='seq', causal=causal)
+    out = np.asarray(attn(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)))
+    np.testing.assert_allclose(out, _reference_attention(q, k, v, causal),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_ulysses_matches_ring_attention(rng):
+    # the two context-parallel strategies are interchangeable: same math,
+    # different data movement
+    from jax.sharding import Mesh
+    from petastorm_tpu.ops.ring_attention import make_ring_attention
+    from petastorm_tpu.ops.ulysses_attention import make_ulysses_attention
+
+    b, h, t, d = 2, 8, 64, 4
+    q = rng.standard_normal((b, h, t, d), dtype=np.float32)
+    k = rng.standard_normal((b, h, t, d), dtype=np.float32)
+    v = rng.standard_normal((b, h, t, d), dtype=np.float32)
+    mesh = Mesh(np.array(jax.devices()[:8]), ('seq',))
+    ring = make_ring_attention(mesh, causal=True)
+    uly = make_ulysses_attention(mesh, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(uly(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))),
+        np.asarray(ring(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))),
+        rtol=2e-4, atol=2e-4)
+
+
+def test_ulysses_attention_with_data_axis_and_chunking(rng):
+    from jax.sharding import Mesh
+    from petastorm_tpu.ops.ulysses_attention import make_ulysses_attention
+
+    b, h, t, d = 4, 4, 32, 4
+    q = rng.standard_normal((b, h, t, d), dtype=np.float32)
+    k = rng.standard_normal((b, h, t, d), dtype=np.float32)
+    v = rng.standard_normal((b, h, t, d), dtype=np.float32)
+    mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(2, 4), ('data', 'seq'))
+    attn = make_ulysses_attention(mesh, seq_axis='seq', batch_axis='data',
+                                  causal=True, kv_chunk=4)
+    out = np.asarray(attn(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)))
+    np.testing.assert_allclose(out, _reference_attention(q, k, v, True),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_ulysses_rejects_indivisible_heads(rng):
+    from jax.sharding import Mesh
+    from petastorm_tpu.ops.ulysses_attention import make_ulysses_attention
+
+    mesh = Mesh(np.array(jax.devices()[:4]), ('seq',))
+    attn = make_ulysses_attention(mesh)
+    x = jnp.zeros((1, 3, 16, 4))  # 3 heads, 4-way seq axis
+    with pytest.raises(ValueError, match='divisible'):
+        attn(x, x, x)

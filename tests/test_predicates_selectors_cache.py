@@ -245,7 +245,10 @@ def test_in_set_mixed_type_values_keep_row_semantics():
     assert pred.do_include({'x': 1}) is True
 
 
+@pytest.mark.filterwarnings('ignore::pytest.PytestUnhandledThreadExceptionWarning')
 def test_do_include_batch_scalar_return_fails_loudly(synthetic_dataset):
+    # the DummyPool ventilator thread re-raises after forwarding the error to
+    # the consumer; that secondary raise is expected noise here
     from petastorm_tpu import make_reader
 
     class BadPredicate(in_set):
@@ -257,3 +260,29 @@ def test_do_include_batch_scalar_return_fails_loudly(synthetic_dataset):
                          predicate=BadPredicate([1], 'id'), shuffle_row_groups=False,
                          schema_fields=['id']) as reader:
             next(iter(reader))
+
+
+def test_batch_reader_pushdown_uses_batch_path(scalar_dataset):
+    from petastorm_tpu import make_batch_reader
+
+    class CountingInSet(in_set):
+        calls = {'batch': 0, 'row': 0}
+
+        def do_include_batch(self, block):
+            CountingInSet.calls['batch'] += 1
+            return super().do_include_batch(block)
+
+        def do_include(self, values):
+            CountingInSet.calls['row'] += 1
+            return super().do_include(values)
+
+    keep = {r['id'] for r in scalar_dataset.data if r['id'] % 2 == 0}
+    pred = CountingInSet(sorted(keep), 'id')
+    got = set()
+    with make_batch_reader(scalar_dataset.url, reader_pool_type='dummy',
+                           predicate=pred, shuffle_row_groups=False) as reader:
+        for batch in reader:
+            got.update(np.asarray(batch.id).tolist())
+    assert got == keep
+    assert CountingInSet.calls['batch'] > 0
+    assert CountingInSet.calls['row'] == 0

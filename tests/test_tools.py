@@ -95,6 +95,15 @@ class TestThroughput:
         assert result.samples_per_second > 0
         assert 0.0 <= result.input_stall_fraction <= 1.0
 
+    def test_columnar_read_method(self, synthetic_dataset):
+        result = reader_throughput(synthetic_dataset.url, field_regex=['id', 'matrix'],
+                                   warmup_cycles=16, measure_cycles=64,
+                                   pool_type='dummy', workers_count=1,
+                                   read_method='columnar', batch_size=16)
+        assert result.samples_per_second > 0
+        assert result.samples == 64
+        assert result.input_stall_fraction is None  # host-only: no staging to stall on
+
     def test_cli(self, synthetic_dataset, capsys):
         assert throughput_main([synthetic_dataset.url, '-f', 'id', '-m', '5', '-n', '20',
                                 '-p', 'dummy', '-w', '1']) == 0
