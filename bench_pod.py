@@ -82,6 +82,8 @@ def main(argv=None):
     parser.add_argument('--feature-dim', type=int, default=64)
     parser.add_argument('--rows', type=int, default=4096)
     parser.add_argument('--workers', type=int, default=2)
+    parser.add_argument('--context', choices=('ring', 'ulysses'), default='ring',
+                        help='context-parallel attention strategy over the seq axis')
     args = parser.parse_args(argv)
 
     _ensure_devices(args.devices)
@@ -130,7 +132,8 @@ def main(argv=None):
 
     num_classes = 16
     model = make_sequence_transformer(num_classes=num_classes, mesh=mesh,
-                                      d_model=64, num_layers=2)
+                                      d_model=64, num_layers=2,
+                                      context_parallelism=args.context)
     state = create_train_state(
         model, jax.random.PRNGKey(0),
         jnp.zeros((args.batch_size, args.seq_len, args.feature_dim)))

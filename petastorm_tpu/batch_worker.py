@@ -20,6 +20,7 @@ import pyarrow as pa
 from petastorm_tpu.columnar import BlockResultsReaderBase
 from petastorm_tpu.row_worker import _cache_key, select_row_drop_indices
 from petastorm_tpu.native import open_parquet
+from petastorm_tpu.predicates import evaluate_predicate_mask
 from petastorm_tpu.workers.worker_base import WorkerBase
 
 
@@ -139,16 +140,7 @@ class ArrowBatchWorker(WorkerBase):
             raise ValueError('Predicate fields {} not available in batch columns {}'.format(
                 missing, sorted(batch)))
         n = len(next(iter(batch.values())))
-        mask = None
-        if hasattr(predicate, 'do_include_batch'):
-            mask = predicate.do_include_batch({f: batch[f] for f in fields})
-            if mask is not None:
-                mask = np.asarray(mask)
-                if mask.ndim != 1 or len(mask) != n:
-                    raise ValueError(
-                        'do_include_batch must return a 1-D mask with one entry per row; '
-                        'got shape {} for {} rows'.format(mask.shape, n))
-                mask = mask.astype(bool, copy=False)
+        mask = evaluate_predicate_mask(predicate, {f: batch[f] for f in fields}, n)
         if mask is None:  # vectorized path declined: per-row semantics
             mask = np.empty(n, dtype=bool)
             for i in range(n):

@@ -110,7 +110,7 @@ def make_sequence_transformer(num_classes, mesh=None, seq_axis='seq', batch_axis
     ``context_parallelism`` picks the sharded strategy:
       * ``'ring'`` — blockwise ring attention (O(T/n) memory per device,
         k/v shards rotate on the ICI ring; scales to extreme T);
-      * ``'ulysses'`` — all-to-all head redistribution (2 collectives total,
+      * ``'ulysses'`` — all-to-all head redistribution (two all-to-all phases,
         full-T k/v per device for H/n heads; needs ``num_heads`` divisible by
         the ``seq_axis`` size).
     Both compute exact attention — they are interchangeable and tested equal.

@@ -14,8 +14,10 @@ Trade-offs (why both exist):
   * Ulysses needs ``num_heads % ring_size == 0`` and holds full-length K/V for
     its head subset — O(T) memory per device, so it suits moderate T with many
     heads; ring attention holds O(T/n) and scales to extreme T.
-  * Ulysses does 2 collectives total (cheap on small meshes / fat ICI); ring
-    does n-1 rotations but overlaps them with block compute.
+  * Ulysses communicates in 2 all-to-all phases (4 ``all_to_all`` ops: q, k, v
+    forward + the output back — XLA is free to fuse/overlap the forward
+    three); ring does n-1 ppermute rotations but overlaps them with block
+    compute.
 
 The local per-head attention reuses the same online-softmax block update as
 ring attention (one implementation of the math), scanning k/v chunks so the
@@ -100,7 +102,11 @@ def ulysses_attention(q, k, v, axis_name, causal=False, kv_chunk=None):
                                      split_axis=1, concat_axis=2, tiled=True)
     q_full, k_full, v_full = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
 
-    chunk = kv_chunk or t_local
+    t = t_local * n
+    chunk = t_local if kv_chunk is None else int(kv_chunk)
+    if chunk < 1 or t % chunk:
+        raise ValueError('kv_chunk ({}) must be a positive divisor of the full sequence '
+                         'length ({})'.format(kv_chunk, t))
     out = _chunked_full_attention(q_full, k_full, v_full, causal, chunk)
 
     # inverse redistribution: split the sequence axis, concatenate heads back

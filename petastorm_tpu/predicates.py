@@ -36,6 +36,24 @@ class PredicateBase(object):
         return None
 
 
+def evaluate_predicate_mask(predicate, block, num_rows):
+    """THE contract enforcement for :meth:`PredicateBase.do_include_batch`,
+    shared by both workers' pushdown paths: returns a validated boolean mask,
+    or ``None`` when the predicate has no batch path / declined (callers fall
+    back to per-row ``do_include``)."""
+    if not hasattr(predicate, 'do_include_batch'):
+        return None
+    mask = predicate.do_include_batch(block)
+    if mask is None:
+        return None
+    mask = np.asarray(mask)
+    if mask.ndim != 1 or len(mask) != num_rows:
+        raise ValueError(
+            'do_include_batch must return a 1-D mask with one entry per row; '
+            'got shape {} for {} rows'.format(mask.shape, num_rows))
+    return mask.astype(bool, copy=False)
+
+
 class in_set(PredicateBase):
     """Keep rows whose scalar field value is in ``inclusion_values``."""
 

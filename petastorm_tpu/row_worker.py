@@ -27,6 +27,7 @@ import numpy as np
 from petastorm_tpu.columnar import (BlockResultsReaderBase, block_num_rows, block_to_rows,
                                     column_cells, rows_to_block, stack_cells, take_block)
 from petastorm_tpu.native import open_parquet
+from petastorm_tpu.predicates import evaluate_predicate_mask
 from petastorm_tpu.workers.worker_base import WorkerBase
 
 
@@ -260,16 +261,8 @@ class RowGroupDecoderWorker(WorkerBase):
         pred_table, _ = self._read_table(piece, predicate_fields, drop_indices
                                          if shuffle_row_drop_partition else None)
         pred_block = self._decode_table(pred_table, predicate_fields, piece)
-        mask = None
-        if hasattr(predicate, 'do_include_batch'):
-            mask = predicate.do_include_batch(dict(pred_block))
-            if mask is not None:
-                mask = np.asarray(mask)
-                if mask.ndim != 1 or len(mask) != block_num_rows(pred_block):
-                    raise ValueError(
-                        'do_include_batch must return a 1-D mask with one entry per row; '
-                        'got shape {} for {} rows'.format(mask.shape,
-                                                          block_num_rows(pred_block)))
+        mask = evaluate_predicate_mask(predicate, dict(pred_block),
+                                       block_num_rows(pred_block))
         if mask is None:  # vectorized path declined: per-row semantics
             pred_rows = block_to_rows(pred_block, predicate_fields)
             mask = [predicate.do_include(r) for r in pred_rows]
