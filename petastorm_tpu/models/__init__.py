@@ -12,3 +12,4 @@ from petastorm_tpu.models.resnet import ResNet, resnet18, resnet50  # noqa: F401
 from petastorm_tpu.models.mnist import MnistCNN  # noqa: F401
 from petastorm_tpu.models.transformer import (SequenceTransformer,  # noqa: F401
                                               make_sequence_transformer)
+from petastorm_tpu.models.moe import MoEMlp, MoESequenceTransformer  # noqa: F401

@@ -1,0 +1,168 @@
+"""Mixture-of-experts layer with expert parallelism (ep).
+
+The reference has no model side at all (SURVEY.md §2.9); this exists so the
+framework's parallelism story covers ep alongside dp/tp/sp: a GShard/Switch
+style dense-dispatch MoE whose expert tensors are sharded over a mesh axis,
+letting XLA partition the per-expert FFNs across devices and insert the
+dispatch/combine collectives itself — the TPU-idiomatic formulation (einsum
+dispatch masks + sharding constraints, no hand-rolled routing runtime).
+
+Math (top-1 "switch" routing, public recipe — GShard arXiv:2006.16668,
+Switch Transformer arXiv:2101.03961):
+
+  * gate: softmax(Dense_E(token)); expert = argmax, gate_p = its probability
+  * capacity C = ceil(tokens/E * capacity_factor); within each expert, tokens
+    beyond C are DROPPED (their output is 0 — the caller's residual connection
+    passes them through, the standard behavior)
+  * dispatch [N, E, C] one-hot scatters tokens to expert slots; combine =
+    dispatch * gate_p gathers expert outputs back
+  * aux load-balancing loss = E * sum_e(fraction_tokens_e * mean_prob_e)
+    (Switch eq. 4) — add ``aux_weight * aux_loss`` to the training objective
+    to keep routing balanced.
+
+With ``mesh``, the [E, C, D] expert tensors and [E, ...] expert weights carry
+``P(expert_axis)`` sharding constraints: each device holds E/n experts and XLA
+turns the dispatch/combine einsums into all-to-alls over ICI.
+"""
+
+from __future__ import annotations
+
+import math
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+def expert_capacity(num_tokens, num_experts, capacity_factor):
+    """C = ceil(tokens/experts * capacity_factor), clamped to [1, tokens]
+    (the documented Switch formula — ceil AFTER the slack multiply, so
+    fractional slack is not truncated away)."""
+    capacity = int(math.ceil(num_tokens / num_experts * capacity_factor))
+    return max(1, min(num_tokens, capacity))
+
+
+class MoEMlp(nn.Module):
+    """Drop-in MLP replacement: [B, T, D] -> ([B, T, D], aux_loss).
+
+    :param num_experts: E; with ``mesh``, must be divisible by the
+        ``expert_axis`` size.
+    :param d_hidden: per-expert FFN hidden width.
+    :param capacity_factor: slack over the perfectly-balanced per-expert load.
+    :param mesh: optional ``jax.sharding.Mesh`` for expert parallelism.
+    :param expert_axis: mesh axis name the experts shard over.
+    """
+
+    num_experts: int
+    d_hidden: int
+    capacity_factor: float = 1.25
+    mesh: object = None
+    expert_axis: str = 'expert'
+    dtype: jnp.dtype = jnp.float32
+
+    def _constrain(self, t, spec):
+        if self.mesh is None:
+            return t
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        return jax.lax.with_sharding_constraint(
+            t, NamedSharding(self.mesh, P(*spec)))
+
+    @nn.compact
+    def __call__(self, x):  # x: [B, T, D]
+        if self.mesh is not None and self.num_experts % self.mesh.shape[self.expert_axis]:
+            raise ValueError('num_experts ({}) must be divisible by the {!r} axis size '
+                             '({})'.format(self.num_experts, self.expert_axis,
+                                           self.mesh.shape[self.expert_axis]))
+        b, t, d = x.shape
+        n = b * t
+        e = self.num_experts
+        capacity = expert_capacity(n, e, self.capacity_factor)
+
+        tokens = x.reshape(n, d).astype(jnp.float32)
+        gate_logits = nn.Dense(e, dtype=jnp.float32, name='gate')(tokens)  # [N, E]
+        probs = jax.nn.softmax(gate_logits, axis=-1)
+        expert_idx = jnp.argmax(probs, axis=-1)                            # [N]
+        gate_p = jnp.take_along_axis(probs, expert_idx[:, None], axis=1)[:, 0]
+
+        onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)          # [N, E]
+        # slot position of each token within its expert (0-based), FIFO order
+        position = jnp.cumsum(onehot, axis=0) * onehot - onehot            # [N, E]
+        keep = onehot * (position < capacity)                              # [N, E]
+        dispatch = keep[..., None] * jax.nn.one_hot(                       # [N, E, C]
+            position.astype(jnp.int32), capacity, dtype=jnp.float32)
+        combine = dispatch * gate_p[:, None, None]
+
+        # Switch load-balancing aux loss: E * sum_e f_e * P_e
+        frac_tokens = onehot.mean(axis=0)
+        mean_probs = probs.mean(axis=0)
+        aux_loss = e * jnp.sum(frac_tokens * mean_probs)
+
+        # expert weights [E, ...] and expert tensors [E, C, ...] shard over
+        # the expert axis; XLA inserts the dispatch/combine collectives
+        w1 = self.param('w1', nn.initializers.lecun_normal(), (e, d, self.d_hidden))
+        b1 = self.param('b1', nn.initializers.zeros, (e, self.d_hidden))
+        w2 = self.param('w2', nn.initializers.lecun_normal(), (e, self.d_hidden, d))
+        b2 = self.param('b2', nn.initializers.zeros, (e, d))
+        espec = (self.expert_axis,)
+        w1, b1 = self._constrain(w1, espec + (None, None)), self._constrain(b1, espec + (None,))
+        w2, b2 = self._constrain(w2, espec + (None, None)), self._constrain(b2, espec + (None,))
+
+        # routing/dispatch stays fp32 (standard — argmax/softmax robustness);
+        # the expert FFN einsums, the bulk of the FLOPs, run in self.dtype
+        xin = jnp.einsum('nec,nd->ecd', dispatch, tokens)
+        xin = self._constrain(xin, espec + (None, None)).astype(self.dtype)
+        h = jnp.einsum('ecd,edh->ech', xin, w1.astype(self.dtype)) \
+            + b1[:, None, :].astype(self.dtype)
+        h = nn.gelu(h)
+        h = self._constrain(h, espec + (None, None))
+        out = jnp.einsum('ech,ehd->ecd', h, w2.astype(self.dtype)) \
+            + b2[:, None, :].astype(self.dtype)
+        out = self._constrain(out, espec + (None, None))
+
+        y = jnp.einsum('nec,ecd->nd', combine, out.astype(jnp.float32))
+        return y.reshape(b, t, d).astype(x.dtype), aux_loss
+
+
+class MoESequenceTransformer(nn.Module):
+    """The sequence transformer with MoE MLPs — the ep measurement load:
+    [B, T, F] NGram window stacks -> [B, num_classes], plus the summed
+    load-balancing aux loss (add ``aux_weight`` of it to the objective)."""
+
+    num_classes: int
+    num_experts: int
+    d_model: int = 64
+    num_heads: int = 4
+    num_layers: int = 2
+    capacity_factor: float = 1.25
+    mesh: object = None
+    expert_axis: str = 'expert'
+    attention_fn: object = None
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train=True):  # noqa: ARG002 - train-step parity
+        from petastorm_tpu.models.transformer import SelfAttention
+
+        x = x.astype(self.dtype)
+        x = nn.Dense(self.d_model, dtype=self.dtype, name='embed')(x)
+        pos = self.param('pos_embed', nn.initializers.normal(0.02),
+                         (1, x.shape[1], self.d_model))
+        x = x + pos.astype(self.dtype)
+        aux_total = 0.0
+        for i in range(self.num_layers):
+            # the attention path is the SHARED SelfAttention sub-block — the
+            # dense TransformerBlock uses the identical module, so masking/
+            # dtype/validation fixes land in both model families at once
+            x = SelfAttention(self.d_model, self.num_heads, self.attention_fn,
+                              self.dtype, name='attn{}'.format(i))(x)
+            h = nn.LayerNorm(dtype=self.dtype)(x)
+            moe_out, aux = MoEMlp(num_experts=self.num_experts,
+                                  d_hidden=4 * self.d_model,
+                                  capacity_factor=self.capacity_factor,
+                                  mesh=self.mesh, expert_axis=self.expert_axis,
+                                  dtype=self.dtype, name='moe{}'.format(i))(h)
+            x = x + moe_out  # dropped tokens ride the residual (standard)
+            aux_total = aux_total + aux
+        x = nn.LayerNorm(dtype=self.dtype)(x)
+        x = jnp.mean(x, axis=1)
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name='head')(x), aux_total

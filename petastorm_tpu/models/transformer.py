@@ -32,13 +32,14 @@ def plain_attention(q, k, v):
     return jnp.einsum('bhqk,bhkd->bhqd', jax.nn.softmax(logits, axis=-1), v)
 
 
-class TransformerBlock(nn.Module):
-    """Pre-norm block: attention + MLP with residuals. ``attention_fn`` is any
-    ``(q, k, v) -> out`` on [B, H, T, D] — plain or ring."""
+class SelfAttention(nn.Module):
+    """THE attention sub-block (pre-norm qkv -> heads -> ``attention_fn`` ->
+    output projection), shared by the dense and MoE transformer blocks so the
+    attention path cannot drift between them. Residual is applied here:
+    returns ``x + attn_out``."""
 
     d_model: int
     num_heads: int
-    mlp_ratio: int = 4
     attention_fn: callable = None
     dtype: jnp.dtype = jnp.float32
 
@@ -59,7 +60,23 @@ class TransformerBlock(nn.Module):
 
         out = attn_fn(heads(q), heads(k), heads(v))
         out = out.transpose(0, 2, 1, 3).reshape(x.shape[0], x.shape[1], self.d_model)
-        x = x + nn.Dense(self.d_model, dtype=self.dtype, name='attn_out')(out)
+        return x + nn.Dense(self.d_model, dtype=self.dtype, name='attn_out')(out)
+
+
+class TransformerBlock(nn.Module):
+    """Pre-norm block: attention + MLP with residuals. ``attention_fn`` is any
+    ``(q, k, v) -> out`` on [B, H, T, D] — plain, ring, or ulysses."""
+
+    d_model: int
+    num_heads: int
+    mlp_ratio: int = 4
+    attention_fn: callable = None
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):  # x: [B, T, d_model]
+        x = SelfAttention(self.d_model, self.num_heads, self.attention_fn,
+                          self.dtype, name='attn')(x)
         h = nn.LayerNorm(dtype=self.dtype)(x)
         h = nn.Dense(self.mlp_ratio * self.d_model, dtype=self.dtype, name='mlp_up')(h)
         h = nn.gelu(h)
