@@ -4,3 +4,6 @@ from petastorm_tpu.parallel.mesh import (  # noqa: F401
     make_mesh, data_sharding, reader_shard_for_process, make_global_batch,
     process_local_batch_size,
 )
+from petastorm_tpu.parallel.pipeline import (  # noqa: F401
+    make_pipelined_apply, pipeline_spmd,
+)
