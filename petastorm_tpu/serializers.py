@@ -46,9 +46,15 @@ class NumpyBlockSerializer(object):
     _BLOCK = b'N'
     _PICKLE = b'P'
 
-    def serialize(self, obj):
+    @staticmethod
+    def _split_block(obj):
+        """THE block-eligibility classification + header framing, shared by
+        :meth:`serialize` and :meth:`serialize_into` (the two channels must
+        stay byte-identical for :meth:`deserialize`): returns
+        ``(raw_arrays, header_bytes)`` or ``None`` when the payload must ride
+        plain pickle."""
         if not isinstance(obj, dict) or not obj:
-            return self._PICKLE + pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+            return None
         raw = {}
         others = {}
         for k, v in obj.items():
@@ -61,12 +67,22 @@ class NumpyBlockSerializer(object):
             header = pickle.dumps(
                 ([(k, v.dtype.str, v.shape) for k, v in raw.items()], others),
                 protocol=pickle.HIGHEST_PROTOCOL)
-        except Exception:  # noqa: BLE001 - unpicklable extras: let pickle raise uniformly
-            return self._PICKLE + pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
-        parts = [self._BLOCK, struct.pack('<I', len(header)), header]
+        except Exception:  # noqa: BLE001 - unpicklable extras: plain pickle
+            return None
+        return raw, header
+
+    @staticmethod
+    def _array_bytes(v):
         # datetime/timedelta arrays refuse buffer export (PEP 3118); tobytes
-        parts.extend(v.tobytes() if v.dtype.kind in 'Mm' else memoryview(v).cast('B')
-                     for v in raw.values())
+        return v.tobytes() if v.dtype.kind in 'Mm' else memoryview(v).cast('B')
+
+    def serialize(self, obj):
+        split = self._split_block(obj)
+        if split is None:
+            return self._PICKLE + pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+        raw, header = split
+        parts = [self._BLOCK, struct.pack('<I', len(header)), header]
+        parts.extend(self._array_bytes(v) for v in raw.values())
         return b''.join(parts)
 
     def deserialize(self, data):
@@ -85,6 +101,34 @@ class NumpyBlockSerializer(object):
             out[name] = np.frombuffer(mv[off:off + n], dtype=dt).reshape(shape)
             off += n
         return out
+
+    def serialize_into(self, obj, alloc, min_size=0):
+        """Single-copy serialize: compute the exact framed-message size, obtain
+        a writable buffer from ``alloc(size)`` (e.g. an mmapped /dev/shm file),
+        and write the message straight into it — no intermediate ``b''.join``
+        allocation. Returns the buffer, or ``None`` when ``obj`` does not
+        qualify (non-block payload, object columns only, or total < ``min_size``
+        — callers then use the regular :meth:`serialize` channel). The written
+        bytes :meth:`deserialize` identically to :meth:`serialize` output."""
+        split = self._split_block(obj)
+        if split is None:
+            return None
+        raw, header = split
+        if not raw:
+            return None
+        total = 5 + len(header) + sum(v.nbytes for v in raw.values())
+        if total < min_size:
+            return None
+        buf = memoryview(alloc(total))
+        buf[0:1] = self._BLOCK
+        struct.pack_into('<I', buf, 1, len(header))
+        buf[5:5 + len(header)] = header
+        off = 5 + len(header)
+        for v in raw.values():
+            n = v.nbytes
+            buf[off:off + n] = self._array_bytes(v)
+            off += n
+        return buf
 
 
 class ArrowTableSerializer(object):

@@ -41,11 +41,37 @@ from petastorm_tpu.workers.worker_base import EmptyResultError, TimeoutWaitingFo
 logger = logging.getLogger(__name__)
 
 _CONTROL_FINISHED = b'FINISHED'
-_STARTED, _DATA, _DONE, _ERROR = b'S', b'D', b'F', b'E'
+_STARTED, _DATA, _DONE, _ERROR, _BLOB = b'S', b'D', b'F', b'E', b'B'
 
 _WORKER_STARTUP_TIMEOUT_S = 30
 _DEFAULT_RESULTS_HWM = 50
 _DEFAULT_RING_BYTES = 64 << 20
+#: payloads at least this large ride the per-message /dev/shm blob sidechannel
+#: (when the serializer supports single-copy serialize_into): the worker writes
+#: the message straight into an mmapped tmpfs file and only the file name
+#: crosses the ring/zmq transport — 1 data copy end-to-end instead of 3
+#: (serialize join + ring in + ring out). Small payloads keep the low-latency
+#: in-band path.
+_DEFAULT_BLOB_THRESHOLD = 1 << 20
+#: per-POOL bound on UNCONSUMED blob bytes (workers share the run's blob dir,
+#: and blobs are unlinked on read, so the dir size is the live backlog) — the
+#: byte-backpressure analog of the ring's capacity: workers whose consumer
+#: lags block instead of parking unbounded row groups in tmpfs. A single
+#: over-budget blob is still allowed through (mirroring the ring's
+#: one-payload-must-fit invariant).
+_BLOB_BUDGET_BYTES = 256 << 20
+
+
+def _read_blob(path):
+    """Map a blob file copy-on-write and unlink it: the returned memoryview's
+    consumers (numpy views) keep the mapping — and thus the pages — alive; the
+    name disappears immediately, so nothing leaks even if deserialization
+    fails. ACCESS_COPY makes the views writable without copying upfront."""
+    import mmap
+    with open(path, 'rb') as f:
+        mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_COPY)
+    os.unlink(path)
+    return memoryview(mm)
 
 
 def _ring_header(kind, seq):
@@ -63,13 +89,17 @@ def _ring_unpack(view):
 
 class ProcessPool(object):
     def __init__(self, workers_count, results_queue_size=_DEFAULT_RESULTS_HWM, serializer=None,
-                 results_timeout_s=None, transport=None, ring_bytes=_DEFAULT_RING_BYTES):
+                 results_timeout_s=None, transport=None, ring_bytes=_DEFAULT_RING_BYTES,
+                 blob_threshold_bytes=_DEFAULT_BLOB_THRESHOLD):
         """``results_timeout_s``: raise if no worker message arrives within this
         many seconds (None = block indefinitely, matching ThreadPool).
         ``transport``: 'shm' (first-party C++ shared-memory rings) | 'zmq' |
         None = shm when the native library is available, else zmq.
         ``ring_bytes``: per-worker ring capacity for the shm transport; one
-        serialized row-group payload must fit."""
+        serialized row-group payload must fit.
+        ``blob_threshold_bytes``: payloads >= this ride the single-copy
+        /dev/shm blob sidechannel when the serializer supports
+        ``serialize_into`` (0 disables)."""
         self._workers_count = workers_count
         self._results_hwm = results_queue_size
         self._serializer = serializer or PickleSerializer()
@@ -81,6 +111,8 @@ class ProcessPool(object):
             raise ValueError("transport must be 'shm', 'zmq' or None, got {!r}".format(transport))
         self._transport = transport
         self._ring_bytes = ring_bytes
+        self._blob_threshold = blob_threshold_bytes
+        self._blob_dir = None
         self._rings = []
         self._context = None
         self._processes = []
@@ -164,6 +196,15 @@ class ProcessPool(object):
             self._results_receive.setsockopt(zmq.RCVHWM, self._results_hwm)
             self._results_receive.bind(result_addr)
 
+        # per-run /dev/shm blob dir for the large-payload sidechannel: only when
+        # the serializer can single-copy serialize into an mmapped file
+        if (self._blob_threshold and hasattr(self._serializer, 'serialize_into')
+                and os.path.isdir('/dev/shm')):
+            try:
+                self._blob_dir = tempfile.mkdtemp(prefix='pstpu_blobs_', dir='/dev/shm')
+            except OSError:
+                self._blob_dir = None
+
         # spawn (NOT fork): forked children inherit locked mutexes/threads from
         # Arrow, JAX, etc. (reference process_pool.py:15-17 for the JVM analog)
         ctx = multiprocessing.get_context('spawn')
@@ -173,7 +214,8 @@ class ProcessPool(object):
             p = ctx.Process(
                 target=_worker_bootstrap,
                 args=(worker_id, os.getpid(), setup_blob, vent_addr, result_addr, control_addr,
-                      self._results_hwm, ring_names[worker_id]),
+                      self._results_hwm, ring_names[worker_id],
+                      self._blob_dir, self._blob_threshold),
                 daemon=True)
             p.start()
             self._processes.append(p)
@@ -239,6 +281,9 @@ class ProcessPool(object):
             if kind == _DATA:
                 self.last_result_seq = seq
                 return self._serializer.deserialize(payload)
+            elif kind == _BLOB:
+                self.last_result_seq = seq
+                return self._serializer.deserialize(_read_blob(bytes(payload).decode()))
             elif kind == _DONE:
                 self._completed_items += 1
                 if self._ventilator is not None:
@@ -297,6 +342,11 @@ class ProcessPool(object):
         self._context.term()
         if self._ipc_dir:
             shutil.rmtree(self._ipc_dir, ignore_errors=True)
+        if self._blob_dir:
+            # sweep unconsumed blobs (already-consumed ones were unlinked on
+            # read; live mappings keep their pages regardless)
+            shutil.rmtree(self._blob_dir, ignore_errors=True)
+            self._blob_dir = None
 
     @property
     def diagnostics(self):
@@ -314,9 +364,10 @@ class ProcessPool(object):
 # ---------------------------------------------------------------------------
 
 def _worker_bootstrap(worker_id, main_pid, setup_blob, vent_addr, result_addr, control_addr,
-                      results_hwm, ring_name=None):
+                      results_hwm, ring_name=None, blob_dir=None, blob_threshold=0):
     """Entry point of a spawned worker process. ``ring_name`` selects the shm
-    results transport; None = zmq PUSH."""
+    results transport; None = zmq PUSH. ``blob_dir`` enables the large-payload
+    /dev/shm sidechannel."""
     worker_class, worker_setup_args, serializer = pickle.loads(setup_blob)
 
     _start_orphan_monitor(main_pid)
@@ -357,7 +408,74 @@ def _worker_bootstrap(worker_id, main_pid, setup_blob, vent_addr, result_addr, c
 
     current = {'seq': None}  # seq of the item being processed, for publish tagging
 
+    def _blob_backpressure(incoming):
+        """The byte analog of the ring's capacity bound: blobs are unlinked on
+        read, so the shared directory's total size IS the pool's unconsumed
+        backlog. Block (stop-aware) until the new blob fits the budget."""
+        while True:
+            try:
+                backlog = sum(e.stat().st_size for e in os.scandir(blob_dir))
+            except OSError:
+                return  # dir swept (shutdown race): the write will fail loudly
+            if backlog + incoming <= _BLOB_BUDGET_BYTES or backlog == 0:
+                return
+            if check_finished():
+                return
+            time.sleep(0.002)
+
+    class _BlobAllocFailed(Exception):
+        pass
+
+    def _write_blob(data):
+        """Serialize straight into a fresh mmapped /dev/shm file (ONE data
+        copy); returns its path, or None when the payload doesn't qualify or
+        tmpfs is full (callers fall back to the in-band channel)."""
+        import mmap
+        state = {}
+
+        def alloc(size):
+            # file creation is deferred to HERE: payloads that decline the
+            # blob path (sub-threshold) never touch the filesystem
+            _blob_backpressure(size)
+            fd, path = tempfile.mkstemp(prefix='b', dir=blob_dir)
+            state['fd'], state['path'] = fd, path
+            try:
+                # posix_fallocate: tmpfs exhaustion surfaces as a catchable
+                # ENOSPC here, NOT as a SIGBUS when the mmap write faults a
+                # page that cannot be backed (same stance as the ring's
+                # pre-faulting create)
+                os.posix_fallocate(fd, 0, size)
+            except OSError as e:
+                raise _BlobAllocFailed(str(e))
+            state['mm'] = mmap.mmap(fd, size)
+            return state['mm']
+
+        try:
+            written = serializer.serialize_into(data, alloc, min_size=blob_threshold)
+        except _BlobAllocFailed as e:
+            logger.warning('blob allocation failed (%s); payload falling back in-band', e)
+            written = None
+        except BaseException:
+            if 'fd' in state:
+                os.close(state['fd'])
+                os.unlink(state['path'])
+            raise
+        if written is not None:
+            written.release()  # the mmap refuses to close with exported views
+        if 'mm' in state:
+            state['mm'].close()
+        if 'fd' in state:
+            os.close(state['fd'])
+            if written is None:
+                os.unlink(state['path'])
+        return state.get('path') if written is not None else None
+
     def publish(data):
+        if blob_dir is not None:
+            path = _write_blob(data)
+            if path is not None:
+                send(_BLOB, current['seq'], path.encode())
+                return
         send(_DATA, current['seq'], serializer.serialize(data))
 
     worker = worker_class(worker_id, publish, worker_setup_args)

@@ -1,6 +1,8 @@
 """Pool protocol tests across thread/process/dummy pools
 (modeled on reference workers_pool/tests/test_workers_pool.py)."""
 
+import os
+
 import pytest
 
 from petastorm_tpu.serializers import ArrowTableSerializer, PickleSerializer
@@ -377,3 +379,110 @@ class TestShmRingStress:
         finally:
             pool.stop()
             pool.join()
+
+
+class TestBlobSidechannel:
+    """The large-payload /dev/shm blob path: single-copy serialize_into, COW
+    mmap on read, unlink-on-read + sweep-on-join hygiene."""
+
+    def test_serialize_into_bytes_match_serialize(self):
+        import numpy as np
+        from petastorm_tpu.serializers import NumpyBlockSerializer
+        s = NumpyBlockSerializer()
+        obj = {'a': np.arange(12, dtype=np.int64).reshape(3, 4),
+               'b': np.ones((2, 5), np.float32),
+               's': np.array(['x', 'y'], dtype=object)}
+        regular = s.serialize(obj)
+        buf = bytearray(len(regular))
+        out = s.serialize_into(obj, lambda size: memoryview(buf)[:size])
+        assert out is not None
+        assert bytes(buf) == regular  # byte-identical framing
+        back = s.deserialize(bytes(buf))
+        np.testing.assert_array_equal(back['a'], obj['a'])
+        np.testing.assert_array_equal(back['b'], obj['b'])
+        assert back['s'].tolist() == ['x', 'y']
+
+    def test_serialize_into_declines_small_and_nonblock(self):
+        import numpy as np
+        from petastorm_tpu.serializers import NumpyBlockSerializer
+        s = NumpyBlockSerializer()
+        called = []
+        assert s.serialize_into({'a': np.arange(4)}, called.append, min_size=1 << 20) is None
+        assert s.serialize_into(['not', 'a', 'block'], called.append) is None
+        assert s.serialize_into({'only': np.array([None, 1], dtype=object)},
+                                called.append) is None
+        assert not called  # alloc never invoked on declined payloads
+
+    @pytest.mark.skipif(not os.path.isdir('/dev/shm'), reason='needs /dev/shm')
+    def test_process_pool_blob_payloads_roundtrip_and_cleanup(self, tmp_path):
+        import glob
+        import numpy as np
+        from petastorm_tpu import make_reader
+        from petastorm_tpu.codecs import RawTensorCodec, ScalarCodec
+        from petastorm_tpu.etl.dataset_metadata import write_petastorm_dataset
+        from petastorm_tpu.unischema import Unischema, UnischemaField
+
+        schema = Unischema('S', [
+            UnischemaField('id', np.int64, (), ScalarCodec(), False),
+            UnischemaField('big', np.uint8, (64, 64, 3), RawTensorCodec(), False),
+        ])
+        url = 'file://' + str(tmp_path / 'ds')
+        rng = np.random.default_rng(1)
+        expected = {i: rng.integers(0, 255, (64, 64, 3), dtype=np.uint8) for i in range(30)}
+        write_petastorm_dataset(url, schema, ({'id': i, 'big': expected[i]}
+                                              for i in range(30)), rows_per_row_group=10)
+
+        # 10 rows x 12KB > the tiny threshold: every block rides the blob path
+        from petastorm_tpu import reader as reader_mod
+        orig = reader_mod._make_pool
+
+        def patched(pool_type, workers, qsize, serializer=None):
+            pool = orig(pool_type, workers, qsize, serializer=serializer)
+            if hasattr(pool, '_blob_threshold'):
+                pool._blob_threshold = 1024
+            return pool
+
+        reader_mod._make_pool = patched
+        try:
+            with make_reader(url, reader_pool_type='process', workers_count=1,
+                             output='columnar', shuffle_row_groups=False,
+                             num_epochs=1) as reader:
+                blob_dir = reader._pool._blob_dir
+                assert blob_dir is not None
+                seen = {}
+                for block in reader:
+                    for i, row_id in enumerate(block.id.tolist()):
+                        seen[row_id] = np.array(block.big[i])
+                    # consumed blobs are unlinked on read
+                    assert len(glob.glob(os.path.join(blob_dir, '*'))) <= 2
+        finally:
+            reader_mod._make_pool = orig
+        assert len(seen) == 30
+        for i, arr in expected.items():
+            np.testing.assert_array_equal(seen[i], arr)
+        assert not os.path.exists(blob_dir)  # swept on join
+
+    @pytest.mark.skipif(not os.path.isdir('/dev/shm'), reason='needs /dev/shm')
+    def test_blob_views_are_writable(self, tmp_path):
+        # ACCESS_COPY mapping: consumers may mutate batch arrays in place
+        import numpy as np
+        from petastorm_tpu import make_reader
+        from petastorm_tpu.codecs import RawTensorCodec, ScalarCodec
+        from petastorm_tpu.etl.dataset_metadata import write_petastorm_dataset
+        from petastorm_tpu.unischema import Unischema, UnischemaField
+
+        schema = Unischema('S', [
+            UnischemaField('id', np.int64, (), ScalarCodec(), False),
+            UnischemaField('big', np.uint8, (128, 128, 3), RawTensorCodec(), False),
+        ])
+        url = 'file://' + str(tmp_path / 'ds')
+        rng = np.random.default_rng(2)
+        write_petastorm_dataset(url, schema, ({'id': i, 'big': rng.integers(
+            0, 255, (128, 128, 3), dtype=np.uint8)} for i in range(30)),
+            rows_per_row_group=30)
+        with make_reader(url, reader_pool_type='process', workers_count=1,
+                         output='columnar', shuffle_row_groups=False, num_epochs=1) as r:
+            block = next(iter(r))
+            arr = block.big
+            arr[0, 0, 0, 0] = 7  # must not raise
+            assert arr[0, 0, 0, 0] == 7
