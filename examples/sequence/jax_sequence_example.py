@@ -38,7 +38,7 @@ from petastorm_tpu.parallel import make_mesh
 
 
 def train(dataset_url, steps=50, batch_size=16, window=8, seq_axis_size=None,
-          num_classes=8, seed=0):
+          num_classes=8, seed=0, context='ring'):
     feature_dim = TelemetrySchema.fields['features'].shape[0]
     n = len(jax.devices())
     seq_size = seq_axis_size or (2 if n % 2 == 0 else 1)
@@ -52,7 +52,8 @@ def train(dataset_url, steps=50, batch_size=16, window=8, seq_axis_size=None,
     ngram = NGram(fields, delta_threshold=1,
                   timestamp_field=TelemetrySchema.fields['timestamp'])
 
-    model = make_sequence_transformer(num_classes=num_classes, mesh=mesh)
+    model = make_sequence_transformer(num_classes=num_classes, mesh=mesh,
+                                      context_parallelism=context)
     state = create_train_state(model, jax.random.PRNGKey(seed),
                                jnp.zeros((batch_size, window, feature_dim)))
     batch_sharding = NamedSharding(mesh, P('data', 'seq', None))
@@ -84,8 +85,11 @@ def main():
     parser.add_argument('--steps', type=int, default=50)
     parser.add_argument('--batch-size', type=int, default=16)
     parser.add_argument('--window', type=int, default=8)
+    parser.add_argument('--context', choices=('ring', 'ulysses'), default='ring',
+                        help='context-parallel attention strategy (docs/parallelism.md)')
     args = parser.parse_args()
-    train(args.dataset_url, args.steps, args.batch_size, args.window)
+    train(args.dataset_url, args.steps, args.batch_size, args.window,
+          context=args.context)
 
 
 if __name__ == '__main__':

@@ -48,8 +48,10 @@ def _make_pool(reader_pool_type, workers_count, results_queue_size, serializer=N
     here EVERY worker publishes column blocks, so the raw-buffer
     :class:`NumpyBlockSerializer` is the process-pool default (its embedded
     pickle covers NGram window lists and other non-block payloads).
-    Note: block columns crossing the process boundary arrive as read-only numpy
-    views over the IPC message (zero-copy receive)."""
+    Note: block columns crossing the process boundary arrive as WRITABLE numpy
+    views over the IPC message (zero-copy receive: shm-ring bytearray, blob
+    copy-on-write mmap; the zmq fallback copies once to match) — the same
+    mutate-in-place affordance thread-pool blocks have."""
     if reader_pool_type == 'thread':
         return ThreadPool(workers_count, results_queue_size)
     if reader_pool_type == 'process':

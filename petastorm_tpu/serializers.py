@@ -102,6 +102,35 @@ class NumpyBlockSerializer(object):
             off += n
         return out
 
+    def serialize_routed(self, obj, alloc, min_size=0):
+        """One-pass channel routing for the process-pool publish path: the
+        block classification/framing runs ONCE, then large raw blocks are
+        written via ``alloc`` (single copy) and everything else is framed
+        in-band. Returns ``('blob', buffer)`` or ``('bytes', message)``."""
+        split = self._split_block(obj)
+        if split is None:
+            return 'bytes', self._PICKLE + pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+        raw, header = split
+        total = 5 + len(header) + sum(v.nbytes for v in raw.values())
+        if raw and total >= min_size:
+            return 'blob', self._write_frame_into(raw, header, alloc(total))
+        parts = [self._BLOCK, struct.pack('<I', len(header)), header]
+        parts.extend(self._array_bytes(v) for v in raw.values())
+        return 'bytes', b''.join(parts)
+
+    @classmethod
+    def _write_frame_into(cls, raw, header, target):
+        buf = memoryview(target)
+        buf[0:1] = cls._BLOCK
+        struct.pack_into('<I', buf, 1, len(header))
+        buf[5:5 + len(header)] = header
+        off = 5 + len(header)
+        for v in raw.values():
+            n = v.nbytes
+            buf[off:off + n] = cls._array_bytes(v)
+            off += n
+        return buf
+
     def serialize_into(self, obj, alloc, min_size=0):
         """Single-copy serialize: compute the exact framed-message size, obtain
         a writable buffer from ``alloc(size)`` (e.g. an mmapped /dev/shm file),
@@ -119,16 +148,7 @@ class NumpyBlockSerializer(object):
         total = 5 + len(header) + sum(v.nbytes for v in raw.values())
         if total < min_size:
             return None
-        buf = memoryview(alloc(total))
-        buf[0:1] = self._BLOCK
-        struct.pack_into('<I', buf, 1, len(header))
-        buf[5:5 + len(header)] = header
-        off = 5 + len(header)
-        for v in raw.values():
-            n = v.nbytes
-            buf[off:off + n] = self._array_bytes(v)
-            off += n
-        return buf
+        return self._write_frame_into(raw, header, alloc(total))
 
 
 class ArrowTableSerializer(object):

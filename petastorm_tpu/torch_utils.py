@@ -76,8 +76,9 @@ def _collate_columns_to_torch(batch_columns):
                 'TransformSpec.'.format(name))
         if col.dtype in _TORCH_HOSTILE_PROMOTIONS:
             col = col.astype(_TORCH_HOSTILE_PROMOTIONS[col.dtype])
-        # 'W': process-pool blocks arrive as read-only views over the IPC
-        # message; torch.from_numpy needs writable memory (copies only then)
+        # 'W': defensive — process-pool blocks are writable on all current
+        # channels, but torch.from_numpy hard-requires writable memory, so any
+        # read-only input (e.g. a user-supplied view) copies instead of raising
         out[name] = torch.from_numpy(np.require(col, requirements=['C', 'W']))
     return out
 

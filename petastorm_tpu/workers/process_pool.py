@@ -66,7 +66,10 @@ def _read_blob(path):
     """Map a blob file copy-on-write and unlink it: the returned memoryview's
     consumers (numpy views) keep the mapping — and thus the pages — alive; the
     name disappears immediately, so nothing leaks even if deserialization
-    fails. ACCESS_COPY makes the views writable without copying upfront."""
+    fails. ACCESS_COPY gives WRITABLE views without an upfront copy — the
+    uniform process-pool contract (the shm ring's per-message bytearray is
+    writable too, and the zmq fallback copies to match): writability must not
+    depend on which channel a payload happened to ride."""
     import mmap
     with open(path, 'rb') as f:
         mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_COPY)
@@ -197,11 +200,15 @@ class ProcessPool(object):
             self._results_receive.bind(result_addr)
 
         # per-run /dev/shm blob dir for the large-payload sidechannel: only when
-        # the serializer can single-copy serialize into an mmapped file
-        if (self._blob_threshold and hasattr(self._serializer, 'serialize_into')
+        # the serializer can route payloads in one pass and tmpfs has at least
+        # token headroom (workers additionally self-disable after persistent
+        # ENOSPC — the capacity can change under us at runtime)
+        if (self._blob_threshold and hasattr(self._serializer, 'serialize_routed')
                 and os.path.isdir('/dev/shm')):
             try:
-                self._blob_dir = tempfile.mkdtemp(prefix='pstpu_blobs_', dir='/dev/shm')
+                st = os.statvfs('/dev/shm')
+                if st.f_bavail * st.f_frsize >= 4 * self._blob_threshold:
+                    self._blob_dir = tempfile.mkdtemp(prefix='pstpu_blobs_', dir='/dev/shm')
             except OSError:
                 self._blob_dir = None
 
@@ -244,6 +251,11 @@ class ProcessPool(object):
             if not self._results_receive.poll(timeout_ms):
                 return None
             kind, seq_bytes, payload = self._results_receive.recv_multipart()
+            if kind == _DATA:
+                # bytes are immutable and would make the deserializer's views
+                # read-only; the ring and blob channels hand out writable
+                # views, and the contract must not depend on the transport
+                payload = bytearray(payload)
             return kind, (int(seq_bytes) if seq_bytes else None), payload
         deadline = time.monotonic() + timeout_ms / 1000.0
         sleep_s = 0.0002
@@ -426,55 +438,66 @@ def _worker_bootstrap(worker_id, main_pid, setup_blob, vent_addr, result_addr, c
     class _BlobAllocFailed(Exception):
         pass
 
-    def _write_blob(data):
-        """Serialize straight into a fresh mmapped /dev/shm file (ONE data
-        copy); returns its path, or None when the payload doesn't qualify or
-        tmpfs is full (callers fall back to the in-band channel)."""
-        import mmap
-        state = {}
-
-        def alloc(size):
-            # file creation is deferred to HERE: payloads that decline the
-            # blob path (sub-threshold) never touch the filesystem
-            _blob_backpressure(size)
-            fd, path = tempfile.mkstemp(prefix='b', dir=blob_dir)
-            state['fd'], state['path'] = fd, path
-            try:
-                # posix_fallocate: tmpfs exhaustion surfaces as a catchable
-                # ENOSPC here, NOT as a SIGBUS when the mmap write faults a
-                # page that cannot be backed (same stance as the ring's
-                # pre-faulting create)
-                os.posix_fallocate(fd, 0, size)
-            except OSError as e:
-                raise _BlobAllocFailed(str(e))
-            state['mm'] = mmap.mmap(fd, size)
-            return state['mm']
-
-        try:
-            written = serializer.serialize_into(data, alloc, min_size=blob_threshold)
-        except _BlobAllocFailed as e:
-            logger.warning('blob allocation failed (%s); payload falling back in-band', e)
-            written = None
-        except BaseException:
-            if 'fd' in state:
-                os.close(state['fd'])
-                os.unlink(state['path'])
-            raise
-        if written is not None:
-            written.release()  # the mmap refuses to close with exported views
-        if 'mm' in state:
-            state['mm'].close()
-        if 'fd' in state:
-            os.close(state['fd'])
-            if written is None:
-                os.unlink(state['path'])
-        return state.get('path') if written is not None else None
+    # persistent tmpfs exhaustion must not degrade into a warn+retry treadmill
+    # on every message: give up on the sidechannel after a few consecutive
+    # allocation failures (the in-band path keeps working regardless)
+    blob_fail = {'consecutive': 0, 'disabled': False}
+    _BLOB_DISABLE_AFTER = 3
 
     def publish(data):
-        if blob_dir is not None:
-            path = _write_blob(data)
-            if path is not None:
-                send(_BLOB, current['seq'], path.encode())
+        use_blob = (blob_dir is not None and not blob_fail['disabled']
+                    and hasattr(serializer, 'serialize_routed'))
+        if use_blob:
+            import mmap
+            state = {}
+
+            def alloc(size):
+                # file creation is deferred to HERE: payloads routed in-band
+                # (sub-threshold/non-block) never touch the filesystem
+                _blob_backpressure(size)
+                fd, path = tempfile.mkstemp(prefix='b', dir=blob_dir)
+                state['fd'], state['path'] = fd, path
+                try:
+                    # posix_fallocate: tmpfs exhaustion surfaces as a catchable
+                    # ENOSPC here, NOT as a SIGBUS when the mmap write faults a
+                    # page that cannot be backed (same stance as the ring's
+                    # pre-faulting create)
+                    os.posix_fallocate(fd, 0, size)
+                except OSError as e:
+                    raise _BlobAllocFailed(str(e))
+                state['mm'] = mmap.mmap(fd, size)
+                return state['mm']
+
+            try:
+                kind, payload = serializer.serialize_routed(data, alloc,
+                                                            min_size=blob_threshold)
+            except _BlobAllocFailed as e:
+                if 'fd' in state:
+                    os.close(state['fd'])
+                    os.unlink(state['path'])
+                blob_fail['consecutive'] += 1
+                if blob_fail['consecutive'] >= _BLOB_DISABLE_AFTER:
+                    blob_fail['disabled'] = True
+                    logger.warning('blob allocation failed %d times (%s); disabling the '
+                                   '/dev/shm sidechannel for this worker',
+                                   blob_fail['consecutive'], e)
+                else:
+                    logger.warning('blob allocation failed (%s); payload falling back '
+                                   'in-band', e)
+            except BaseException:
+                if 'fd' in state:
+                    os.close(state['fd'])
+                    os.unlink(state['path'])
+                raise
+            else:
+                blob_fail['consecutive'] = 0
+                if kind == 'bytes':
+                    send(_DATA, current['seq'], payload)
+                else:
+                    payload.release()  # the mmap refuses to close with views
+                    state['mm'].close()
+                    os.close(state['fd'])
+                    send(_BLOB, current['seq'], state['path'].encode())
                 return
         send(_DATA, current['seq'], serializer.serialize(data))
 

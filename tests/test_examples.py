@@ -115,12 +115,14 @@ def test_imagenet_directory_ingest(tmp_path):
     assert rows[0].image.shape == (40, 50, 3)
 
 
-def test_sequence_example_end_to_end(tmp_path):
-    """Long-context example: telemetry store -> columnar NGram -> ring-attention
-    transformer training steps on the virtual mesh."""
+@pytest.mark.parametrize('context', ['ring', 'ulysses'])
+def test_sequence_example_end_to_end(tmp_path, context):
+    """Long-context example: telemetry store -> columnar NGram -> context-
+    parallel transformer training steps on the virtual mesh, under both
+    strategies."""
     from examples.sequence.generate_petastorm_sequence import generate_sequence_dataset
     from examples.sequence.jax_sequence_example import train
     url = 'file://' + str(tmp_path / 'seq')
     generate_sequence_dataset(url, rows=512, rows_per_row_group=64)
-    state = train(url, steps=4, batch_size=8, window=4)
+    state = train(url, steps=4, batch_size=8, window=4, context=context)
     assert int(state.step) == 4

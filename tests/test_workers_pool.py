@@ -462,9 +462,35 @@ class TestBlobSidechannel:
             np.testing.assert_array_equal(seen[i], arr)
         assert not os.path.exists(blob_dir)  # swept on join
 
+    def test_serialize_routed_picks_channel_once(self):
+        import numpy as np
+        from petastorm_tpu.serializers import NumpyBlockSerializer
+        s = NumpyBlockSerializer()
+        big = {'a': np.zeros((1 << 18,), np.uint8)}
+        allocs = []
+
+        def alloc(size):
+            buf = bytearray(size)
+            allocs.append(buf)
+            return memoryview(buf)
+
+        kind, payload = s.serialize_routed(big, alloc, min_size=1024)
+        assert kind == 'blob' and len(allocs) == 1
+        np.testing.assert_array_equal(s.deserialize(bytes(allocs[0]))['a'], big['a'])
+        # sub-threshold: framed in-band, alloc untouched, bytes identical to serialize
+        small = {'a': np.arange(4, dtype=np.int64)}
+        kind, payload = s.serialize_routed(small, alloc, min_size=1 << 20)
+        assert kind == 'bytes' and len(allocs) == 1
+        assert payload == s.serialize(small)
+        # non-block: pickle channel
+        kind, payload = s.serialize_routed(['x'], alloc, min_size=0)
+        assert kind == 'bytes' and s.deserialize(payload) == ['x']
+
     @pytest.mark.skipif(not os.path.isdir('/dev/shm'), reason='needs /dev/shm')
-    def test_blob_views_are_writable(self, tmp_path):
-        # ACCESS_COPY mapping: consumers may mutate batch arrays in place
+    @pytest.mark.parametrize('rows_per_group,label', [(30, 'blob'), (4, 'inband')])
+    def test_blocks_writable_on_every_channel(self, tmp_path, rows_per_group, label):
+        # the uniform contract: process-pool blocks are WRITABLE whichever
+        # channel they rode (blob COW mmap / ring bytearray / zmq copies)
         import numpy as np
         from petastorm_tpu import make_reader
         from petastorm_tpu.codecs import RawTensorCodec, ScalarCodec
@@ -479,10 +505,11 @@ class TestBlobSidechannel:
         rng = np.random.default_rng(2)
         write_petastorm_dataset(url, schema, ({'id': i, 'big': rng.integers(
             0, 255, (128, 128, 3), dtype=np.uint8)} for i in range(30)),
-            rows_per_row_group=30)
+            rows_per_row_group=rows_per_group)
         with make_reader(url, reader_pool_type='process', workers_count=1,
                          output='columnar', shuffle_row_groups=False, num_epochs=1) as r:
             block = next(iter(r))
             arr = block.big
+            assert arr.flags.writeable, label
             arr[0, 0, 0, 0] = 7  # must not raise
             assert arr[0, 0, 0, 0] == 7
