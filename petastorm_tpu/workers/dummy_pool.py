@@ -1,11 +1,18 @@
-"""Dummy pool: synchronous execution on the caller thread.
+"""Dummy pool: synchronous execution on the CONSUMER thread.
 
 Parity: /root/reference/petastorm/workers_pool/dummy_pool.py:20-91. Exists for
-debugging and profiling — worker code runs where a profiler/debugger can see it.
+debugging and profiling — worker code runs where a profiler/debugger can see
+it. That is why ``ventilate`` only ENQUEUES tasks: the actual
+``worker.process`` happens inside :meth:`get_results` on the caller's thread
+(with a ventilator attached, ``ventilate`` is invoked from the ventilator
+thread — processing there would hide the hot loop from per-thread profilers
+AND leave the consumer sleep-polling for results).
 """
 
 from __future__ import annotations
 
+import threading
+import time
 from collections import deque
 
 from petastorm_tpu.workers.worker_base import EmptyResultError
@@ -17,6 +24,8 @@ _DATA, _DONE = 0, 1
 class DummyPool(object):
     def __init__(self, workers_count=1, results_queue_size=None):
         self._results = deque()  # (_DATA, seq, payload) | (_DONE, seq, None)
+        self._pending = deque()  # (args, kwargs) not yet processed (_seq rides kwargs)
+        self._pending_lock = threading.Lock()
         self._worker = None
         self._ventilator = None
         self._worker_error = None
@@ -37,20 +46,29 @@ class DummyPool(object):
             self._ventilator.start()
 
     def ventilate(self, *args, **kwargs):
+        with self._pending_lock:
+            self._pending.append((args, kwargs))
+
+    def _process_one(self):
+        """Run one pending task on THIS thread. Returns False when none were
+        queued."""
+        with self._pending_lock:
+            if not self._pending:
+                return False
+            args, kwargs = self._pending.popleft()
+        kwargs = dict(kwargs)
         self._current_seq = kwargs.pop('_seq', None)
         try:
             self._worker.process(*args, **kwargs)
             self._results.append((_DONE, self._current_seq, None))
-        except Exception as e:  # noqa: BLE001 - forwarded to the consumer, like
-            # ThreadPool/ProcessPool do; without this a ventilator-thread failure
-            # would leave get_results() spinning forever
+        except Exception as e:  # noqa: BLE001 - forwarded like Thread/ProcessPool
             self._worker_error = e
             if self._ventilator is not None:
                 self._ventilator.stop()
-            raise
         finally:
             if self._ventilator is not None:
                 self._ventilator.processed_item()
+        return True
 
     def _pop_ready(self):
         """Pop queued entries until a payload is found; process completion
@@ -65,8 +83,6 @@ class DummyPool(object):
         return None
 
     def get_results(self):
-        # give a lazy ventilator thread a chance to feed us before declaring empty
-        import time
         while True:
             payload = self._pop_ready()
             if payload is not None:
@@ -74,9 +90,13 @@ class DummyPool(object):
             if self._worker_error is not None:
                 error, self._worker_error = self._worker_error, None
                 raise error
+            if self._process_one():
+                continue  # produced results (or an error) synchronously
             if self._ventilator is None or self._ventilator.completed():
-                # re-check: the ventilator may have appended a result between the
+                # re-check: the ventilator may have enqueued between the
                 # emptiness check and completed() flipping true
+                if self._process_one():
+                    continue
                 payload = self._pop_ready()
                 if payload is not None:
                     return payload
@@ -84,11 +104,19 @@ class DummyPool(object):
                     error, self._worker_error = self._worker_error, None
                     raise error
                 raise EmptyResultError()
+            # brief wait: only reachable while the ventilator thread is between
+            # enqueues (it does no processing, so this resolves in microseconds)
             time.sleep(0.0001)
 
     def stop(self):
         if self._ventilator is not None:
             self._ventilator.stop()
+        # parity with ThreadPool (whose workers exit on the stop event): items
+        # ventilated but not yet processed are dropped, not run after stop —
+        # and a post-join get_results must raise EmptyResultError, not
+        # AttributeError off the cleared worker
+        with self._pending_lock:
+            self._pending.clear()
 
     def join(self):
         if self._worker is not None:

@@ -513,3 +513,39 @@ class TestBlobSidechannel:
             assert arr.flags.writeable, label
             arr[0, 0, 0, 0] = 7  # must not raise
             assert arr[0, 0, 0, 0] == 7
+
+
+def test_dummy_pool_drops_pending_after_stop():
+    # parity with ThreadPool: stop() discards ventilated-but-unprocessed items;
+    # get_results after stop+join raises EmptyResultError, never AttributeError
+    from petastorm_tpu.test_util.stub_workers import IdentityWorker
+    pool = DummyPool()
+    pool.start(IdentityWorker)
+    pool.ventilate(1)
+    pool.ventilate(2)
+    assert pool.get_results() == 1
+    pool.stop()
+    pool.join()
+    with pytest.raises(EmptyResultError):
+        pool.get_results()
+
+
+def test_dummy_pool_processes_on_consumer_thread():
+    # the pool's reason to exist: worker code runs where a profiler sees it
+    import threading
+    from petastorm_tpu.workers.worker_base import WorkerBase
+
+    class ThreadRecorder(WorkerBase):
+        seen = []
+
+        def process(self, x):
+            ThreadRecorder.seen.append(threading.current_thread())
+            self.publish(x)
+
+    pool = DummyPool()
+    pool.start(ThreadRecorder)
+    pool.ventilate(1)
+    assert pool.get_results() == 1
+    assert ThreadRecorder.seen == [threading.main_thread()]
+    pool.stop()
+    pool.join()
