@@ -240,40 +240,52 @@ class JaxDataLoader(object):
         # state_dict() taken from another thread (background prefetch pumping
         # this loader) sees a consistent snapshot and cannot hang behind a
         # starved reader.
+        #
+        # Exactly ONE batch is extracted per yield: a batch leaves the buffer
+        # only at the moment it is handed to the consumer. Extracting several
+        # batches under the lock and yielding them lazily would park them in a
+        # generator-local limbo that state_dict() cannot see — a checkpoint
+        # taken then would silently lose those rows.
         import time
         self._iter_start = time.perf_counter()
         self._reader_wait_s = 0.0
         self._rows_out = 0
         bs = self.batch_size
         reader_it = iter(self.reader)
+        exhausted = False
         while True:
+            with self._state_lock:
+                batch = None
+                if not exhausted:
+                    if buffer.can_emit(bs):
+                        batch = self._emit_columnar(buffer.emit(bs))
+                elif buffer.size >= bs:
+                    batch = self._emit_columnar(buffer.emit(bs))
+                elif buffer.size and not self._drop_last:
+                    batch = self._emit_columnar(buffer.emit(buffer.size))
+                else:
+                    # drop_last leftovers are intentionally dropped — clear so
+                    # an exhausted loader can be iterated again (multi-epoch)
+                    buffer.clear()
+                    return
+            if batch is not None:
+                yield batch
+                continue
             w0 = time.perf_counter()
             try:
                 item = next(reader_it)
             except StopIteration:
                 self._reader_wait_s += time.perf_counter() - w0
-                break
+                with self._state_lock:
+                    buffer.finish()
+                exhausted = True
+                continue
             self._reader_wait_s += time.perf_counter() - w0
-            emitted = []
             with self._state_lock:
                 if self._columnar_ngram:
                     buffer.add_block(_flatten_ngram_block(item))
                 else:
                     buffer.add_block(dict(item._asdict()))
-                while buffer.can_emit(bs):
-                    emitted.append(self._emit_columnar(buffer.emit(bs)))
-            yield from emitted
-        with self._state_lock:
-            buffer.finish()
-            emitted = []
-            while buffer.size >= bs:
-                emitted.append(self._emit_columnar(buffer.emit(bs)))
-            if buffer.size and not self._drop_last:
-                emitted.append(self._emit_columnar(buffer.emit(buffer.size)))
-            # drop_last leftovers are intentionally dropped — clear them so an
-            # exhausted loader can be iterated again (multi-epoch pattern)
-            buffer.clear()
-        yield from emitted
 
     def _emit_columnar(self, batch):
         self._rows_out += len(next(iter(batch.values()))) if batch else 0
@@ -285,49 +297,52 @@ class JaxDataLoader(object):
         return batch
 
     def _iterate(self, buffer, pending):
+        # One batch extracted per yield, same invariant (and for the same
+        # checkpoint-correctness reason) as _iterate_columnar. The collate
+        # happens under the lock BEFORE the yield: a state_dict() taken while
+        # the consumer holds a batch must not count its rows as pending.
         import time
         self._iter_start = time.perf_counter()
         self._reader_wait_s = 0.0
         self._rows_out = 0
+        bs = self.batch_size
         reader_it = iter(self.reader)
+        exhausted = False
         while True:
+            with self._state_lock:
+                batch = None
+                while buffer.can_retrieve() and len(pending) < bs:
+                    pending.append(buffer.retrieve())
+                if len(pending) == bs:
+                    batch = self._emit(pending)
+                    pending.clear()
+                elif exhausted:
+                    if pending and not self._drop_last:
+                        batch = self._emit(list(pending))
+                        pending.clear()
+                    else:
+                        # drop_last leftovers are intentionally dropped — clear
+                        # so an exhausted loader can be iterated again
+                        pending.clear()
+                        return
+            if batch is not None:
+                yield batch
+                continue
             w0 = time.perf_counter()
             try:
                 item = next(reader_it)
             except StopIteration:
                 self._reader_wait_s += time.perf_counter() - w0
-                break
+                with self._state_lock:
+                    buffer.finish()
+                exhausted = True
+                continue
             self._reader_wait_s += time.perf_counter() - w0
-            emitted = []
             with self._state_lock:  # mutation only — never across the reader wait
                 if self.reader.batched_output:
                     buffer.add_many(_rows_from_columnar_batch(item))
                 else:
                     buffer.add_many([item])
-                while buffer.can_retrieve():
-                    pending.append(buffer.retrieve())
-                    if len(pending) == self.batch_size:
-                        # collate+clear BEFORE yield: a state_dict() taken while
-                        # the consumer holds this batch must not count its rows
-                        # as pending
-                        emitted.append(self._emit(pending))
-                        pending.clear()
-            yield from emitted
-        with self._state_lock:
-            buffer.finish()
-            emitted = []
-            while buffer.can_retrieve():
-                pending.append(buffer.retrieve())
-                if len(pending) == self.batch_size:
-                    emitted.append(self._emit(pending))
-                    pending.clear()
-            if pending and not self._drop_last:
-                emitted.append(self._emit(list(pending)))
-                pending.clear()
-            # drop_last leftovers are intentionally dropped — clear them so an
-            # exhausted loader can be iterated again (multi-epoch pattern)
-            pending.clear()
-        yield from emitted
 
     # -- checkpoint ---------------------------------------------------------
 
