@@ -455,7 +455,10 @@ def _worker_bootstrap(worker_id, main_pid, setup_blob, vent_addr, result_addr, c
                 # file creation is deferred to HERE: payloads routed in-band
                 # (sub-threshold/non-block) never touch the filesystem
                 _blob_backpressure(size)
-                fd, path = tempfile.mkstemp(prefix='b', dir=blob_dir)
+                try:
+                    fd, path = tempfile.mkstemp(prefix='b', dir=blob_dir)
+                except OSError as e:  # unwritable/vanished dir: degrade, not die
+                    raise _BlobAllocFailed(str(e))
                 state['fd'], state['path'] = fd, path
                 try:
                     # posix_fallocate: tmpfs exhaustion surfaces as a catchable
@@ -465,7 +468,10 @@ def _worker_bootstrap(worker_id, main_pid, setup_blob, vent_addr, result_addr, c
                     os.posix_fallocate(fd, 0, size)
                 except OSError as e:
                     raise _BlobAllocFailed(str(e))
-                state['mm'] = mmap.mmap(fd, size)
+                try:
+                    state['mm'] = mmap.mmap(fd, size)
+                except OSError as e:  # e.g. ENOMEM under address-space pressure
+                    raise _BlobAllocFailed(str(e))
                 return state['mm']
 
             try:

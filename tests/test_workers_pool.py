@@ -549,3 +549,42 @@ def test_dummy_pool_processes_on_consumer_thread():
     assert ThreadRecorder.seen == [threading.main_thread()]
     pool.stop()
     pool.join()
+
+
+@pytest.mark.skipif(not os.path.isdir('/dev/shm'), reason='needs /dev/shm')
+def test_blob_allocation_failure_degrades_in_band(tmp_path):
+    """A vanished blob dir (stand-in for tmpfs exhaustion; deletion works even
+    under root, where chmod would be bypassed via CAP_DAC_OVERRIDE) must
+    degrade every payload to the in-band channel — data complete and correct,
+    no worker crash. With 4 row groups the worker also rides through its
+    self-disable threshold (3 failures), though the disable itself is
+    child-process state this test cannot observe directly."""
+    import numpy as np
+    from petastorm_tpu import make_reader
+    from petastorm_tpu.codecs import RawTensorCodec, ScalarCodec
+    from petastorm_tpu.etl.dataset_metadata import write_petastorm_dataset
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+
+    schema = Unischema('S', [
+        UnischemaField('id', np.int64, (), ScalarCodec(), False),
+        UnischemaField('big', np.uint8, (96, 96, 3), RawTensorCodec(), False),
+    ])
+    url = 'file://' + str(tmp_path / 'ds')
+    rng = np.random.default_rng(5)
+    expected = {i: rng.integers(0, 255, (96, 96, 3), dtype=np.uint8) for i in range(40)}
+    write_petastorm_dataset(url, schema, ({'id': i, 'big': expected[i]}
+                                          for i in range(40)), rows_per_row_group=10)
+
+    import shutil
+    with make_reader(url, reader_pool_type='process', workers_count=1,
+                     output='columnar', shuffle_row_groups=False, num_epochs=1) as r:
+        blob_dir = r._pool._blob_dir
+        assert blob_dir is not None
+        shutil.rmtree(blob_dir)  # every mkstemp now fails -> fallback path
+        seen = {}
+        for block in r:
+            for i, row_id in enumerate(block.id.tolist()):
+                seen[row_id] = np.array(block.big[i])
+    assert len(seen) == 40
+    for i, a in expected.items():
+        np.testing.assert_array_equal(seen[i], a)
