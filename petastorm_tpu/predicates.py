@@ -103,12 +103,35 @@ class in_intersection(PredicateBase):
     def get_fields(self):
         return {self._field_name}
 
-    def do_include(self, values):
-        value = values[self._field_name]
+    def _cell_intersects(self, value):
+        """THE intersection semantics (None excluded; arrays compared over
+        ``.flat``), shared by the row and batched paths."""
         if value is None:
             return False
         return not self._inclusion_values.isdisjoint(
             v for v in (value.flat if isinstance(value, np.ndarray) else value))
+
+    def do_include(self, values):
+        return self._cell_intersects(values[self._field_name])
+
+    def do_include_batch(self, block):
+        col = block[self._field_name]
+        if not isinstance(col, np.ndarray):
+            return None
+        if col.ndim >= 2 and col.dtype.kind in 'biuf':
+            # uniform stacked cells: one vectorized isin over the flattened
+            # tail axes (same mixed-type guard as in_set — np.isin silently
+            # coerces e.g. strings against numeric columns)
+            vals = list(self._inclusion_values)
+            if not all(isinstance(v, (int, float, np.number)) and not isinstance(v, (str, bytes))
+                       for v in vals):
+                return None
+            return np.isin(col.reshape(len(col), -1), vals).any(axis=1)
+        if col.ndim == 1 and col.dtype == object:
+            # ragged cells: per-cell set probe, but no per-row dict churn
+            return np.fromiter((self._cell_intersects(v) for v in col),
+                               dtype=bool, count=len(col))
+        return None
 
 
 class in_lambda(PredicateBase):

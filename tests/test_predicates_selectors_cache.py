@@ -286,3 +286,25 @@ def test_batch_reader_pushdown_uses_batch_path(scalar_dataset):
     assert got == keep
     assert CountingInSet.calls['batch'] > 0
     assert CountingInSet.calls['row'] == 0
+
+
+def test_in_intersection_batch_uniform_and_ragged():
+    from petastorm_tpu.columnar import block_to_rows
+    pred = in_intersection([3, 7], 'arr')
+    # uniform stacked [N, 2] cells
+    uni = {'arr': np.array([[1, 3], [4, 5], [7, 7], [2, 9]])}
+    out = pred.do_include_batch(dict(uni))
+    assert out.tolist() == [True, False, True, False]
+    assert out.tolist() == [pred.do_include(r) for r in block_to_rows(dict(uni))]
+    # ragged object cells incl. None
+    ragged = np.empty(4, dtype=object)
+    ragged[0] = np.array([1, 2, 3])
+    ragged[1] = np.array([5])
+    ragged[2] = None
+    ragged[3] = np.array([[7, 1], [2, 2]])  # 2-D cell: .flat semantics
+    block = {'arr': ragged}
+    out = pred.do_include_batch(dict(block))
+    assert out.tolist() == [True, False, False, True]
+    assert out.tolist() == [pred.do_include(r) for r in block_to_rows(dict(block))]
+    # mixed-type inclusion values decline on uniform numeric columns
+    assert in_intersection(['a', 1], 'arr').do_include_batch(dict(uni)) is None

@@ -556,9 +556,14 @@ def test_blob_allocation_failure_degrades_in_band(tmp_path):
     """A vanished blob dir (stand-in for tmpfs exhaustion; deletion works even
     under root, where chmod would be bypassed via CAP_DAC_OVERRIDE) must
     degrade every payload to the in-band channel — data complete and correct,
-    no worker crash. With 4 row groups the worker also rides through its
-    self-disable threshold (3 failures), though the disable itself is
+    no worker crash. Row groups are >= the 1MB blob threshold (1.38MB), so
+    every payload genuinely attempts the blob path; mkdtemp is patched to
+    hand the pool an already-deleted path, so the dir NEVER exists for any
+    worker — no blob can land first, race-free. 4 failing groups also ride
+    the worker through its self-disable threshold (3), though that flag is
     child-process state this test cannot observe directly."""
+    import shutil
+    import tempfile as tempfile_mod
     import numpy as np
     from petastorm_tpu import make_reader
     from petastorm_tpu.codecs import RawTensorCodec, ScalarCodec
@@ -571,20 +576,31 @@ def test_blob_allocation_failure_degrades_in_band(tmp_path):
     ])
     url = 'file://' + str(tmp_path / 'ds')
     rng = np.random.default_rng(5)
-    expected = {i: rng.integers(0, 255, (96, 96, 3), dtype=np.uint8) for i in range(40)}
+    expected = {i: rng.integers(0, 255, (96, 96, 3), dtype=np.uint8) for i in range(200)}
     write_petastorm_dataset(url, schema, ({'id': i, 'big': expected[i]}
-                                          for i in range(40)), rows_per_row_group=10)
+                                          for i in range(200)), rows_per_row_group=50)
 
-    import shutil
-    with make_reader(url, reader_pool_type='process', workers_count=1,
-                     output='columnar', shuffle_row_groups=False, num_epochs=1) as r:
-        blob_dir = r._pool._blob_dir
-        assert blob_dir is not None
-        shutil.rmtree(blob_dir)  # every mkstemp now fails -> fallback path
-        seen = {}
-        for block in r:
-            for i, row_id in enumerate(block.id.tolist()):
-                seen[row_id] = np.array(block.big[i])
-    assert len(seen) == 40
+    real_mkdtemp = tempfile_mod.mkdtemp
+    hijacked = []
+
+    def fake_mkdtemp(*args, **kwargs):
+        d = real_mkdtemp(*args, **kwargs)
+        if kwargs.get('prefix') == 'pstpu_blobs_':
+            shutil.rmtree(d)  # the pool gets a path that never exists
+            hijacked.append(d)
+        return d
+
+    tempfile_mod.mkdtemp = fake_mkdtemp
+    try:
+        with make_reader(url, reader_pool_type='process', workers_count=1,
+                         output='columnar', shuffle_row_groups=False, num_epochs=1) as r:
+            seen = {}
+            for block in r:
+                for i, row_id in enumerate(block.id.tolist()):
+                    seen[row_id] = np.array(block.big[i])
+    finally:
+        tempfile_mod.mkdtemp = real_mkdtemp
+    assert hijacked, 'blob dir was never requested: test did not cover the sidechannel'
+    assert len(seen) == 200
     for i, a in expected.items():
         np.testing.assert_array_equal(seen[i], a)
