@@ -41,9 +41,7 @@ def evaluate_predicate_mask(predicate, block, num_rows):
     shared by both workers' pushdown paths: returns a validated boolean mask,
     or ``None`` when the predicate has no batch path / declined (callers fall
     back to per-row ``do_include``)."""
-    if not hasattr(predicate, 'do_include_batch'):
-        return None
-    mask = predicate.do_include_batch(block)
+    mask = _batch_mask(predicate, block)
     if mask is None:
         return None
     mask = np.asarray(mask)
@@ -52,6 +50,16 @@ def evaluate_predicate_mask(predicate, block, num_rows):
             'do_include_batch must return a 1-D mask with one entry per row; '
             'got shape {} for {} rows'.format(mask.shape, num_rows))
     return mask.astype(bool, copy=False)
+
+
+def _batch_mask(predicate, block):
+    """The optional-batch contract in one place: a predicate without
+    ``do_include_batch`` (duck-typed, row-only) declines with ``None``, same
+    as one whose batch path returns ``None``."""
+    batch_fn = getattr(predicate, 'do_include_batch', None)
+    if batch_fn is None:
+        return None
+    return batch_fn(block)
 
 
 class in_set(PredicateBase):
@@ -163,7 +171,7 @@ class in_negate(PredicateBase):
         return not self._predicate.do_include(values)
 
     def do_include_batch(self, block):
-        inner = self._predicate.do_include_batch(block)
+        inner = _batch_mask(self._predicate, block)
         return None if inner is None else ~np.asarray(inner, dtype=bool)
 
 
@@ -193,7 +201,7 @@ class in_reduce(PredicateBase):
             return None  # arbitrary reducers keep row-at-a-time semantics
         masks = []
         for p in self._predicate_list:
-            m = p.do_include_batch(block)
+            m = _batch_mask(p, block)
             if m is None:
                 return None
             masks.append(np.asarray(m, dtype=bool))

@@ -57,7 +57,13 @@ class TransformSpec(object):
         self.image_decode_hints = dict(image_decode_hints or {})
         self.image_resize = {}
         for name, size in (image_resize or {}).items():
-            if len(size) != 2 or int(size[0]) < 1 or int(size[1]) < 1:
+            try:
+                # a str would pass len()==2 per-character ('24' -> (2, 4))
+                ok = (not isinstance(size, (str, bytes))
+                      and len(size) == 2 and int(size[0]) >= 1 and int(size[1]) >= 1)
+            except (TypeError, ValueError):  # scalar (no len) or non-numeric elements
+                ok = False
+            if not ok:
                 raise ValueError('image_resize[{!r}] must be a positive (out_h, out_w), '
                                  'got {!r}'.format(name, size))
             self.image_resize[name] = (int(size[0]), int(size[1]))

@@ -62,6 +62,53 @@ _DEFAULT_BLOB_THRESHOLD = 1 << 20
 _BLOB_BUDGET_BYTES = 256 << 20
 
 
+#: minimum age before a blob dir with a dead/unparseable owner pid may be
+#: reaped — protects a just-created dir whose owner the pid probe cannot see
+#: (e.g. a different PID namespace sharing /dev/shm)
+_BLOB_SWEEP_GRACE_S = 600
+
+
+def _sweep_stale_blob_dirs(shm_root):
+    """Reap ``pstpu_blobs_<pid>_*`` dirs whose owning process is gone AND whose
+    mtime is older than a grace period: blobs from a hard-killed run persist in
+    tmpfs forever (no kernel reclaim), and enough of them would silently
+    self-disable the sidechannel for every later pool via the headroom check.
+    Dirs without a parseable pid are treated as dead-owner (nothing alive can
+    own them across a restart) but still get the mtime grace. Best-effort: any
+    per-entry error skips that entry, never pool startup."""
+    try:
+        entries = list(os.scandir(shm_root))
+    except OSError:
+        return
+    now = time.time()
+    for entry in entries:
+        if not entry.name.startswith('pstpu_blobs_'):
+            continue
+        try:
+            owner_alive = False
+            parts = entry.name.split('_')
+            # <= 10 digits: anything longer overflows a C pid_t (os.kill would
+            # raise OverflowError) and is treated as no-parseable-owner instead
+            if (len(parts) >= 3 and parts[2].isascii() and parts[2].isdigit()
+                    and len(parts[2]) <= 10):
+                pid = int(parts[2])
+                if pid == os.getpid():
+                    continue
+                try:
+                    os.kill(pid, 0)  # signal 0: existence probe only
+                    owner_alive = True
+                except ProcessLookupError:
+                    owner_alive = False
+                except PermissionError:
+                    owner_alive = True  # exists, owned by someone else
+            if not owner_alive and now - entry.stat().st_mtime >= _BLOB_SWEEP_GRACE_S:
+                shutil.rmtree(entry.path, ignore_errors=True)
+        except (OSError, OverflowError, ValueError):
+            # e.g. os.kill OverflowError on an absurd digit string: skip the
+            # entry, never pool startup
+            continue
+
+
 def _read_blob(path):
     """Map a blob file copy-on-write and unlink it: the returned memoryview's
     consumers (numpy views) keep the mapping — and thus the pages — alive; the
@@ -205,10 +252,15 @@ class ProcessPool(object):
         # ENOSPC — the capacity can change under us at runtime)
         if (self._blob_threshold and hasattr(self._serializer, 'serialize_routed')
                 and os.path.isdir('/dev/shm')):
+            _sweep_stale_blob_dirs('/dev/shm')
             try:
                 st = os.statvfs('/dev/shm')
                 if st.f_bavail * st.f_frsize >= 4 * self._blob_threshold:
-                    self._blob_dir = tempfile.mkdtemp(prefix='pstpu_blobs_', dir='/dev/shm')
+                    # owner pid is encoded in the name so a future pool start can
+                    # reap dirs orphaned by a hard-killed process (tmpfs never
+                    # reclaims them on its own)
+                    self._blob_dir = tempfile.mkdtemp(
+                        prefix='pstpu_blobs_{}_'.format(os.getpid()), dir='/dev/shm')
             except OSError:
                 self._blob_dir = None
 
@@ -426,7 +478,14 @@ def _worker_bootstrap(worker_id, main_pid, setup_blob, vent_addr, result_addr, c
         backlog. Block (stop-aware) until the new blob fits the budget."""
         while True:
             try:
-                backlog = sum(e.stat().st_size for e in os.scandir(blob_dir))
+                backlog = 0
+                for e in os.scandir(blob_dir):
+                    try:
+                        backlog += e.stat().st_size
+                    except FileNotFoundError:
+                        # consumer unlinked the blob mid-scan — the normal
+                        # contended condition, not a shutdown; keep summing
+                        continue
             except OSError:
                 return  # dir swept (shutdown race): the write will fail loudly
             if backlog + incoming <= _BLOB_BUDGET_BYTES or backlog == 0:

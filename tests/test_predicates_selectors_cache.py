@@ -89,6 +89,25 @@ class TestVectorizedPredicates:
         out = self._check(p_any, block)
         assert out is not None and out.tolist() == [True, False, False, True]
 
+    def test_composed_duck_typed_predicate_falls_back_to_row_path(self):
+        # A user predicate with only do_include/get_fields (no do_include_batch)
+        # must keep working when wrapped in in_negate / in_reduce (ADVICE r3).
+        import numpy as np
+
+        class RowOnly(object):
+            def get_fields(self):
+                return {'a'}
+
+            def do_include(self, values):
+                return values['a'] > 2
+
+        block = {'a': np.array([1, 2, 3, 4])}
+        assert in_negate(RowOnly()).do_include_batch(dict(block)) is None
+        assert in_reduce([RowOnly(), in_set([1], 'a')], all).do_include_batch(dict(block)) is None
+        # and the row path still composes correctly
+        assert in_negate(RowOnly()).do_include({'a': 1}) is True
+        assert in_reduce([RowOnly(), in_set([3], 'a')], all).do_include({'a': 3}) is True
+
     def test_reduce_custom_func_declines(self):
         import numpy as np
         block = {'a': np.array([1, 2])}

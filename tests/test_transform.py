@@ -45,3 +45,16 @@ def test_selected_missing_raises():
     spec = TransformSpec(removed_fields=['c'], selected_fields=['c'])
     with pytest.raises(ValueError):
         transform_schema(_schema(), spec)
+
+
+def test_image_resize_scalar_rejected_with_clear_error():
+    # A scalar size must raise the descriptive ValueError, not a bare
+    # TypeError from len() (ADVICE r3).
+    with pytest.raises(ValueError, match='positive \\(out_h, out_w\\)'):
+        TransformSpec(image_resize={'image': 224})
+    with pytest.raises(ValueError, match='positive \\(out_h, out_w\\)'):
+        TransformSpec(image_resize={'image': (224,)})
+    with pytest.raises(ValueError, match='positive \\(out_h, out_w\\)'):
+        TransformSpec(image_resize={'image': (0, 224)})
+    spec = TransformSpec(image_resize={'image': [224, 128]})
+    assert spec.image_resize['image'] == (224, 128)

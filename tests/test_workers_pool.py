@@ -585,7 +585,7 @@ def test_blob_allocation_failure_degrades_in_band(tmp_path):
 
     def fake_mkdtemp(*args, **kwargs):
         d = real_mkdtemp(*args, **kwargs)
-        if kwargs.get('prefix') == 'pstpu_blobs_':
+        if str(kwargs.get('prefix', '')).startswith('pstpu_blobs_'):
             shutil.rmtree(d)  # the pool gets a path that never exists
             hijacked.append(d)
         return d
@@ -604,3 +604,56 @@ def test_blob_allocation_failure_degrades_in_band(tmp_path):
     assert len(seen) == 200
     for i, a in expected.items():
         np.testing.assert_array_equal(seen[i], a)
+
+def test_stale_blob_dirs_swept_on_pool_start(tmp_path):
+    """Blob dirs orphaned by a hard-killed process (dead pid in the name, or a
+    name with no parseable pid) are reaped by the next pool start once past
+    the mtime grace; dirs owned by a live process — own pid, a real foreign
+    live pid, or any fresh dir — survive (ADVICE r3)."""
+    import os
+    import subprocess
+    import sys
+    import time as time_mod
+    from petastorm_tpu.workers.process_pool import _BLOB_SWEEP_GRACE_S, _sweep_stale_blob_dirs
+
+    root = tmp_path / 'shm'
+    root.mkdir()
+    # find a pid that is certainly dead
+    dead_pid = 999999
+    while True:
+        try:
+            os.kill(dead_pid, 0)
+            dead_pid -= 1
+        except ProcessLookupError:
+            break
+        except PermissionError:
+            dead_pid -= 1
+    # a real foreign live process, to exercise the os.kill success branch
+    child = subprocess.Popen([sys.executable, '-c', 'import time; time.sleep(60)'])
+    try:
+        stale = root / ('pstpu_blobs_%d_abc' % dead_pid)
+        legacy = root / 'pstpu_blobs_legacyname'
+        own = root / ('pstpu_blobs_%d_xyz' % os.getpid())
+        foreign_live = root / ('pstpu_blobs_%d_qrs' % child.pid)
+        fresh_dead = root / ('pstpu_blobs_%d_new' % dead_pid)
+        weird = root / 'pstpu_blobs_²_x'  # non-ASCII digit: must not crash the sweep
+        other = root / 'unrelated_dir'
+        for d in (stale, legacy, own, foreign_live, fresh_dead, weird, other):
+            d.mkdir()
+            (d / 'blob').write_bytes(b'x' * 128)
+        old = time_mod.time() - _BLOB_SWEEP_GRACE_S - 5
+        for d in (stale, legacy, own, foreign_live, weird):
+            os.utime(d, (old, old))  # past the grace period; fresh_dead stays fresh
+
+        _sweep_stale_blob_dirs(str(root))
+
+        assert not stale.exists()
+        assert not legacy.exists()
+        assert not weird.exists()  # unparseable pid + old: reaped, not crashed
+        assert own.exists()
+        assert foreign_live.exists()
+        assert fresh_dead.exists()  # dead owner but inside the grace window
+        assert other.exists()
+    finally:
+        child.kill()
+        child.wait()
