@@ -27,7 +27,11 @@ class FilesystemResolver(object):
     (reference fs_utils.py:174-180).
     """
 
-    def __init__(self, dataset_url):
+    def __init__(self, dataset_url, retry_policy=None):
+        """``retry_policy``: a :class:`petastorm_tpu.retry.RetryPolicy`
+        governing transient-error retries on object-store IO; ``None`` =
+        defaults for ``s3://``/``gs://`` (where throttles/resets are expected
+        operating conditions), ``False`` = no retry wrapping."""
         if not isinstance(dataset_url, str):
             raise PetastormTpuError('dataset_url must be a string, got {}'.format(type(dataset_url)))
         dataset_url = dataset_url.rstrip('/')
@@ -38,18 +42,21 @@ class FilesystemResolver(object):
                 '(e.g. file:///tmp/my_dataset), or hdfs://, s3://, gs://.'.format(dataset_url))
         self._url = dataset_url
         self._scheme = parsed.scheme
+        self._retry_policy = retry_policy
         if parsed.scheme == 'file':
             if parsed.netloc not in ('', 'localhost'):
                 raise PetastormTpuError('file:// URL must not have a host: {}'.format(dataset_url))
             self._path = parsed.path
             self._filesystem = pafs.LocalFileSystem()
         elif parsed.scheme in ('gs', 'gcs'):
-            self._filesystem = pafs.GcsFileSystem()
+            self._filesystem = _wrap_object_store(pafs.GcsFileSystem(), retry_policy)
             self._path = parsed.netloc + parsed.path
         elif parsed.scheme == 's3':
-            self._filesystem = pafs.S3FileSystem()
+            self._filesystem = _wrap_object_store(pafs.S3FileSystem(), retry_policy)
             self._path = parsed.netloc + parsed.path
         elif parsed.scheme == 'hdfs':
+            # HDFS elasticity is the HA namenode failover in hdfs/namenode.py,
+            # the reference's model; no backoff wrapper on top
             self._filesystem, self._path = _resolve_hdfs(dataset_url)
         else:
             raise PetastormTpuError('Unsupported URL scheme {!r} in {}'.format(parsed.scheme, dataset_url))
@@ -65,16 +72,28 @@ class FilesystemResolver(object):
         return self._path
 
     def filesystem_factory(self):
-        """A picklable zero-arg callable recreating the filesystem in another
-        process (pyarrow filesystems themselves are picklable in modern Arrow,
-        but a URL-based factory stays robust across versions)."""
-        return _FilesystemFactory(self._url)
+        """A picklable zero-arg callable recreating the filesystem — including
+        the retry policy — in another process (pyarrow filesystems themselves
+        are picklable in modern Arrow, but a URL-based factory stays robust
+        across versions). A custom ``classify`` callable on the policy must be
+        picklable (module-level) to cross a process-pool boundary."""
+        return _FilesystemFactory(self._url, self._retry_policy)
 
     def __getstate__(self):
-        return {'url': self._url}
+        return {'url': self._url, 'retry_policy': self._retry_policy}
 
     def __setstate__(self, state):
-        self.__init__(state['url'])
+        self.__init__(state['url'], retry_policy=state.get('retry_policy'))
+
+
+def _wrap_object_store(fs, retry_policy):
+    """Object stores answer transiently (429/503 throttles, resets) as a
+    normal operating condition: wrap in the bounded-backoff retrier unless
+    explicitly disabled (``retry_policy=False``)."""
+    if retry_policy is False:
+        return fs
+    from petastorm_tpu.retry import wrap_retrying
+    return wrap_retrying(fs, retry_policy)
 
 
 def _resolve_hdfs(dataset_url):
@@ -100,11 +119,12 @@ class _FilesystemFactory(object):
     """Picklable zero-arg filesystem factory (spawned worker processes re-resolve
     the URL instead of shipping a live filesystem handle)."""
 
-    def __init__(self, url):
+    def __init__(self, url, retry_policy=None):
         self._url = url
+        self._retry_policy = retry_policy
 
     def __call__(self):
-        return FilesystemResolver(self._url).filesystem()
+        return FilesystemResolver(self._url, retry_policy=self._retry_policy).filesystem()
 
 
 def path_to_url(path):

@@ -110,7 +110,8 @@ def make_reader(dataset_url,
                 transform_spec=None,
                 ngram=None,
                 output='rows', batch_size=None, drop_last=False,
-                resume_state=None):
+                resume_state=None,
+                storage_retry_policy=None):
     """Reader for datasets written by :func:`materialize_dataset` — rows decoded
     through the stored Unischema's codecs (reference reader.py:50-174).
 
@@ -128,6 +129,9 @@ def make_reader(dataset_url,
         ``index % shard_count == cur_shard``
     :param cache_type/...: 'null' or 'local-disk' row-group cache
     :param ngram: :class:`petastorm_tpu.ngram.NGram` for windowed sequence readout
+    :param storage_retry_policy: :class:`petastorm_tpu.retry.RetryPolicy` for
+        transient object-store (s3/gs) IO errors; ``None`` = sensible defaults,
+        ``False`` = disable retry wrapping. Carried into worker processes.
     :param output: 'rows' (default) yields one schema namedtuple per row —
         reference ``make_reader`` parity; 'columnar' yields one namedtuple of
         decoded column arrays per row group (``batched_output=True``) — the TPU
@@ -182,7 +186,8 @@ def make_reader(dataset_url,
                   num_epochs=num_epochs, cur_shard=cur_shard, shard_count=shard_count,
                   cache=cache, transform_spec=transform_spec, ngram=ngram,
                   columnar_ngram=columnar_ngram,
-                  resume_state=resume_state)
+                  resume_state=resume_state,
+                  storage_retry_policy=storage_retry_policy)
 
 
 def make_batch_reader(dataset_url,
@@ -197,7 +202,8 @@ def make_batch_reader(dataset_url,
                       cache_row_size_estimate=None,
                       transform_spec=None,
                       batch_size=None, drop_last=False,
-                      resume_state=None):
+                      resume_state=None,
+                      storage_retry_policy=None):
     """Columnar reader for ANY Parquet store (reference reader.py:177-289):
     yields one namedtuple of numpy column arrays per row group
     (``batched_output=True``). Schema is inferred from the Arrow schema unless
@@ -223,7 +229,8 @@ def make_batch_reader(dataset_url,
                   predicate=predicate, rowgroup_selector=None,
                   num_epochs=num_epochs, cur_shard=cur_shard, shard_count=shard_count,
                   cache=cache, transform_spec=transform_spec, ngram=None,
-                  resume_state=resume_state)
+                  resume_state=resume_state,
+                  storage_retry_policy=storage_retry_policy)
 
 
 class Reader(object):
@@ -234,7 +241,8 @@ class Reader(object):
                  schema_fields=None, seed=None, shuffle_row_groups=True,
                  shuffle_row_drop_partitions=1, predicate=None, rowgroup_selector=None,
                  num_epochs=1, cur_shard=None, shard_count=None, cache=None,
-                 transform_spec=None, ngram=None, columnar_ngram=False, resume_state=None):
+                 transform_spec=None, ngram=None, columnar_ngram=False, resume_state=None,
+                 storage_retry_policy=None):
         if (cur_shard is None) != (shard_count is None):
             raise ValueError('cur_shard and shard_count must be specified together')
         if cur_shard is not None and not 0 <= cur_shard < shard_count:
@@ -245,7 +253,7 @@ class Reader(object):
 
         self._dataset_url = dataset_url
         self.schema = schema  # full stored/inferred schema
-        resolver = FilesystemResolver(dataset_url)
+        resolver = FilesystemResolver(dataset_url, retry_policy=storage_retry_policy)
         self._dataset_path = resolver.get_dataset_path()
 
         # (2-3) schema view + ngram resolution + transform schema

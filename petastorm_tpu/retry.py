@@ -1,0 +1,270 @@
+"""Transient-storage retry: bounded exponential backoff around object-store IO.
+
+The reference's only storage elasticity is HDFS namenode failover
+(/root/reference/petastorm/hdfs/namenode.py:236-271, mirrored here in
+``hdfs/namenode.py``). Object stores (``s3://``, ``gs://``) fail differently:
+not a standby endpoint to fail over to, but the SAME endpoint answering
+transiently with throttles (429/503 SlowDown), connection resets, and
+timeouts — the expected behavior of a TPU-scale input pipeline hammering GCS
+from many hosts. This module is the cloud-native analog of the failover
+decorator: every filesystem operation and positional read gets a bounded
+exponential-backoff retry with decorrelated jitter, and a fresh underlying
+stream is opened when a read fails mid-flight (SURVEY §2.9 elasticity row).
+
+Policy: retries apply to idempotent operations only — metadata calls, input
+opens and reads. Output streams are NOT retried mid-write (a half-written
+object is not safely resumable); only their open is.
+
+Cost: input files route through ``pa.PythonFile`` so mid-read failures can
+resume on a fresh stream — a per-read Python hop (~µs, GIL-held) on schemes
+where a single network round trip costs milliseconds. The wrapper is applied
+ONLY to s3/gs; local-file reads (the duty-cycle hot path) never see it.
+"""
+
+from __future__ import annotations
+
+import errno
+import logging
+import random
+import re
+import time
+
+import pyarrow as pa
+import pyarrow.fs as pafs
+
+logger = logging.getLogger(__name__)
+
+#: errnos that signal a transient network/storage condition
+_TRANSIENT_ERRNOS = frozenset({
+    errno.EAGAIN, errno.ETIMEDOUT, errno.ECONNRESET, errno.ECONNABORTED,
+    errno.ECONNREFUSED, errno.EPIPE, errno.EHOSTUNREACH, errno.ENETUNREACH,
+    errno.EBUSY,
+})
+
+#: lower-cased substrings of error messages Arrow surfaces for retryable
+#: object-store failures (Arrow folds HTTP-level errors into OSError text)
+_TRANSIENT_MARKERS = (
+    'slow down', 'slowdown', 'slow_down', 'too many requests', 'request rate',
+    'timed out', 'timeout', 'connection reset', 'connection aborted',
+    'connection refused', 'broken pipe', 'temporarily unavailable',
+    'service unavailable', 'internal server error',
+    'bad gateway', 'gateway timeout', 'eof occurred',
+    'curl error', 'throttl',
+)
+
+#: retryable HTTP status codes, matched only in status context — a bare
+#: " 500" would also match byte counts in permanent errors ("got 500 bytes")
+_TRANSIENT_HTTP_RE = re.compile(
+    r'(?:http|status|code|error)\W{0,10}(?:429|500|502|503|504)\b')
+
+
+def is_transient_io_error(exc):
+    """Classify an exception as a retryable transient storage failure.
+
+    Conservative on purpose: FileNotFoundError/PermissionError and schema or
+    parse errors must fail immediately — retrying them only delays the real
+    diagnosis.
+    """
+    if isinstance(exc, (FileNotFoundError, PermissionError, IsADirectoryError,
+                        NotADirectoryError)):
+        return False
+    if isinstance(exc, (ConnectionError, TimeoutError)):
+        return True
+    if isinstance(exc, OSError):
+        if exc.errno in _TRANSIENT_ERRNOS:
+            return True
+        msg = str(exc).lower()
+        return (any(marker in msg for marker in _TRANSIENT_MARKERS)
+                or _TRANSIENT_HTTP_RE.search(msg) is not None)
+    return False
+
+
+class RetryPolicy(object):
+    """Bounded exponential backoff with decorrelated jitter.
+
+    ``max_attempts`` counts the initial try: 4 means up to 3 retries. Sleeps
+    follow ``initial_backoff_s * multiplier**k`` capped at ``max_backoff_s``,
+    each scaled by ``1 ± jitter`` so synchronized workers do not re-stampede
+    the endpoint that just throttled them.
+    """
+
+    def __init__(self, max_attempts=4, initial_backoff_s=0.1, multiplier=2.0,
+                 max_backoff_s=5.0, jitter=0.25, classify=is_transient_io_error):
+        if max_attempts < 1:
+            raise ValueError('max_attempts must be >= 1, got {}'.format(max_attempts))
+        self.max_attempts = max_attempts
+        self.initial_backoff_s = initial_backoff_s
+        self.multiplier = multiplier
+        self.max_backoff_s = max_backoff_s
+        self.jitter = jitter
+        self.classify = classify
+
+    def backoff_s(self, attempt):
+        """Sleep before retry number ``attempt`` (1-based)."""
+        base = min(self.initial_backoff_s * self.multiplier ** (attempt - 1),
+                   self.max_backoff_s)
+        return base * (1.0 + self.jitter * (2.0 * random.random() - 1.0))
+
+    def call(self, fn, *args, on_retry=None, **kwargs):
+        """Invoke ``fn`` with retries per this policy. ``on_retry`` (if given)
+        runs after each backoff sleep, before the re-attempt — e.g. reopening
+        a broken stream."""
+        attempt = 1
+        while True:
+            try:
+                return fn(*args, **kwargs)
+            except Exception as e:  # noqa: BLE001 — classifier decides
+                if attempt >= self.max_attempts or not self.classify(e):
+                    raise
+                sleep_s = self.backoff_s(attempt)
+                logger.warning('Transient storage error (attempt %d/%d, retrying in %.2fs): %s',
+                               attempt, self.max_attempts, sleep_s, e)
+                time.sleep(sleep_s)
+                attempt += 1
+                if on_retry is not None:
+                    on_retry()
+
+
+class _RetryingInputFile(object):
+    """File-like over ``fs.open_input_file`` that survives mid-read transient
+    failures by reopening the underlying stream and seeking back to the last
+    good position. Wrapped in ``pa.PythonFile`` so Arrow/Parquet C++ consume it
+    as a random-access file."""
+
+    def __init__(self, fs, path, policy):
+        self._fs = fs
+        self._path = path
+        self._policy = policy
+        self._pos = 0
+        self._file = policy.call(fs.open_input_file, path)
+        self._size = None
+
+    def _reopen(self):
+        try:
+            self._file.close()
+        except Exception:  # noqa: BLE001 — old handle is already broken
+            pass
+        self._file = self._fs.open_input_file(self._path)
+        self._file.seek(self._pos)
+
+    def _with_stream_retry(self, op):
+        # a failed read leaves the stream in an unknown state: always resume
+        # on a FRESH stream at the last good offset
+        return self._policy.call(op, on_retry=lambda: self._policy.call(self._reopen))
+
+    # --- file protocol consumed by pa.PythonFile ---
+
+    def read(self, nbytes=None):
+        def _do():
+            self._file.seek(self._pos)
+            data = self._file.read(nbytes) if nbytes is not None else self._file.read()
+            return data
+        data = self._with_stream_retry(_do)
+        self._pos += len(data)
+        return data
+
+    def seek(self, offset, whence=0):
+        if whence == 0:
+            self._pos = offset
+        elif whence == 1:
+            self._pos += offset
+        elif whence == 2:
+            self._pos = self.size() + offset
+        else:
+            raise ValueError('invalid whence {}'.format(whence))
+        return self._pos
+
+    def tell(self):
+        return self._pos
+
+    def size(self):
+        if self._size is None:
+            self._size = self._with_stream_retry(lambda: self._file.size())
+        return self._size
+
+    @property
+    def closed(self):
+        return self._file.closed
+
+    def close(self):
+        self._file.close()
+
+
+class RetryingHandler(pafs.FileSystemHandler):
+    """A ``pyarrow.fs.FileSystemHandler`` delegating to another pyarrow
+    filesystem with transient-error retries on idempotent operations.
+
+    Use ``wrap_retrying(fs)`` to obtain a real ``pyarrow.fs.PyFileSystem``
+    usable anywhere a filesystem is (parquet reads, dataset discovery).
+    """
+
+    def __init__(self, fs, policy=None):
+        self.fs = fs
+        self.policy = policy or RetryPolicy()
+
+    def __eq__(self, other):
+        if isinstance(other, RetryingHandler):
+            return self.fs == other.fs
+        return NotImplemented
+
+    def __ne__(self, other):
+        eq = self.__eq__(other)
+        return eq if eq is NotImplemented else not eq
+
+    def get_type_name(self):
+        return 'retrying+' + self.fs.type_name
+
+    def normalize_path(self, path):
+        return self.fs.normalize_path(path)
+
+    def get_file_info(self, paths):
+        return self.policy.call(self.fs.get_file_info, paths)
+
+    def get_file_info_selector(self, selector):
+        return self.policy.call(self.fs.get_file_info, selector)
+
+    def create_dir(self, path, recursive):
+        self.policy.call(self.fs.create_dir, path, recursive=recursive)
+
+    def delete_dir(self, path):
+        self.policy.call(self.fs.delete_dir, path)
+
+    def delete_dir_contents(self, path, missing_dir_ok=False):
+        self.policy.call(self.fs.delete_dir_contents, path, missing_dir_ok=missing_dir_ok)
+
+    def delete_root_dir_contents(self):
+        self.policy.call(self.fs.delete_dir_contents, '/', accept_root_dir=True)
+
+    def delete_file(self, path):
+        self.policy.call(self.fs.delete_file, path)
+
+    def move(self, src, dest):
+        self.policy.call(self.fs.move, src, dest)
+
+    def copy_file(self, src, dest):
+        self.policy.call(self.fs.copy_file, src, dest)
+
+    def open_input_stream(self, path):
+        return pa.PythonFile(_RetryingInputFile(self.fs, path, self.policy), mode='r')
+
+    def open_input_file(self, path):
+        return pa.PythonFile(_RetryingInputFile(self.fs, path, self.policy), mode='r')
+
+    def open_output_stream(self, path, metadata):
+        # retry the OPEN only: a half-written object store upload is not
+        # safely resumable, so mid-write failures must surface.
+        # compression=None: the outer PyFileSystem already applies
+        # suffix-detected compression; the inner default of 'detect' would
+        # stack a second compressor on e.g. *.gz paths
+        return self.policy.call(self.fs.open_output_stream, path,
+                                compression=None, metadata=metadata)
+
+    def open_append_stream(self, path, metadata):
+        return self.policy.call(self.fs.open_append_stream, path,
+                                compression=None, metadata=metadata)
+
+
+def wrap_retrying(fs, policy=None):
+    """Wrap a pyarrow filesystem so transient IO errors are retried with
+    bounded exponential backoff. Returns a genuine ``pyarrow.fs.PyFileSystem``."""
+    return pafs.PyFileSystem(RetryingHandler(fs, policy))

@@ -1,0 +1,342 @@
+"""Transient-storage retry: policy, classifier, and fault-injected reads
+through a flaky pyarrow filesystem (SURVEY §2.9 elasticity; the object-store
+analog of the HDFS failover tests in test_hdfs_namenode.py)."""
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.fs as pafs
+import pyarrow.parquet as pq
+import pytest
+
+from petastorm_tpu.retry import (RetryPolicy, is_transient_io_error, wrap_retrying)
+
+FAST = RetryPolicy(max_attempts=4, initial_backoff_s=0.001, max_backoff_s=0.004)
+
+
+# ---------------------------------------------------------------------------
+# Fault injection: a pyarrow filesystem whose chosen operations fail with a
+# configurable transient error for the first N calls, then delegate for real.
+# ---------------------------------------------------------------------------
+
+class _FlakyFile(object):
+    """File-like that raises on the first ``fail_reads`` read() calls (shared
+    across reopens via the ``counters`` dict), then reads for real."""
+
+    def __init__(self, inner, key, counters, fail_reads, exc_factory):
+        self._inner = inner
+        self._key = key
+        self._counters = counters
+        self._fail_reads = fail_reads
+        self._exc_factory = exc_factory
+
+    def read(self, nbytes=None):
+        n = self._counters.setdefault(self._key, 0)
+        if n < self._fail_reads:
+            self._counters[self._key] = n + 1
+            raise self._exc_factory()
+        return self._inner.read(nbytes) if nbytes is not None else self._inner.read()
+
+    def seek(self, offset, whence=0):
+        return self._inner.seek(offset, whence)
+
+    def tell(self):
+        return self._inner.tell()
+
+    def size(self):
+        return self._inner.size()
+
+    @property
+    def closed(self):
+        return self._inner.closed
+
+    def close(self):
+        self._inner.close()
+
+
+class FlakyHandler(pafs.FileSystemHandler):
+    """Delegates to a real pyarrow filesystem; the first ``fail_opens`` input
+    opens and the first ``fail_reads`` stream reads raise ``exc_factory()``."""
+
+    def __init__(self, fs, fail_opens=0, fail_reads=0,
+                 exc_factory=lambda: OSError('connection reset by peer')):
+        self.fs = fs
+        self.fail_opens = fail_opens
+        self.fail_reads = fail_reads
+        self.exc_factory = exc_factory
+        self.counters = {}
+        self.open_calls = 0
+        self.read_fail_counters = {}
+
+    def __eq__(self, other):
+        return self is other
+
+    def __ne__(self, other):
+        return self is not other
+
+    def get_type_name(self):
+        return 'flaky+' + self.fs.type_name
+
+    def normalize_path(self, path):
+        return self.fs.normalize_path(path)
+
+    def get_file_info(self, paths):
+        return self.fs.get_file_info(paths)
+
+    def get_file_info_selector(self, selector):
+        return self.fs.get_file_info(selector)
+
+    def create_dir(self, path, recursive):
+        self.fs.create_dir(path, recursive=recursive)
+
+    def delete_dir(self, path):
+        self.fs.delete_dir(path)
+
+    def delete_dir_contents(self, path, missing_dir_ok=False):
+        self.fs.delete_dir_contents(path, missing_dir_ok=missing_dir_ok)
+
+    def delete_root_dir_contents(self):
+        self.fs.delete_dir_contents('/', accept_root_dir=True)
+
+    def delete_file(self, path):
+        self.fs.delete_file(path)
+
+    def move(self, src, dest):
+        self.fs.move(src, dest)
+
+    def copy_file(self, src, dest):
+        self.fs.copy_file(src, dest)
+
+    def _open(self, path):
+        self.open_calls += 1
+        if self.open_calls <= self.fail_opens:
+            raise self.exc_factory()
+        inner = self.fs.open_input_file(path)
+        return pa.PythonFile(
+            _FlakyFile(inner, path, self.read_fail_counters, self.fail_reads,
+                       self.exc_factory), mode='r')
+
+    def open_input_stream(self, path):
+        return self._open(path)
+
+    def open_input_file(self, path):
+        return self._open(path)
+
+    def open_output_stream(self, path, metadata):
+        return self.fs.open_output_stream(path, metadata=metadata)
+
+    def open_append_stream(self, path, metadata):
+        return self.fs.open_append_stream(path, metadata=metadata)
+
+
+def _flaky_fs(**kwargs):
+    handler = FlakyHandler(pafs.LocalFileSystem(), **kwargs)
+    return pafs.PyFileSystem(handler), handler
+
+
+def _write_table(path, rows=500):
+    table = pa.table({'id': np.arange(rows, dtype=np.int64),
+                      'payload': np.random.default_rng(1).random(rows)})
+    pq.write_table(table, path)
+    return table
+
+
+# ---------------------------------------------------------------------------
+# Classifier
+# ---------------------------------------------------------------------------
+
+def test_classifier_transient_cases():
+    assert is_transient_io_error(ConnectionResetError('peer'))
+    assert is_transient_io_error(TimeoutError())
+    assert is_transient_io_error(OSError('AWS Error SLOW_DOWN during GetObject'))
+    assert is_transient_io_error(OSError('HTTP 503 Service Unavailable'))
+    assert is_transient_io_error(OSError('When reading gs://b/o: curl error 56'))
+    import errno
+    assert is_transient_io_error(OSError(errno.ECONNRESET, 'reset'))
+
+
+def test_classifier_permanent_cases():
+    assert not is_transient_io_error(FileNotFoundError('gone'))
+    assert not is_transient_io_error(PermissionError('denied'))
+    assert not is_transient_io_error(ValueError('bad parquet magic'))
+    assert not is_transient_io_error(OSError('Invalid Parquet file size'))
+    # numbers that are NOT http statuses must not trip the status markers
+    assert not is_transient_io_error(OSError('Unexpected end of stream: got 500 bytes, expected 4096'))
+    assert not is_transient_io_error(OSError('Max retries exceeded with url'))
+
+
+# ---------------------------------------------------------------------------
+# Policy
+# ---------------------------------------------------------------------------
+
+def test_policy_retries_then_succeeds():
+    calls = {'n': 0}
+
+    def flaky():
+        calls['n'] += 1
+        if calls['n'] < 3:
+            raise OSError('connection reset by peer')
+        return 'ok'
+
+    assert FAST.call(flaky) == 'ok'
+    assert calls['n'] == 3
+
+
+def test_policy_exhausts_and_raises_original():
+    def always():
+        raise OSError('HTTP 503 Service Unavailable')
+
+    with pytest.raises(OSError, match='503'):
+        FAST.call(always)
+
+
+def test_policy_permanent_error_not_retried():
+    calls = {'n': 0}
+
+    def notfound():
+        calls['n'] += 1
+        raise FileNotFoundError('nope')
+
+    with pytest.raises(FileNotFoundError):
+        FAST.call(notfound)
+    assert calls['n'] == 1
+
+
+def test_policy_backoff_bounded():
+    p = RetryPolicy(max_attempts=10, initial_backoff_s=0.1, multiplier=2.0,
+                    max_backoff_s=0.5, jitter=0.25)
+    for attempt in range(1, 10):
+        s = p.backoff_s(attempt)
+        assert 0 < s <= 0.5 * 1.25 + 1e-9
+
+
+def test_policy_validates():
+    with pytest.raises(ValueError):
+        RetryPolicy(max_attempts=0)
+
+
+# ---------------------------------------------------------------------------
+# Filesystem wrapper: real parquet reads through injected faults
+# ---------------------------------------------------------------------------
+
+def test_parquet_read_survives_flaky_opens(tmp_path):
+    path = str(tmp_path / 'data.parquet')
+    expected = _write_table(path)
+    flaky, handler = _flaky_fs(fail_opens=2)
+    fs = wrap_retrying(flaky, FAST)
+    got = pq.ParquetFile(fs.open_input_file(path)).read()
+    assert got.equals(expected)
+    assert handler.open_calls >= 3  # 2 failures + >=1 success
+
+
+def test_parquet_read_survives_midstream_failures(tmp_path):
+    path = str(tmp_path / 'data.parquet')
+    expected = _write_table(path)
+    flaky, handler = _flaky_fs(fail_reads=2)
+    fs = wrap_retrying(flaky, FAST)
+    got = pq.ParquetFile(fs.open_input_file(path)).read()
+    assert got.equals(expected)
+    assert handler.read_fail_counters  # faults were actually injected
+
+
+def test_permanent_error_propagates_through_wrapper(tmp_path):
+    flaky, _ = _flaky_fs()
+    fs = wrap_retrying(flaky, FAST)
+    with pytest.raises(FileNotFoundError):
+        fs.open_input_file(str(tmp_path / 'missing.parquet')).read()
+
+
+def test_exhausted_retries_raise_last_error(tmp_path):
+    path = str(tmp_path / 'data.parquet')
+    _write_table(path)
+    flaky, _ = _flaky_fs(fail_opens=50)
+    fs = wrap_retrying(flaky, FAST)
+    with pytest.raises(OSError, match='connection reset'):
+        fs.open_input_file(path)
+
+
+def test_metadata_ops_retried(tmp_path):
+    path = str(tmp_path / 'data.parquet')
+    _write_table(path)
+
+    calls = {'n': 0}
+
+    class FlakyInfoHandler(FlakyHandler):
+        def get_file_info(self, paths):
+            calls['n'] += 1
+            if calls['n'] <= 2:
+                raise OSError('HTTP 429 Too Many Requests')
+            return super(FlakyInfoHandler, self).get_file_info(paths)
+
+    fs = wrap_retrying(pafs.PyFileSystem(FlakyInfoHandler(pafs.LocalFileSystem())), FAST)
+    info = fs.get_file_info([path])[0]
+    assert info.type == pafs.FileType.File
+    assert calls['n'] == 3
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: make_reader over a flaky "object store"
+# ---------------------------------------------------------------------------
+
+def test_make_reader_survives_flaky_object_store(tmp_path, monkeypatch):
+    """A full reader run over a gs:// URL whose filesystem drops the first
+    opens and mid-stream reads: the resolver's default retry wrapping must
+    deliver every row exactly once, with the user's policy honored via
+    ``make_reader(storage_retry_policy=...)``."""
+    from petastorm_tpu import make_reader
+    from petastorm_tpu.codecs import ScalarCodec
+    from petastorm_tpu.etl.dataset_metadata import write_petastorm_dataset
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+
+    schema = Unischema('S', [UnischemaField('id', np.int64, (), ScalarCodec(), False)])
+    local_url = 'file://' + str(tmp_path / 'ds')
+    write_petastorm_dataset(local_url, schema, ({'id': i} for i in range(100)),
+                            rows_per_row_group=25)
+
+    handlers = []
+
+    def fake_gcs(*args, **kwargs):
+        # "gs://<netloc>/<path>" resolves to netloc+path, a root-relative local
+        # path: serve it from / with injected faults
+        h = FlakyHandler(pafs.SubTreeFileSystem('/', pafs.LocalFileSystem()),
+                         fail_opens=1, fail_reads=1)
+        handlers.append(h)
+        return pafs.PyFileSystem(h)
+
+    import petastorm_tpu.fs as fs_mod
+    monkeypatch.setattr(fs_mod.pafs, 'GcsFileSystem', fake_gcs)
+
+    gs_url = 'gs:/' + str(tmp_path / 'ds')  # gs://<tmp_path>/ds
+    with make_reader(gs_url, reader_pool_type='dummy', shuffle_row_groups=False,
+                     num_epochs=1, storage_retry_policy=FAST) as r:
+        ids = sorted(row.id for row in r)
+    assert ids == list(range(100))
+    assert any(h.open_calls > 0 for h in handlers)
+
+
+def test_retry_policy_survives_factory_pickle():
+    """The resolver's picklable filesystem factory must carry the user's
+    policy into worker processes — a tuned/disabled policy silently reverting
+    to defaults in workers was a reviewed failure mode."""
+    import pickle
+    from petastorm_tpu.fs import FilesystemResolver
+
+    policy = RetryPolicy(max_attempts=7, initial_backoff_s=0.01)
+    resolver = FilesystemResolver('file:///tmp/x', retry_policy=policy)
+    factory = pickle.loads(pickle.dumps(resolver.filesystem_factory()))
+    assert factory._retry_policy.max_attempts == 7
+    # and through resolver pickling itself
+    r2 = pickle.loads(pickle.dumps(resolver))
+    assert r2._retry_policy.max_attempts == 7
+
+
+def test_retry_policy_false_disables_wrapping(monkeypatch):
+    import petastorm_tpu.fs as fs_mod
+
+    local = pafs.LocalFileSystem()
+    monkeypatch.setattr(fs_mod.pafs, 'GcsFileSystem', lambda *a, **k: local)
+    wrapped = fs_mod.FilesystemResolver('gs://bucket/ds').filesystem()
+    assert wrapped.type_name.startswith('py::retrying+')
+    raw = fs_mod.FilesystemResolver('gs://bucket/ds', retry_policy=False).filesystem()
+    assert raw is local
