@@ -1,0 +1,84 @@
+"""Train a softmax-regression MNIST model from a petastorm_tpu dataset with
+TensorFlow (reference examples/mnist/tf_example.py, re-done for TF2 eager:
+the reference fed a TF1 session via ``tf_tensors`` + ``tf.train.batch``; here
+``make_petastorm_dataset`` feeds the same model through ``tf.data``).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+from petastorm_tpu import make_reader
+from petastorm_tpu.tf_utils import make_petastorm_dataset
+
+
+def train_and_test(dataset_url, training_iterations=100, batch_size=100,
+                   evaluation_interval=50, shuffle_buffer_size=256, seed=0):
+    """Train for ``training_iterations`` batches, printing test accuracy every
+    ``evaluation_interval`` steps; returns the final accuracy."""
+    import tensorflow as tf
+
+    w = tf.Variable(tf.zeros([784, 10]))
+    b = tf.Variable(tf.zeros([10]))
+    optimizer = tf.keras.optimizers.SGD(learning_rate=0.5)
+
+    @tf.function
+    def train_step(images, labels):
+        with tf.GradientTape() as tape:
+            logits = tf.matmul(images, w) + b
+            loss = tf.reduce_mean(
+                tf.nn.sparse_softmax_cross_entropy_with_logits(labels=labels, logits=logits))
+        grads = tape.gradient(loss, [w, b])
+        optimizer.apply_gradients(zip(grads, [w, b]))
+        return loss
+
+    @tf.function
+    def accuracy(images, labels):
+        logits = tf.matmul(images, w) + b
+        correct = tf.equal(tf.argmax(logits, 1), labels)
+        return tf.reduce_mean(tf.cast(correct, tf.float32))
+
+    def _as_batch(row_batch):
+        images = tf.cast(tf.reshape(row_batch.image, [-1, 784]), tf.float32) / 255.0
+        labels = tf.cast(row_batch.digit, tf.int64)
+        return images, labels
+
+    final_accuracy = 0.0
+    with make_reader(dataset_url + '/train', num_epochs=None, seed=seed) as train_reader:
+        train_ds = (make_petastorm_dataset(train_reader,
+                                           shuffle_buffer_size=shuffle_buffer_size, seed=seed)
+                    .batch(batch_size))
+        for step, row_batch in enumerate(train_ds):
+            if step >= training_iterations:
+                break
+            images, labels = _as_batch(row_batch)
+            loss = train_step(images, labels)
+            if (step + 1) % evaluation_interval == 0 or step + 1 == training_iterations:
+                with make_reader(dataset_url + '/test', num_epochs=1) as test_reader:
+                    test_ds = make_petastorm_dataset(test_reader).batch(batch_size)
+                    accs, weights = [], []
+                    for test_batch in test_ds:
+                        t_images, t_labels = _as_batch(test_batch)
+                        accs.append(float(accuracy(t_images, t_labels)))
+                        weights.append(int(t_labels.shape[0]))
+                final_accuracy = float(np.average(accs, weights=weights))
+                print('step {}: loss={:.4f} test accuracy={:.3f}'.format(
+                    step + 1, float(loss), final_accuracy))
+    return final_accuracy
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('--dataset-url', default='file:///tmp/mnist_dataset')
+    parser.add_argument('--training-iterations', type=int, default=100)
+    parser.add_argument('--batch-size', type=int, default=100)
+    parser.add_argument('--evaluation-interval', type=int, default=50)
+    args = parser.parse_args()
+    train_and_test(args.dataset_url, args.training_iterations, args.batch_size,
+                   args.evaluation_interval)
+
+
+if __name__ == '__main__':
+    main()

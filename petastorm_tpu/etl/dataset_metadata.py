@@ -320,9 +320,9 @@ def read_metadata_value(dataset_url, key):
     return read_metadata_dict(dataset_url).get(key)
 
 
-def read_metadata_dict(dataset_url):
+def read_metadata_dict(dataset_url, retry_policy=None):
     """All KV metadata from _common_metadata as a dict (one footer fetch)."""
-    resolver = FilesystemResolver(dataset_url)
+    resolver = FilesystemResolver(dataset_url, retry_policy=retry_policy)
     fs, root = resolver.filesystem(), resolver.get_dataset_path()
     arrow_schema = _read_common_metadata(fs, root)
     if arrow_schema is None or not arrow_schema.metadata:
@@ -383,7 +383,7 @@ def _partition_keys_from_relpath(relpath, schema=None):
 
 
 def load_row_groups(dataset_url, schema=None, max_footer_read_threads=10,
-                    use_cached_metadata=True):
+                    use_cached_metadata=True, retry_policy=None):
     """List all row-group pieces of the dataset with the reference's three-way
     fallback (etl/dataset_metadata.py:231-336):
 
@@ -395,7 +395,7 @@ def load_row_groups(dataset_url, schema=None, max_footer_read_threads=10,
     file footers — the ground truth when stored metadata may be stale (e.g. the
     generate-metadata tool retrofitting a store rewritten by another tool).
     """
-    resolver = FilesystemResolver(dataset_url)
+    resolver = FilesystemResolver(dataset_url, retry_policy=retry_policy)
     fs, root = resolver.filesystem(), resolver.get_dataset_path()
     arrow_meta_schema = _read_common_metadata(fs, root)  # single read serves schema + counts
     meta = (arrow_meta_schema.metadata or {}) if arrow_meta_schema is not None else {}
@@ -478,10 +478,10 @@ def _try_get_schema(fs, root):
     return None
 
 
-def get_schema(dataset_url):
+def get_schema(dataset_url, retry_policy=None):
     """Load the stored Unischema; raise if the dataset is not a petastorm_tpu
     dataset (reference etl/dataset_metadata.py:339-368)."""
-    resolver = FilesystemResolver(dataset_url)
+    resolver = FilesystemResolver(dataset_url, retry_policy=retry_policy)
     schema = _try_get_schema(resolver.filesystem(), resolver.get_dataset_path())
     if schema is None:
         raise PetastormMetadataError(
@@ -495,11 +495,11 @@ def get_schema_from_dataset_url(dataset_url):
     return get_schema(dataset_url)
 
 
-def infer_or_load_unischema(dataset_url):
+def infer_or_load_unischema(dataset_url, retry_policy=None):
     """Load the stored schema, else infer one from the Parquet/Arrow schema
     (reference etl/dataset_metadata.py:389-397). Hive partition columns are
     included in the inferred schema."""
-    resolver = FilesystemResolver(dataset_url)
+    resolver = FilesystemResolver(dataset_url, retry_policy=retry_policy)
     fs, root = resolver.filesystem(), resolver.get_dataset_path()
     schema = _try_get_schema(fs, root)
     if schema is not None:

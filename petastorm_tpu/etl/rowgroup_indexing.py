@@ -66,10 +66,10 @@ def build_rowgroup_index(dataset_url, indexers, max_workers=10):
     return combined
 
 
-def get_row_group_indexes(dataset_url):
+def get_row_group_indexes(dataset_url, retry_policy=None):
     """Load the stored indexes: dict index_name -> indexer
     (reference rowgroup_indexing.py:138-160)."""
-    meta = dataset_metadata.read_metadata_dict(dataset_url)  # one footer fetch serves both keys
+    meta = dataset_metadata.read_metadata_dict(dataset_url, retry_policy=retry_policy)  # one footer fetch serves both keys
     raw = meta.get(dataset_metadata.ROW_GROUP_INDEX_KEY)
     if raw is None:
         from petastorm_tpu.etl import legacy

@@ -272,61 +272,22 @@ def as_pyarrow_filesystem(ha_client):
     Every handler call rides the HA proxy, so failover still applies."""
     import pyarrow.fs as pafs
 
-    class _HaHandler(pafs.FileSystemHandler):
-        def __init__(self, client):
-            self.client = client
+    from petastorm_tpu.pafs_util import DelegatingHandler
+
+    class _HaHandler(DelegatingHandler):
+        # self.fs is the HAHdfsClient: same method surface as a pyarrow
+        # filesystem (its __getattr__ proxies the live HadoopFileSystem with
+        # failover), so the shared delegation base applies verbatim
 
         def get_type_name(self):
             return 'ha-hdfs'
 
         def __eq__(self, other):
             return isinstance(other, _HaHandler) and \
-                self.client._list_of_namenodes == other.client._list_of_namenodes
+                self.fs._list_of_namenodes == other.fs._list_of_namenodes
 
         def __ne__(self, other):
             return not self.__eq__(other)
-
-        def get_file_info(self, paths):
-            return self.client.get_file_info(paths)
-
-        def get_file_info_selector(self, selector):
-            return self.client.get_file_info(selector)
-
-        def create_dir(self, path, recursive):
-            self.client.create_dir(path, recursive=recursive)
-
-        def delete_dir(self, path):
-            self.client.delete_dir(path)
-
-        def delete_dir_contents(self, path, missing_dir_ok=False):
-            self.client.delete_dir_contents(path, missing_dir_ok=missing_dir_ok)
-
-        def delete_root_dir_contents(self):
-            self.client.delete_dir_contents('/', accept_root_dir=True)
-
-        def delete_file(self, path):
-            self.client.delete_file(path)
-
-        def move(self, src, dest):
-            self.client.move(src, dest)
-
-        def copy_file(self, src, dest):
-            self.client.copy_file(src, dest)
-
-        def open_input_stream(self, path):
-            return self.client.open_input_stream(path)
-
-        def open_input_file(self, path):
-            return self.client.open_input_file(path)
-
-        def open_output_stream(self, path, metadata):
-            return self.client.open_output_stream(path, metadata=metadata)
-
-        def open_append_stream(self, path, metadata):
-            return self.client.open_append_stream(path, metadata=metadata)
-
-        def normalize_path(self, path):
-            return self.client.normalize_path(path)
 
     return pafs.PyFileSystem(_HaHandler(ha_client))
 

@@ -148,7 +148,7 @@ def make_reader(dataset_url,
         from a checkpointed position (construct with otherwise-identical args)
     """
     try:
-        schema = dataset_metadata.get_schema(dataset_url)
+        schema = dataset_metadata.get_schema(dataset_url, retry_policy=storage_retry_policy)
     except dataset_metadata.PetastormMetadataError:
         raise PetastormTpuError(
             'Dataset at {} is missing unischema metadata. If it is a plain Parquet store, '
@@ -215,7 +215,8 @@ def make_batch_reader(dataset_url,
     pyarrow_helpers/batching_table_queue.py:20-79, SURVEY.md §2.6). The final
     short batch is emitted unless ``drop_last``.
     """
-    schema = dataset_metadata.infer_or_load_unischema(dataset_url)
+    schema = dataset_metadata.infer_or_load_unischema(dataset_url,
+                                                      retry_policy=storage_retry_policy)
     cache = _make_cache(cache_type, cache_location, cache_size_limit, cache_row_size_estimate)
     pool = _make_pool(reader_pool_type, workers_count, results_queue_size)
     results_queue_reader_factory = _columnar_results_reader_factory(
@@ -278,9 +279,11 @@ class Reader(object):
 
         # (4) list pieces and filter: selector (index sets refer to the ORIGINAL
         # load_row_groups enumeration, so it must run first) -> predicate -> shard
-        pieces = dataset_metadata.load_row_groups(dataset_url, schema=schema)
+        pieces = dataset_metadata.load_row_groups(dataset_url, schema=schema,
+                                                  retry_policy=storage_retry_policy)
         if rowgroup_selector is not None:
-            pieces = self._apply_rowgroup_selector(dataset_url, pieces, rowgroup_selector)
+            pieces = self._apply_rowgroup_selector(dataset_url, pieces, rowgroup_selector,
+                                                   storage_retry_policy)
         pieces, worker_predicate = self._apply_predicate_to_pieces(pieces, predicate)
         pieces = self._partition_pieces(pieces, cur_shard, shard_count)
         if not pieces:
@@ -353,11 +356,11 @@ class Reader(object):
         return pieces, predicate
 
     @staticmethod
-    def _apply_rowgroup_selector(dataset_url, pieces, selector):
+    def _apply_rowgroup_selector(dataset_url, pieces, selector, retry_policy=None):
         """Filter pieces through precomputed row-group indexes
         (reference reader.py:504-523). Selector indexes refer to the unfiltered
         piece enumeration, so this runs before sharding."""
-        indexes = get_row_group_indexes(dataset_url)
+        indexes = get_row_group_indexes(dataset_url, retry_policy=retry_policy)
         for name in selector.get_index_names():
             if name not in indexes:
                 raise PetastormTpuError('Index {!r} does not exist in the dataset'.format(name))

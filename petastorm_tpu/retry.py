@@ -12,8 +12,10 @@ exponential-backoff retry with decorrelated jitter, and a fresh underlying
 stream is opened when a read fails mid-flight (SURVEY §2.9 elasticity row).
 
 Policy: retries apply to idempotent operations only — metadata calls, input
-opens and reads. Output streams are NOT retried mid-write (a half-written
-object is not safely resumable); only their open is.
+opens and reads, plus create_dir/copy_file (re-running converges). Deletes
+and moves pass through unretried (success-then-lost-response would make the
+retry raise a spurious FileNotFoundError). Output streams are NOT retried
+mid-write (a half-written object is not safely resumable); only their open is.
 
 Cost: input files route through ``pa.PythonFile`` so mid-read failures can
 resume on a fresh stream — a per-read Python hop (~µs, GIL-held) on schemes
@@ -31,6 +33,8 @@ import time
 
 import pyarrow as pa
 import pyarrow.fs as pafs
+
+from petastorm_tpu.pafs_util import DelegatingHandler
 
 logger = logging.getLogger(__name__)
 
@@ -190,78 +194,53 @@ class _RetryingInputFile(object):
         self._file.close()
 
 
-class RetryingHandler(pafs.FileSystemHandler):
+class RetryingHandler(DelegatingHandler):
     """A ``pyarrow.fs.FileSystemHandler`` delegating to another pyarrow
-    filesystem with transient-error retries on idempotent operations.
+    filesystem with transient-error retries on idempotent operations: every
+    delegated op retries per the policy; input opens additionally return
+    mid-read-resumable streams; output streams retry the OPEN only (a
+    half-written object store upload is not safely resumable, so mid-write
+    failures must surface).
 
     Use ``wrap_retrying(fs)`` to obtain a real ``pyarrow.fs.PyFileSystem``
     usable anywhere a filesystem is (parquet reads, dataset discovery).
     """
 
     def __init__(self, fs, policy=None):
-        self.fs = fs
+        super(RetryingHandler, self).__init__(fs)
         self.policy = policy or RetryPolicy()
 
-    def __eq__(self, other):
-        if isinstance(other, RetryingHandler):
-            return self.fs == other.fs
-        return NotImplemented
-
-    def __ne__(self, other):
-        eq = self.__eq__(other)
-        return eq if eq is NotImplemented else not eq
+    def _invoke(self, fn, *args, **kwargs):
+        return self.policy.call(fn, *args, **kwargs)
 
     def get_type_name(self):
         return 'retrying+' + self.fs.type_name
 
-    def normalize_path(self, path):
-        return self.fs.normalize_path(path)
-
-    def get_file_info(self, paths):
-        return self.policy.call(self.fs.get_file_info, paths)
-
-    def get_file_info_selector(self, selector):
-        return self.policy.call(self.fs.get_file_info, selector)
-
-    def create_dir(self, path, recursive):
-        self.policy.call(self.fs.create_dir, path, recursive=recursive)
-
-    def delete_dir(self, path):
-        self.policy.call(self.fs.delete_dir, path)
-
-    def delete_dir_contents(self, path, missing_dir_ok=False):
-        self.policy.call(self.fs.delete_dir_contents, path, missing_dir_ok=missing_dir_ok)
-
-    def delete_root_dir_contents(self):
-        self.policy.call(self.fs.delete_dir_contents, '/', accept_root_dir=True)
+    # non-idempotent mutations pass through UNretried: if the server performed
+    # the op but the response was lost, a retry would surface a spurious
+    # FileNotFoundError for an operation that actually succeeded. (create_dir
+    # and copy_file stay retried — re-running them converges to the same state.)
 
     def delete_file(self, path):
-        self.policy.call(self.fs.delete_file, path)
+        self.fs.delete_file(path)
+
+    def delete_dir(self, path):
+        self.fs.delete_dir(path)
+
+    def delete_dir_contents(self, path, missing_dir_ok=False):
+        self.fs.delete_dir_contents(path, missing_dir_ok=missing_dir_ok)
+
+    def delete_root_dir_contents(self):
+        self.fs.delete_dir_contents('/', accept_root_dir=True)
 
     def move(self, src, dest):
-        self.policy.call(self.fs.move, src, dest)
-
-    def copy_file(self, src, dest):
-        self.policy.call(self.fs.copy_file, src, dest)
+        self.fs.move(src, dest)
 
     def open_input_stream(self, path):
         return pa.PythonFile(_RetryingInputFile(self.fs, path, self.policy), mode='r')
 
     def open_input_file(self, path):
         return pa.PythonFile(_RetryingInputFile(self.fs, path, self.policy), mode='r')
-
-    def open_output_stream(self, path, metadata):
-        # retry the OPEN only: a half-written object store upload is not
-        # safely resumable, so mid-write failures must surface.
-        # compression=None: the outer PyFileSystem already applies
-        # suffix-detected compression; the inner default of 'detect' would
-        # stack a second compressor on e.g. *.gz paths
-        return self.policy.call(self.fs.open_output_stream, path,
-                                compression=None, metadata=metadata)
-
-    def open_append_stream(self, path, metadata):
-        return self.policy.call(self.fs.open_append_stream, path,
-                                compression=None, metadata=metadata)
 
 
 def wrap_retrying(fs, policy=None):

@@ -73,6 +73,14 @@ def test_mnist_pytorch_training(mnist_url):
     train_and_test(mnist_url, batch_size=16, epochs=1)
 
 
+def test_mnist_tf_training(mnist_url):
+    pytest.importorskip('tensorflow')
+    from examples.mnist.tf_example import train_and_test
+    acc = train_and_test(mnist_url, training_iterations=6, batch_size=16,
+                         evaluation_interval=6, shuffle_buffer_size=64)
+    assert 0.0 <= acc <= 1.0
+
+
 def test_imagenet_synthetic_generate_and_read(tmp_path):
     import jax
     import jax.numpy as jnp

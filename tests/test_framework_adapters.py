@@ -62,6 +62,32 @@ class TestTfDataset:
         assert rows[0].matrix.shape == (32, 16, 3)
         assert int(rows[0].id) == 0
 
+    def test_tf1_session_migration_recipe(self, synthetic_dataset):
+        """The documented tf_tensors replacement (PARITY.md §2.6, ref
+        tf_utils.py:289-338) must actually run: a TF1 ``Session`` pulling
+        tensors per ``session.run`` from
+        ``tf.compat.v1.data.make_one_shot_iterator(make_petastorm_dataset(r))``,
+        including the shuffle the reference's RandomShuffleQueue provided."""
+        tf = pytest.importorskip('tensorflow')
+        from petastorm_tpu.tf_utils import make_petastorm_dataset
+        with make_reader(synthetic_dataset.url, reader_pool_type='dummy',
+                         schema_fields=['id', 'matrix'], shuffle_row_groups=False,
+                         num_epochs=1) as reader:
+            with tf.Graph().as_default():
+                ds = make_petastorm_dataset(reader, shuffle_buffer_size=20, seed=3)
+                readout = tf.compat.v1.data.make_one_shot_iterator(ds).get_next()
+                ids = []
+                with tf.compat.v1.Session() as sess:
+                    while True:
+                        try:
+                            row = sess.run(readout)
+                        except tf.errors.OutOfRangeError:
+                            break
+                        ids.append(int(row.id))
+                        assert row.matrix.shape == (32, 16, 3)
+        assert sorted(ids) == list(range(100))  # every row, exactly once
+        assert ids != sorted(ids)  # the queue-style shuffle actually shuffled
+
     def test_batched_reader_dataset(self, scalar_dataset):
         tf = pytest.importorskip('tensorflow')
         from petastorm_tpu.tf_utils import make_petastorm_dataset

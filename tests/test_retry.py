@@ -10,6 +10,7 @@ import pyarrow.fs as pafs
 import pyarrow.parquet as pq
 import pytest
 
+from petastorm_tpu.pafs_util import DelegatingHandler
 from petastorm_tpu.retry import (RetryPolicy, is_transient_io_error, wrap_retrying)
 
 FAST = RetryPolicy(max_attempts=4, initial_backoff_s=0.001, max_backoff_s=0.004)
@@ -55,17 +56,16 @@ class _FlakyFile(object):
         self._inner.close()
 
 
-class FlakyHandler(pafs.FileSystemHandler):
+class FlakyHandler(DelegatingHandler):
     """Delegates to a real pyarrow filesystem; the first ``fail_opens`` input
     opens and the first ``fail_reads`` stream reads raise ``exc_factory()``."""
 
     def __init__(self, fs, fail_opens=0, fail_reads=0,
                  exc_factory=lambda: OSError('connection reset by peer')):
-        self.fs = fs
+        super(FlakyHandler, self).__init__(fs)
         self.fail_opens = fail_opens
         self.fail_reads = fail_reads
         self.exc_factory = exc_factory
-        self.counters = {}
         self.open_calls = 0
         self.read_fail_counters = {}
 
@@ -77,36 +77,6 @@ class FlakyHandler(pafs.FileSystemHandler):
 
     def get_type_name(self):
         return 'flaky+' + self.fs.type_name
-
-    def normalize_path(self, path):
-        return self.fs.normalize_path(path)
-
-    def get_file_info(self, paths):
-        return self.fs.get_file_info(paths)
-
-    def get_file_info_selector(self, selector):
-        return self.fs.get_file_info(selector)
-
-    def create_dir(self, path, recursive):
-        self.fs.create_dir(path, recursive=recursive)
-
-    def delete_dir(self, path):
-        self.fs.delete_dir(path)
-
-    def delete_dir_contents(self, path, missing_dir_ok=False):
-        self.fs.delete_dir_contents(path, missing_dir_ok=missing_dir_ok)
-
-    def delete_root_dir_contents(self):
-        self.fs.delete_dir_contents('/', accept_root_dir=True)
-
-    def delete_file(self, path):
-        self.fs.delete_file(path)
-
-    def move(self, src, dest):
-        self.fs.move(src, dest)
-
-    def copy_file(self, src, dest):
-        self.fs.copy_file(src, dest)
 
     def _open(self, path):
         self.open_calls += 1
@@ -122,12 +92,6 @@ class FlakyHandler(pafs.FileSystemHandler):
 
     def open_input_file(self, path):
         return self._open(path)
-
-    def open_output_stream(self, path, metadata):
-        return self.fs.open_output_stream(path, metadata=metadata)
-
-    def open_append_stream(self, path, metadata):
-        return self.fs.open_append_stream(path, metadata=metadata)
 
 
 def _flaky_fs(**kwargs):
@@ -331,6 +295,30 @@ def test_retry_policy_survives_factory_pickle():
     assert r2._retry_policy.max_attempts == 7
 
 
+def test_retry_policy_false_reaches_discovery_path(tmp_path, monkeypatch):
+    """storage_retry_policy=False must disable retries EVERYWHERE, including
+    schema/row-group discovery — a transient failure during get_schema then
+    surfaces immediately instead of silently retrying with defaults."""
+    from petastorm_tpu import make_reader
+    from petastorm_tpu.codecs import ScalarCodec
+    from petastorm_tpu.etl.dataset_metadata import write_petastorm_dataset
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+
+    schema = Unischema('S', [UnischemaField('id', np.int64, (), ScalarCodec(), False)])
+    write_petastorm_dataset('file://' + str(tmp_path / 'ds'),
+                            schema, ({'id': i} for i in range(10)), rows_per_row_group=5)
+
+    import petastorm_tpu.fs as fs_mod
+    monkeypatch.setattr(
+        fs_mod.pafs, 'GcsFileSystem',
+        lambda *a, **k: pafs.PyFileSystem(FlakyHandler(
+            pafs.SubTreeFileSystem('/', pafs.LocalFileSystem()), fail_opens=1)))
+
+    gs_url = 'gs:/' + str(tmp_path / 'ds')
+    with pytest.raises(OSError, match='connection reset'):
+        make_reader(gs_url, reader_pool_type='dummy', storage_retry_policy=False)
+
+
 def test_retry_policy_false_disables_wrapping(monkeypatch):
     import petastorm_tpu.fs as fs_mod
 
@@ -340,3 +328,27 @@ def test_retry_policy_false_disables_wrapping(monkeypatch):
     assert wrapped.type_name.startswith('py::retrying+')
     raw = fs_mod.FilesystemResolver('gs://bucket/ds', retry_policy=False).filesystem()
     assert raw is local
+
+def test_mutating_ops_not_retried(tmp_path):
+    """Deletes/moves must pass through unretried: a lost success response would
+    otherwise resurface as a spurious FileNotFoundError on the retry."""
+    calls = {'delete': 0, 'move': 0}
+
+    class CountingHandler(DelegatingHandler):
+        def get_type_name(self):
+            return 'counting+' + self.fs.type_name
+
+        def delete_file(self, path):
+            calls['delete'] += 1
+            raise OSError('connection reset by peer')
+
+        def move(self, src, dest):
+            calls['move'] += 1
+            raise OSError('connection reset by peer')
+
+    fs = wrap_retrying(pafs.PyFileSystem(CountingHandler(pafs.LocalFileSystem())), FAST)
+    with pytest.raises(OSError):
+        fs.delete_file(str(tmp_path / 'x'))
+    with pytest.raises(OSError):
+        fs.move(str(tmp_path / 'a'), str(tmp_path / 'b'))
+    assert calls == {'delete': 1, 'move': 1}  # exactly one attempt each
