@@ -49,10 +49,9 @@ def train_and_test(dataset_url, training_iterations=100, batch_size=100,
     with make_reader(dataset_url + '/train', num_epochs=None, seed=seed) as train_reader:
         train_ds = (make_petastorm_dataset(train_reader,
                                            shuffle_buffer_size=shuffle_buffer_size, seed=seed)
-                    .batch(batch_size))
+                    .batch(batch_size)
+                    .take(training_iterations))
         for step, row_batch in enumerate(train_ds):
-            if step >= training_iterations:
-                break
             images, labels = _as_batch(row_batch)
             loss = train_step(images, labels)
             if (step + 1) % evaluation_interval == 0 or step + 1 == training_iterations:

@@ -209,5 +209,7 @@ def test_dataset_as_dataframe(tmp_path):
     assert sorted(frame['id'].tolist()) == list(range(30))
 
 
-def test_dataset_as_rdd_requires_pyspark(tmp_path):
-    pytest.importorskip('pyspark', reason='pyspark not installed')
+# The pyspark-gated surfaces (dataset_as_rdd through a SparkSession, the
+# Spark-DataFrame branch of make_spark_converter) EXECUTE in
+# tests/test_spark_execution.py — against real pyspark when importable, else
+# against the in-repo pyspark-API engine (petastorm_tpu/test_util/minispark.py).

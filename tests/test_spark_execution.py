@@ -1,0 +1,144 @@
+"""EXECUTE the pyspark-gated adapter surfaces (reference spark_utils.py:23-52,
+spark/spark_dataset_converter.py:474-526).
+
+This image cannot install pyspark (no JVM, no network egress), so these tests
+run the adapters — unmodified, every line — against
+``petastorm_tpu.test_util.minispark``, a local engine implementing the exact
+pyspark API slice the adapters consume. When a real pyspark IS importable,
+the same tests use it instead (the fixture prefers the genuine module), so
+nothing here depends on the stand-in beyond this environment's limits.
+"""
+
+import sys
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture()
+def spark(monkeypatch):
+    """A SparkSession: real pyspark when available, minispark otherwise."""
+    try:
+        import pyspark  # noqa: F401
+        using_mini = False
+    except ImportError:
+        from petastorm_tpu.test_util import minispark
+        scoped = {}
+        minispark.install(scoped)
+        for name, mod in scoped.items():
+            monkeypatch.setitem(sys.modules, name, mod)
+        using_mini = True
+    from pyspark.sql import SparkSession
+    session = SparkSession.builder.master('local[3]').appName('pstpu-test').getOrCreate()
+    yield session
+    session.stop()
+    if using_mini:
+        # the converter's spark branch imported through the scoped modules;
+        # monkeypatch pops them automatically on teardown
+        pass
+
+
+@pytest.fixture()
+def petastorm_store(tmp_path):
+    from petastorm_tpu.codecs import NdarrayCodec, ScalarCodec
+    from petastorm_tpu.etl.dataset_metadata import write_petastorm_dataset
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+
+    schema = Unischema('S', [
+        UnischemaField('id', np.int64, (), ScalarCodec(), False),
+        UnischemaField('vec', np.float32, (4,), NdarrayCodec(), False),
+    ])
+    url = 'file://' + str(tmp_path / 'store')
+    rng = np.random.default_rng(7)
+    rows = {i: rng.random(4).astype(np.float32) for i in range(60)}
+    write_petastorm_dataset(url, schema, ({'id': i, 'vec': rows[i]} for i in range(60)),
+                            rows_per_row_group=15)
+    return url, rows
+
+
+def test_dataset_as_rdd_executes(spark, petastorm_store):
+    """The real dataset_as_rdd chain: schema load, parallelize over shard
+    indices, per-partition readers, flatMap — every row exactly once."""
+    from petastorm_tpu.spark_utils import dataset_as_rdd
+
+    url, rows = petastorm_store
+    rdd = dataset_as_rdd(url, spark)
+    collected = rdd.collect()
+    assert sorted(int(r.id) for r in collected) == list(range(60))
+    for r in collected:
+        np.testing.assert_array_equal(np.asarray(r.vec), rows[int(r.id)])
+    assert rdd.getNumPartitions() == spark.sparkContext.defaultParallelism
+
+
+def test_dataset_as_rdd_schema_fields_subset(spark, petastorm_store):
+    from petastorm_tpu.spark_utils import dataset_as_rdd
+
+    url, _ = petastorm_store
+    collected = dataset_as_rdd(url, spark, schema_fields=['id']).collect()
+    assert sorted(int(r.id) for r in collected) == list(range(60))
+    assert not hasattr(collected[0], 'vec')
+
+
+def test_make_spark_converter_dataframe_roundtrip(spark, tmp_path):
+    """The Spark-DataFrame branch of the converter: logical-plan fingerprint,
+    withColumn float precision casts (scalars AND arrays), df.write.parquet
+    materialization, loader readback, cache-hit dedup, delete()."""
+    import pandas as pd
+    from petastorm_tpu import make_batch_reader
+    from petastorm_tpu.spark import make_spark_converter
+
+    pdf = pd.DataFrame({
+        'idx': np.arange(20, dtype=np.int64),
+        'feature': np.linspace(0.0, 1.0, 20).astype(np.float64),
+        'emb': [np.arange(3, dtype=np.float64) + i for i in range(20)],
+    })
+    df = spark.createDataFrame(pdf)
+    cache = 'file://' + str(tmp_path / 'cache')
+
+    converter = make_spark_converter(df, parent_cache_dir_url=cache)
+    assert len(converter) == 20
+
+    with make_batch_reader(converter.cache_dir_url) as reader:
+        blocks = list(reader)
+    idx = np.concatenate([np.asarray(b.idx) for b in blocks])
+    feat = np.concatenate([np.asarray(b.feature) for b in blocks])
+    assert sorted(idx.tolist()) == list(range(20))
+    assert feat.dtype == np.float32  # precision='float32' cast applied by withColumn
+    # ArrayType(DoubleType) -> ArrayType(FloatType): assert on the STORED
+    # schema (readback through python lists re-promotes to float64)
+    import pyarrow.fs as pafs
+    import pyarrow.parquet as pq
+    from petastorm_tpu.fs import FilesystemResolver
+    resolver = FilesystemResolver(converter.cache_dir_url)
+    fs, root = resolver.filesystem(), resolver.get_dataset_path()
+    part = [i.path for i in fs.get_file_info(pafs.FileSelector(root))
+            if i.path.endswith('.parquet')][0]
+    import pyarrow as pa
+    stored = pq.read_schema(fs.open_input_file(part))
+    assert stored.field('emb').type == pa.list_(pa.float32())
+
+    # identical frame -> same fingerprint -> cache hit, no second materialization
+    converter2 = make_spark_converter(spark.createDataFrame(pdf),
+                                      parent_cache_dir_url=cache)
+    assert converter2.cache_dir_url == converter.cache_dir_url
+
+    converter.delete()
+    info = fs.get_file_info(root)
+    assert info.type == pafs.FileType.NotFound
+
+
+def test_make_spark_converter_jax_loader(spark, tmp_path):
+    import pandas as pd
+    from petastorm_tpu.spark import make_spark_converter
+
+    pdf = pd.DataFrame({'x': np.arange(32, dtype=np.int64),
+                        'y': np.arange(32).astype(np.float64) / 8.0})
+    converter = make_spark_converter(spark.createDataFrame(pdf),
+                                     parent_cache_dir_url='file://' + str(tmp_path / 'c'))
+    seen = []
+    with converter.make_jax_loader(batch_size=8, num_epochs=1,
+                                   shuffle_row_groups=False) as loader:
+        for batch in loader:
+            assert batch['y'].dtype == np.float32
+            seen.extend(np.asarray(batch['x']).tolist())
+    assert sorted(seen) == list(range(32))
