@@ -588,6 +588,58 @@ void resize_area(const uint8_t* src, int sw, int sh, int c, uint8_t* dst, int dw
   }
 }
 
+// -- bilinear (half-pixel centers, cv2 INTER_LINEAR semantics) -- the policy
+// pairs it with area: bilinear for mild ratios (< 2x both axes, where area's
+// support collapses to bilinear's anyway), area for real decimation.
+
+void bilinear_axis(int in_len, int out_len, std::vector<int>& lo, std::vector<float>& frac) {
+  lo.resize(out_len);
+  frac.resize(out_len);
+  const double scale = double(in_len) / out_len;
+  for (int o = 0; o < out_len; o++) {
+    double f = (o + 0.5) * scale - 0.5;
+    int i = int(std::floor(f));
+    float w = float(f - i);
+    if (i < 0) { i = 0; w = 0.0f; }
+    if (i >= in_len - 1) { i = in_len >= 2 ? in_len - 2 : 0; w = in_len >= 2 ? 1.0f : 0.0f; }
+    lo[o] = i;
+    frac[o] = w;
+  }
+}
+
+void resize_bilinear(const uint8_t* src, int sw, int sh, int c, uint8_t* dst, int dw, int dh) {
+  std::vector<int> xlo, ylo;
+  std::vector<float> xw, yw;
+  bilinear_axis(sw, dw, xlo, xw);
+  bilinear_axis(sh, dh, ylo, yw);
+  // horizontal-first separable: one float row reused across the two taps of
+  // each output row would need caching; simpler and still fast — per output
+  // row, blend the two source rows into a float row, then sample horizontally
+  std::vector<float> row(size_t(sw) * c);
+  for (int oy = 0; oy < dh; oy++) {
+    const uint8_t* r0 = src + size_t(ylo[oy]) * sw * c;
+    const uint8_t* r1 = src + size_t(std::min(ylo[oy] + 1, sh - 1)) * sw * c;
+    const float fy = yw[oy], gy = 1.0f - fy;
+    for (int i = 0; i < sw * c; i++) row[size_t(i)] = gy * r0[i] + fy * r1[i];
+    uint8_t* drow = dst + size_t(oy) * dw * c;
+    for (int ox = 0; ox < dw; ox++) {
+      const int s = xlo[ox] * c;
+      const int s2 = std::min(xlo[ox] + 1, sw - 1) * c;
+      const float fx = xw[ox], gx = 1.0f - fx;
+      for (int ch = 0; ch < c; ch++) {
+        const float v = gx * row[size_t(s + ch)] + fx * row[size_t(s2 + ch)];
+        const int q = int(v + 0.5f);
+        drow[ox * c + ch] = uint8_t(q < 0 ? 0 : (q > 255 ? 255 : q));
+      }
+    }
+  }
+}
+
+// mirror of the python-side policy (codecs._mild_ratio): keep in sync
+bool mild_ratio(int in_h, int in_w, int out_h, int out_w) {
+  return in_h < 2 * out_h && in_w < 2 * out_w;
+}
+
 int decode_resize_one(const uint8_t* data, uint64_t len, const int32_t* info, uint8_t* out,
                       std::string* err, int min_w, int min_h, int out_w, int out_h) {
   try {
@@ -601,7 +653,11 @@ int decode_resize_one(const uint8_t* data, uint64_t len, const int32_t* info, ui
     }
     std::vector<uint8_t> scratch(size_t(sw) * sh * c);
     if (decode_one(data, len, info, scratch.data(), err, min_w, min_h) != 0) return -1;
-    resize_area(scratch.data(), sw, sh, c, out, out_w, out_h);
+    if (mild_ratio(sh, sw, out_h, out_w)) {
+      resize_bilinear(scratch.data(), sw, sh, c, out, out_w, out_h);
+    } else {
+      resize_area(scratch.data(), sw, sh, c, out, out_w, out_h);
+    }
     return 0;
   } catch (const std::exception& e) {
     *err = e.what();
@@ -750,8 +806,23 @@ int64_t pstpu_img_resize_area(const uint8_t* src, int32_t sw, int32_t sh, int32_
   }
 }
 
+// Standalone bilinear resample (half-pixel centers; the mild-ratio half of
+// the shared resize policy).
+int64_t pstpu_img_resize_bilinear(const uint8_t* src, int32_t sw, int32_t sh, int32_t c,
+                                  uint8_t* dst, int32_t dw, int32_t dh) {
+  if (sw < 1 || sh < 1 || dw < 1 || dh < 1 || c < 1) return -1;
+  try {
+    resize_bilinear(src, sw, sh, c, dst, dw, dh);
+    return 0;
+  } catch (...) {
+    g_error = "resize failed";
+    return -1;
+  }
+}
+
 // Fused decode+resize: each image is decoded at its probed dims (JPEG: the
-// min_w/min_h DCT scale, matching the probe) then area-resampled into its
+// min_w/min_h DCT scale, matching the probe) then resampled — bilinear for
+// mild ratios, area for >= 2x decimation (the shared policy) — into its
 // caller-allocated out_h x out_w output — one GIL-released call replaces the
 // per-row Python resize transform. 8-bit images only.
 int64_t pstpu_img_decode_resize_batch(int64_t n, const uint8_t* const* datas,

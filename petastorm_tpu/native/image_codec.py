@@ -70,6 +70,10 @@ def _load_library():
         lib.pstpu_img_resize_area.argtypes = [
             ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
             ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32]
+        lib.pstpu_img_resize_bilinear.restype = ctypes.c_int64
+        lib.pstpu_img_resize_bilinear.argtypes = [
+            ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32]
         _lib = lib
         return _lib
 
@@ -202,21 +206,17 @@ def decode_images_block(buffers, threads=None, min_size=None):
     return result if isinstance(result, np.ndarray) else None
 
 
-def resize_area_image(img, size):
-    """Area-resample one decoded uint8 image to ``size=(out_h, out_w)`` with
-    the native resampler — the cv2 ``INTER_AREA`` stand-in for OpenCV-less
-    deployments. Returns a new array; raises :class:`NativeDecodeError` when
-    the native library is unavailable."""
+def _resize_native(img, size, symbol_name):
     lib = _load_library()
     if lib is None:
         raise NativeDecodeError('native image codec not available')
     if img.dtype != np.uint8:
-        raise ValueError('resize_area_image supports uint8, got {}'.format(img.dtype))
+        raise ValueError('native resize supports uint8, got {}'.format(img.dtype))
     out_h, out_w = int(size[0]), int(size[1])
     c = img.shape[2] if img.ndim == 3 else 1
     src = np.ascontiguousarray(img)
     out = np.empty((out_h, out_w) + ((c,) if img.ndim == 3 else ()), np.uint8)
-    rc = lib.pstpu_img_resize_area(src.ctypes.data, img.shape[1], img.shape[0], c,
+    rc = getattr(lib, symbol_name)(src.ctypes.data, img.shape[1], img.shape[0], c,
                                    out.ctypes.data, out_w, out_h)
     if rc != 0:
         raise NativeDecodeError('native resize failed: {}'.format(
@@ -224,12 +224,28 @@ def resize_area_image(img, size):
     return out
 
 
+def resize_area_image(img, size):
+    """Area-resample one decoded uint8 image to ``size=(out_h, out_w)`` with
+    the native resampler — the cv2 ``INTER_AREA`` stand-in for OpenCV-less
+    deployments. Returns a new array; raises :class:`NativeDecodeError` when
+    the native library is unavailable."""
+    return _resize_native(img, size, 'pstpu_img_resize_area')
+
+
+def resize_bilinear_image(img, size):
+    """Bilinear-resample one decoded uint8 image (half-pixel centers, cv2
+    ``INTER_LINEAR`` semantics) — the mild-ratio half of the shared resize
+    policy (see ``codecs._resize_image``)."""
+    return _resize_native(img, size, 'pstpu_img_resize_bilinear')
+
+
 def decode_images_resized(buffers, size, threads=None, min_size=None):
-    """Fused decode + area resize of a whole column into ONE
+    """Fused decode + resize of a whole column into ONE
     ``[N, out_h, out_w(, C)]`` allocation. ``size`` is ``(out_h, out_w)``.
     Each image decodes at its probed dims (JPEG: at the smallest m/8 DCT scale
     covering the target, so most pixels of a large photo never exist) and is
-    then area-resampled (cv2 ``INTER_AREA`` analog) into its output row — one
+    then resampled per the shared policy — bilinear below 2x decimation, area
+    at >= 2x (see ``codecs._resize_image``) — into its output row: one
     GIL-released native call replaces a per-row Python resize transform.
 
     ``min_size=(min_h, min_w)`` overrides the DCT-scale floor (an explicit

@@ -149,6 +149,13 @@ def _fingerprint(df, parent_cache_dir_url, row_group_size, compression, precisio
 
 # -- materialization -----------------------------------------------------------
 
+def rows_per_row_group_for_bytes(table, row_group_size_bytes):
+    """Bytes target -> rows (Arrow writers take rows): the one sizing
+    heuristic, shared with the minispark test engine's writer."""
+    row_bytes = max(1, table.nbytes // max(1, table.num_rows))
+    return max(1, row_group_size_bytes // row_bytes)
+
+
 def _gen_cache_dir_name():
     # {datetime}-{uuid}: greppable for manual cleanup if atexit never ran
     # (reference _gen_cache_dir_name, :424-436)
@@ -180,11 +187,9 @@ def _materialize(df, parent_cache_dir_url, row_group_size_bytes, compression, pr
         resolver = FilesystemResolver(cache_dir_url)
         fs, path = resolver.filesystem(), resolver.get_dataset_path()
         fs.create_dir(path, recursive=True)
-        # row-group sizing: bytes target -> rows (Arrow writers take rows)
-        row_bytes = max(1, table.nbytes // max(1, table.num_rows))
-        rows_per_group = max(1, row_group_size_bytes // row_bytes)
         with fs.open_output_stream(path + '/part-00000.parquet') as f:
-            pq.write_table(table, f, row_group_size=rows_per_group,
+            pq.write_table(table, f,
+                           row_group_size=rows_per_row_group_for_bytes(table, row_group_size_bytes),
                            compression=compression or 'snappy')
         n_rows = table.num_rows
     atexit.register(_delete_cache_data_atexit, cache_dir_url)

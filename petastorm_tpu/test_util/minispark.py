@@ -257,15 +257,15 @@ class DataFrameWriter(object):
 
     def parquet(self, url):
         from petastorm_tpu.fs import FilesystemResolver
+        from petastorm_tpu.spark.dataset_converter import rows_per_row_group_for_bytes
         resolver = FilesystemResolver(url)
         fs, path = resolver.filesystem(), resolver.get_dataset_path()
         fs.create_dir(path, recursive=True)
         table = self._df._table
         block_bytes = int(self._options.get('parquet.block.size', 32 * 1024 * 1024))
-        row_bytes = max(1, table.nbytes // max(1, table.num_rows))
         with fs.open_output_stream(path + '/part-00000-minispark.parquet') as f:
             pq.write_table(table, f,
-                           row_group_size=max(1, block_bytes // row_bytes),
+                           row_group_size=rows_per_row_group_for_bytes(table, block_bytes),
                            compression=self._options.get('compression', 'snappy'))
 
 
@@ -316,20 +316,26 @@ class SparkSession(object):
         self.sparkContext = SparkContext(defaultParallelism)
 
     class _Builder(object):
-        def __init__(self):
-            self._parallelism = None
+        """Immutable chain: every step returns a FRESH builder, so state from
+        one ``SparkSession.builder...`` chain never leaks into the next (the
+        shared class-level root stays untouched, like pyspark's per-chain
+        config)."""
+
+        def __init__(self, parallelism=None):
+            self._parallelism = parallelism
 
         def master(self, url):
             # 'local[N]' controls parallelism, as in pyspark
+            p = self._parallelism
             if url.startswith('local[') and url.endswith(']') and url[6:-1].isdigit():
-                self._parallelism = int(url[6:-1])
-            return self
+                p = int(url[6:-1])
+            return type(self)(p)
 
         def appName(self, name):
-            return self
+            return type(self)(self._parallelism)
 
         def config(self, *args, **kwargs):
-            return self
+            return type(self)(self._parallelism)
 
         def getOrCreate(self):
             return SparkSession(self._parallelism)

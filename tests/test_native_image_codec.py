@@ -390,7 +390,11 @@ def mixed_size_png_dataset(tmp_path_factory):
 
 
 def _resize_ref(img, size):
-    return cv2.resize(img, (size[1], size[0]), interpolation=cv2.INTER_AREA)
+    # the shared policy: bilinear under 2x decimation, area at >= 2x
+    from petastorm_tpu.codecs import _mild_ratio
+    interp = cv2.INTER_LINEAR if _mild_ratio(img.shape[0], img.shape[1], size[0], size[1]) \
+        else cv2.INTER_AREA
+    return cv2.resize(img, (size[1], size[0]), interpolation=interp)
 
 
 def test_image_resize_end_to_end_row_reader(mixed_size_png_dataset):
@@ -549,3 +553,35 @@ def test_numpy_area_resize_matches_cv2():
     out = _area_resize_numpy(img, 25, 35)
     ref = cv2.resize(img, (35, 25), interpolation=cv2.INTER_AREA)
     assert np.abs(out.astype(int) - ref.astype(int)).max() <= 1
+
+
+def test_native_resize_bilinear_matches_cv2_linear():
+    rng = np.random.default_rng(21)
+    for shape, target in [((60, 80, 3), (40, 56)), ((45, 45), (32, 32)),
+                          ((33, 57, 3), (60, 70))]:  # down-mild and upscale
+        img = rng.integers(0, 255, shape, dtype=np.uint8)
+        out = image_codec.resize_bilinear_image(img, target)
+        ref = cv2.resize(img, (target[1], target[0]), interpolation=cv2.INTER_LINEAR)
+        assert np.abs(out.astype(int) - ref.astype(int)).max() <= 1, (shape, target)
+
+
+def test_resize_policy_dispatch():
+    """_resize_image must pick bilinear under 2x decimation and area at >= 2x,
+    and the native fused path must follow the same split."""
+    from petastorm_tpu.codecs import _mild_ratio, _resize_image
+    rng = np.random.default_rng(22)
+    # mild (1.5x): matches cv2 INTER_LINEAR
+    img = rng.integers(0, 255, (48, 48, 3), dtype=np.uint8)
+    got = _resize_image(img, 32, 32)
+    ref = cv2.resize(img, (32, 32), interpolation=cv2.INTER_LINEAR)
+    np.testing.assert_array_equal(got, ref)
+    # real decimation (3x): matches cv2 INTER_AREA
+    img2 = rng.integers(0, 255, (96, 96, 3), dtype=np.uint8)
+    got2 = _resize_image(img2, 32, 32)
+    ref2 = cv2.resize(img2, (32, 32), interpolation=cv2.INTER_AREA)
+    np.testing.assert_array_equal(got2, ref2)
+    assert _mild_ratio(48, 48, 32, 32) and not _mild_ratio(96, 96, 32, 32)
+    assert not _mild_ratio(64, 40, 32, 32)  # boundary: exactly 2x is NOT mild
+    # fused native path agrees within rounding on the mild branch
+    out = image_codec.decode_images_resized([_png(img)], (32, 32))
+    assert np.abs(out[0].astype(int) - ref.astype(int)).max() <= 1

@@ -15,27 +15,28 @@ import numpy as np
 import pytest
 
 
-@pytest.fixture()
-def spark(monkeypatch):
-    """A SparkSession: real pyspark when available, minispark otherwise."""
+def _using_minispark():
     try:
         import pyspark  # noqa: F401
-        using_mini = False
+        return False
     except ImportError:
+        return True
+
+
+@pytest.fixture()
+def spark(monkeypatch):
+    """A SparkSession: real pyspark when available, minispark otherwise
+    (monkeypatch pops the scoped module registrations on teardown)."""
+    if _using_minispark():
         from petastorm_tpu.test_util import minispark
         scoped = {}
         minispark.install(scoped)
         for name, mod in scoped.items():
             monkeypatch.setitem(sys.modules, name, mod)
-        using_mini = True
     from pyspark.sql import SparkSession
     session = SparkSession.builder.master('local[3]').appName('pstpu-test').getOrCreate()
     yield session
     session.stop()
-    if using_mini:
-        # the converter's spark branch imported through the scoped modules;
-        # monkeypatch pops them automatically on teardown
-        pass
 
 
 @pytest.fixture()
@@ -87,10 +88,11 @@ def test_make_spark_converter_dataframe_roundtrip(spark, tmp_path):
     from petastorm_tpu import make_batch_reader
     from petastorm_tpu.spark import make_spark_converter
 
+    # plain-list array cells: real pyspark cannot infer np.ndarray field types
     pdf = pd.DataFrame({
         'idx': np.arange(20, dtype=np.int64),
         'feature': np.linspace(0.0, 1.0, 20).astype(np.float64),
-        'emb': [np.arange(3, dtype=np.float64) + i for i in range(20)],
+        'emb': [list(np.arange(3, dtype=np.float64) + i) for i in range(20)],
     })
     df = spark.createDataFrame(pdf)
     cache = 'file://' + str(tmp_path / 'cache')
@@ -117,10 +119,17 @@ def test_make_spark_converter_dataframe_roundtrip(spark, tmp_path):
     stored = pq.read_schema(fs.open_input_file(part))
     assert stored.field('emb').type == pa.list_(pa.float32())
 
-    # identical frame -> same fingerprint -> cache hit, no second materialization
-    converter2 = make_spark_converter(spark.createDataFrame(pdf),
-                                      parent_cache_dir_url=cache)
+    # same DataFrame -> same logical plan -> cache hit, no second
+    # materialization (same-object reuse is the contract that holds under BOTH
+    # engines; a re-created frame gets fresh exprIds under real pyspark)
+    converter2 = make_spark_converter(df, parent_cache_dir_url=cache)
     assert converter2.cache_dir_url == converter.cache_dir_url
+    if _using_minispark():
+        # minispark's plan is a content digest: re-created identical frames
+        # dedup too
+        converter3 = make_spark_converter(spark.createDataFrame(pdf),
+                                          parent_cache_dir_url=cache)
+        assert converter3.cache_dir_url == converter.cache_dir_url
 
     converter.delete()
     info = fs.get_file_info(root)
