@@ -96,12 +96,18 @@ def main():
 
     from petastorm_tpu.tools.throughput import reader_throughput
 
-    runs = []
-    for _ in range(5):
-        result = reader_throughput(url, warmup_cycles=200, measure_cycles=6000,
-                                   pool_type='thread', workers_count=3,
-                                   shuffle_row_groups=True, read_method='python')
-        runs.append(result.samples_per_second)
+    def one_run():
+        return reader_throughput(url, warmup_cycles=200, measure_cycles=6000,
+                                 pool_type='thread', workers_count=3,
+                                 shuffle_row_groups=True,
+                                 read_method='python').samples_per_second
+
+    # The r3 capture's 5 runs trended UP monotonically (3904..4934, spread
+    # 0.23): the single warm pass did not fully settle allocator/alloc-cache/
+    # CPU-state warmup on the 1-core container. One full-length measured run
+    # is DISCARDED before the 5 that count.
+    discarded = one_run()
+    runs = [one_run() for _ in range(5)]
     value = statistics.median(runs)
     spread = (max(runs) - min(runs)) / value if value else 0.0
     print(json.dumps({
@@ -111,6 +117,7 @@ def main():
         'vs_baseline': round(value / BASELINE_SAMPLES_PER_SEC, 3),
         'runs': [round(r, 2) for r in runs],
         'spread': round(spread, 4),
+        'discarded_warm_run': round(discarded, 2),
     }))
 
 

@@ -74,7 +74,7 @@ def build_raw_store(url, rows, image_size, num_classes, seed=0):
     return schema
 
 
-def make_step(image_size, num_classes, seed=0):
+def make_step(image_size, num_classes, seed=0, model_factory=None):
     import jax
     import jax.numpy as jnp
 
@@ -82,7 +82,7 @@ def make_step(image_size, num_classes, seed=0):
     from petastorm_tpu.models import resnet50
     from petastorm_tpu.models.train import create_train_state, make_train_step
 
-    model = resnet50(num_classes=num_classes, dtype=jnp.bfloat16)
+    model = (model_factory or resnet50)(num_classes=num_classes, dtype=jnp.bfloat16)
     state = create_train_state(model, jax.random.PRNGKey(seed),
                                jnp.zeros((1, image_size, image_size, 3)))
     state = jax.device_put(state, jax.devices()[0])
@@ -97,14 +97,20 @@ def make_step(image_size, num_classes, seed=0):
     return step_fn
 
 
+def measure_kwargs(args):
+    """The one measurement configuration shared by the variant runs and the
+    sweep — points from both stay comparable."""
+    return ({'seed': 7, 'shuffle_row_groups': True, 'workers_count': args.workers},
+            {'shuffling_queue_capacity': 512, 'seed': 7})
+
+
 def run_variant(variant, args, png_url, raw_url, jpeg_url, tmpdir):
     from examples.imagenet.jax_resnet_example import make_transform
     from petastorm_tpu import make_reader
     from petastorm_tpu.tools.throughput import pipeline_duty_cycle
 
     step_fn = make_step(args.image_size, args.num_classes)
-    reader_kwargs = {'seed': 7, 'shuffle_row_groups': True,
-                     'workers_count': args.workers}
+    reader_kwargs, loader_kwargs = measure_kwargs(args)
     batch_to_args = lambda b: (b['image'], b['label'])  # noqa: E731
     if variant in ('png', 'png_cached'):
         url = png_url
@@ -131,8 +137,91 @@ def run_variant(variant, args, png_url, raw_url, jpeg_url, tmpdir):
     res = pipeline_duty_cycle(
         url, step_fn, batch_to_args, batch_size=args.batch_size, steps=args.steps,
         warmup_steps=args.warmup_steps, reader_kwargs=reader_kwargs,
-        loader_kwargs={'shuffling_queue_capacity': 512, 'seed': 7})
+        loader_kwargs=loader_kwargs)
     return res
+
+
+#: the --sweep ladder: step cost rises ~monotonically (deeper, then wider);
+#: bytes/example stay CONSTANT, so the sweep isolates "can the fixed host+
+#: staging budget hide under a growing step" — the duty-vs-step-cost curve
+SWEEP_MODELS = (
+    ('resnet18', 'resnet18', 1),
+    ('resnet50', 'resnet50', 1),
+    ('resnet101', 'resnet101', 1),
+    ('resnet152', 'resnet152', 1),
+    ('resnet152w2', 'resnet152', 2),  # double width = ~4x FLOPs vs resnet152
+)
+
+
+def measure_step_ms(step_fn, batch_size, image_size, repeats=10):
+    """Device-only cost of one train step (median of ``repeats``), staged
+    input, fully blocked — the x-axis of the duty-vs-step-cost curve."""
+    import statistics
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    images = jax.device_put(jnp.zeros((batch_size, image_size, image_size, 3),
+                                      dtype=jnp.uint8))
+    labels = jax.device_put(jnp.zeros((batch_size,), dtype=jnp.int64))
+    jax.block_until_ready(step_fn(images, labels))  # compile + warm
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        jax.block_until_ready(step_fn(images, labels))
+        times.append(time.perf_counter() - t0)
+    return statistics.median(times) * 1000
+
+
+def run_sweep(args, raw_url):
+    """The duty-vs-step-cost curve on the raw store: one point per ladder
+    model. Emits a JSON line per point; the curve demonstrates (or refutes)
+    that the loader hides input time once the step is heavy enough — the
+    BASELINE north-star claim, measured instead of inferred."""
+    import functools
+
+    from petastorm_tpu import models as model_zoo
+    from petastorm_tpu.tools.throughput import pipeline_duty_cycle
+
+    reader_kwargs, loader_kwargs = measure_kwargs(args)
+    ladder = SWEEP_MODELS
+    if args.sweep_models:
+        wanted = {m.strip() for m in args.sweep_models.split(',')}
+        unknown = wanted - {m[0] for m in SWEEP_MODELS}
+        if unknown:
+            raise SystemExit('unknown --sweep-models: {}'.format(sorted(unknown)))
+        ladder = [m for m in SWEEP_MODELS if m[0] in wanted]
+    results = []
+    for label, factory_name, width in ladder:
+        base = getattr(model_zoo, factory_name)
+        factory = functools.partial(base, num_filters=64 * width)
+        step_fn = make_step(args.image_size, args.num_classes, model_factory=factory)
+        step_ms = measure_step_ms(step_fn, args.batch_size, args.image_size)
+        res = pipeline_duty_cycle(
+            raw_url, step_fn, lambda b: (b['image'], b['label']),
+            batch_size=args.batch_size, steps=args.steps,
+            warmup_steps=args.warmup_steps,
+            reader_kwargs=reader_kwargs, loader_kwargs=loader_kwargs)
+        point = {
+            'metric': 'duty_sweep',
+            'model': label,
+            'step_ms': round(step_ms, 2),
+            'consumption_ex_per_s': round(args.batch_size / (step_ms / 1000), 1),
+            'examples_per_sec': round(res.samples_per_second, 1),
+            'input_stall_fraction': round(res.input_stall_fraction, 4),
+            'duty_cycle': round(1 - res.input_stall_fraction, 4),
+            'batch_size': args.batch_size,
+            'image_size': args.image_size,
+            'steps': args.steps,
+        }
+        print(json.dumps(point), flush=True)
+        results.append(point)
+    best = min(results, key=lambda p: p['input_stall_fraction'])
+    print(json.dumps({'metric': 'duty_sweep_best', **{k: best[k] for k in
+                      ('model', 'step_ms', 'input_stall_fraction', 'duty_cycle',
+                       'examples_per_sec')}}), flush=True)
+    return results
 
 
 def main(argv=None):
@@ -145,6 +234,13 @@ def main(argv=None):
     parser.add_argument('--rows', type=int, default=256)
     parser.add_argument('--workers', type=int, default=max(1, os.cpu_count() or 1))
     parser.add_argument('--variants', default='png,jpeg,raw,png_cached')
+    parser.add_argument('--sweep', action='store_true',
+                        help='duty-vs-step-cost curve on the raw store across '
+                             'the model ladder (instead of --variants)')
+    parser.add_argument('--sweep-models', default=None,
+                        help='comma-separated subset of the ladder '
+                             '(default: all of {})'.format(
+                                 ','.join(m[0] for m in SWEEP_MODELS)))
     parser.add_argument('--keep-dir', default=None,
                         help='reuse/keep the dataset dir (default: fresh tempdir)')
     args = parser.parse_args(argv)
@@ -158,7 +254,8 @@ def main(argv=None):
     jpeg_dir = os.path.join(tmpdir, 'imagenet_jpeg')
     png_url, raw_url = 'file://' + png_dir, 'file://' + raw_dir
     jpeg_url = 'file://' + jpeg_dir
-    variants = [v.strip() for v in args.variants.split(',') if v.strip()]
+    variants = ['raw'] if args.sweep else \
+        [v.strip() for v in args.variants.split(',') if v.strip()]
     try:
         if not os.path.exists(png_dir) and any(v.startswith('png') for v in variants):
             build_png_store(png_url, args.rows)
@@ -182,6 +279,9 @@ def main(argv=None):
             build_png_store(jpeg_url, args.rows, image_codec='jpeg',
                             min_dim=320, max_dim=560)
 
+        if args.sweep:
+            run_sweep(args, raw_url)
+            return
         for variant in variants:
             res = run_variant(variant, args, png_url, raw_url, jpeg_url, tmpdir)
             print(json.dumps({

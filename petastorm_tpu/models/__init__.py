@@ -8,7 +8,8 @@ ring attention (the long-context load: NGram windows over a ('data','seq')
 mesh).
 """
 
-from petastorm_tpu.models.resnet import ResNet, resnet18, resnet50  # noqa: F401
+from petastorm_tpu.models.resnet import (ResNet, resnet18, resnet50,  # noqa: F401
+                                         resnet101, resnet152)
 from petastorm_tpu.models.mnist import MnistCNN  # noqa: F401
 from petastorm_tpu.models.transformer import (SequenceTransformer,  # noqa: F401
                                               make_sequence_transformer)

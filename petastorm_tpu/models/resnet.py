@@ -109,3 +109,5 @@ class ResNet(nn.Module):
 
 resnet18 = partial(ResNet, stage_sizes=[2, 2, 2, 2], block_cls=BasicBlock)
 resnet50 = partial(ResNet, stage_sizes=[3, 4, 6, 3], block_cls=BottleneckBlock)
+resnet101 = partial(ResNet, stage_sizes=[3, 4, 23, 3], block_cls=BottleneckBlock)
+resnet152 = partial(ResNet, stage_sizes=[3, 8, 36, 3], block_cls=BottleneckBlock)
