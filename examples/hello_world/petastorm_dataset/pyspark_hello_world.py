@@ -1,0 +1,49 @@
+"""Minimal pyspark read of a HelloWorld dataset — ``dataset_as_rdd`` yields an
+RDD of decoded row namedtuples, one reader shard per Spark partition.
+
+Parity: reference examples/hello_world/petastorm_dataset/pyspark_hello_world.py.
+When pyspark is not installed (this image has no JVM), the example runs against
+``petastorm_tpu.test_util.minispark`` — the local engine implementing the
+pyspark API slice the adapter consumes — so the code path still executes.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def _spark_session():
+    try:
+        from pyspark.sql import SparkSession
+    except ImportError:
+        from petastorm_tpu.test_util import minispark
+        minispark.install()
+        from pyspark.sql import SparkSession
+    return SparkSession.builder.master('local[2]').appName('pstpu-hello').getOrCreate()
+
+
+def pyspark_hello_world(dataset_url='file:///tmp/hello_world_dataset'):
+    from petastorm_tpu.spark_utils import dataset_as_rdd
+
+    spark = _spark_session()
+    try:
+        rdd = dataset_as_rdd(dataset_url, spark, schema_fields=['id', 'image1'])
+        first = rdd.first()
+        print('An id in the dataset:', first.id)
+        print('image1 shape:', first.image1.shape)
+        ids = sorted(row.id for row in rdd.collect())
+        print('total rows:', len(ids))
+        return ids
+    finally:
+        spark.stop()
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('--dataset-url', default='file:///tmp/hello_world_dataset')
+    args = parser.parse_args()
+    pyspark_hello_world(args.dataset_url)
+
+
+if __name__ == '__main__':
+    main()

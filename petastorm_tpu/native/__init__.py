@@ -196,4 +196,8 @@ def open_parquet(path, filesystem=None, use_threads=True, buffer_size=0):
             logger.warning('native open failed for %s (%s); pyarrow fallback', path, e)
     if filesystem is None:
         return pq.ParquetFile(path)
-    return pq.ParquetFile(filesystem.open_input_file(path))
+    # remote stores (s3/gs/hdfs, incl. the retry-wrapped PyFileSystems) get
+    # pre_buffer: a row group's column-chunk ranges coalesce into few large
+    # reads issued ahead of decode — the milliseconds-per-round-trip regime
+    # where per-chunk sequential reads dominate wall time
+    return pq.ParquetFile(filesystem.open_input_file(path), pre_buffer=not local)

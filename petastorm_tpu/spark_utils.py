@@ -35,10 +35,21 @@ def dataset_as_rdd(dataset_url, spark_session, schema_fields=None):
 
     def _read_shard(shard_index):
         from petastorm_tpu import make_reader
-        with make_reader(dataset_url, schema_fields=fields, reader_pool_type='dummy',
-                         cur_shard=shard_index, shard_count=num_partitions,
-                         num_epochs=1) as reader:
-            return list(reader)
+        from petastorm_tpu.errors import NoDataAvailableError
+        try:
+            with make_reader(dataset_url, schema_fields=fields, reader_pool_type='dummy',
+                             cur_shard=shard_index, shard_count=num_partitions,
+                             num_epochs=1) as reader:
+                return list(reader)
+        except NoDataAvailableError as e:
+            # more Spark partitions than row groups: an empty partition is a
+            # normal condition here (the reference reader warns and yields
+            # nothing, spark_utils.py:23-52) — the Reader's loud no-data
+            # contract stays for direct users
+            import logging
+            logging.getLogger(__name__).warning(
+                'Empty shard %d/%d for %s: %s', shard_index, num_partitions, dataset_url, e)
+            return []
 
     return sc.parallelize(range(num_partitions), num_partitions).flatMap(_read_shard)
 

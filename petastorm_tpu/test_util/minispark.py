@@ -195,6 +195,12 @@ class RDD(object):
                 break
         return out[:n]
 
+    def first(self):
+        got = self.take(1)
+        if not got:
+            raise ValueError('RDD is empty')
+        return got[0]
+
 
 class SparkContext(object):
     def __init__(self, defaultParallelism=None):

@@ -1,6 +1,8 @@
 """End-to-end runs of the examples tree (reference examples/*/tests)."""
 
 
+import os
+
 import numpy as np
 import pytest
 
@@ -134,3 +136,18 @@ def test_sequence_example_end_to_end(tmp_path, context):
     generate_sequence_dataset(url, rows=512, rows_per_row_group=64)
     state = train(url, steps=4, batch_size=8, window=4, context=context)
     assert int(state.step) == 4
+
+
+def test_hello_world_pyspark_read(hello_world_url):
+    # runs against real pyspark when importable, else the minispark engine —
+    # executed in a subprocess so minispark.install() never touches this
+    # process's sys.modules
+    import subprocess
+    import sys
+    out = subprocess.run(
+        [sys.executable, 'examples/hello_world/petastorm_dataset/pyspark_hello_world.py',
+         '--dataset-url', hello_world_url],
+        capture_output=True, text=True, timeout=300,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert out.returncode == 0, out.stderr[-800:]
+    assert 'total rows: 10' in out.stdout

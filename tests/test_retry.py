@@ -352,3 +352,35 @@ def test_mutating_ops_not_retried(tmp_path):
     with pytest.raises(OSError):
         fs.move(str(tmp_path / 'a'), str(tmp_path / 'b'))
     assert calls == {'delete': 1, 'move': 1}  # exactly one attempt each
+
+def test_open_parquet_prebuffers_remote_reads(tmp_path, monkeypatch):
+    """Remote (non-local) filesystems get pre_buffer coalescing — asserted on
+    the actual kwarg, and whole row groups still read correctly through a
+    wrapped PyFileSystem with faults."""
+    import pyarrow.parquet as pq_mod
+
+    from petastorm_tpu.native import open_parquet
+
+    seen_kwargs = []
+    real_parquet_file = pq_mod.ParquetFile
+
+    def recording_parquet_file(*args, **kwargs):
+        seen_kwargs.append(kwargs)
+        return real_parquet_file(*args, **kwargs)
+
+    monkeypatch.setattr(pq_mod, 'ParquetFile', recording_parquet_file)
+
+    path = str(tmp_path / 'data.parquet')
+    expected = _write_table(path)
+    flaky, _ = _flaky_fs(fail_opens=1, fail_reads=1)
+    fs = wrap_retrying(flaky, FAST)
+    pf = open_parquet(path, filesystem=fs)
+    assert seen_kwargs and seen_kwargs[-1].get('pre_buffer') is True
+    got = pa.concat_tables(pf.read_row_group(i) for i in range(pf.num_row_groups))
+    assert got.equals(expected)
+    # local filesystems keep the non-prebuffered open
+    import pyarrow.fs as pafs_mod
+    seen_kwargs.clear()
+    open_parquet(path, filesystem=pafs_mod.LocalFileSystem())
+    if seen_kwargs:  # native kernel absent -> pyarrow fallback took this path
+        assert not seen_kwargs[-1].get('pre_buffer')

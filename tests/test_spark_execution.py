@@ -151,3 +151,20 @@ def test_make_spark_converter_jax_loader(spark, tmp_path):
             assert batch['y'].dtype == np.float32
             seen.extend(np.asarray(batch['x']).tolist())
     assert sorted(seen) == list(range(32))
+
+
+def test_dataset_as_rdd_more_partitions_than_row_groups(spark, tmp_path):
+    """defaultParallelism > row groups: surplus partitions come back empty
+    (reference warns-and-yields-nothing semantics) instead of raising the
+    Reader's NoDataAvailableError through the Spark job."""
+    from petastorm_tpu.codecs import ScalarCodec
+    from petastorm_tpu.etl.dataset_metadata import write_petastorm_dataset
+    from petastorm_tpu.spark_utils import dataset_as_rdd
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+
+    schema = Unischema('S', [UnischemaField('id', np.int64, (), ScalarCodec(), False)])
+    url = 'file://' + str(tmp_path / 'tiny')
+    write_petastorm_dataset(url, schema, ({'id': i} for i in range(5)),
+                            rows_per_row_group=5)  # ONE row group, local[3] session
+    rows = dataset_as_rdd(url, spark).collect()
+    assert sorted(r.id for r in rows) == list(range(5))
