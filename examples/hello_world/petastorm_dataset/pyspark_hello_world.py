@@ -13,13 +13,21 @@ import argparse
 
 
 def _spark_session():
-    try:
-        from pyspark.sql import SparkSession
-    except ImportError:
+    def _mini():
         from petastorm_tpu.test_util import minispark
         minispark.install()
         from pyspark.sql import SparkSession
-    return SparkSession.builder.master('local[2]').appName('pstpu-hello').getOrCreate()
+        return SparkSession.builder.master('local[2]').appName('pstpu-hello').getOrCreate()
+
+    try:
+        from pyspark.sql import SparkSession
+    except ImportError:
+        return _mini()
+    try:
+        return SparkSession.builder.master('local[2]').appName('pstpu-hello').getOrCreate()
+    except Exception as e:  # noqa: BLE001 — e.g. pyspark installed but no JVM
+        print('pyspark session failed ({}); falling back to minispark'.format(e))
+        return _mini()
 
 
 def pyspark_hello_world(dataset_url='file:///tmp/hello_world_dataset'):

@@ -7,14 +7,18 @@ image path. Availability is probed like the other native targets: any
 build/load failure makes :func:`is_available` False and
 ``CompressedImageCodec`` stays on its per-image OpenCV path.
 
-Threading: ``threads`` defaults to the ``PSTPU_IMG_THREADS`` env var, else 1.
-Inside a reader worker pool 1 is right — the pool already parallelizes across
-row groups and the GIL is released for the whole column either way. Raise it
-for single-threaded callers (dummy pool, benchmarks).
+Threading: ``PSTPU_IMG_THREADS`` is the per-PROCESS native decode thread
+budget (default: CPU count), shared cooperatively across concurrent calls
+(:func:`_thread_grant`): a lone caller (dummy pool, benchmark, narrow reader)
+fans its column out across all idle cores, while a full worker pool's
+concurrent calls each take the free remainder (floor 1) — total decode
+threads stay ~budget instead of pool_width x budget. Pass ``threads=N``
+explicitly to bypass the accounting.
 """
 
 from __future__ import annotations
 
+import contextlib
 import ctypes
 import logging
 import os
@@ -83,10 +87,45 @@ def is_available():
 
 
 def _default_threads():
+    """The per-PROCESS native decode thread budget (``PSTPU_IMG_THREADS``,
+    default = CPU count). Not a per-call fan-out: concurrent callers share it
+    through :func:`_thread_grant`."""
+    raw = os.environ.get('PSTPU_IMG_THREADS', '')
     try:
-        return max(1, int(os.environ.get('PSTPU_IMG_THREADS', '1')))
+        if raw:
+            return max(1, int(raw))
     except ValueError:
-        return 1
+        pass
+    return max(1, os.cpu_count() or 1)
+
+
+_budget_lock = threading.Lock()
+_threads_in_use = 0
+
+
+@contextlib.contextmanager
+def _thread_grant(requested):
+    """Cooperative intra-call fan-out: ``requested=None`` (the default) takes
+    whatever share of the process-wide budget is currently free (floor 1, so
+    callers always proceed) and returns it afterwards — a lone worker decoding
+    a column fans out across all idle cores, while a full worker pool's
+    concurrent calls naturally degrade to ~1 thread each instead of
+    oversubscribing cores by pool_width x budget (the failure mode the old
+    'leave PSTPU_IMG_THREADS=1 inside pools' guidance worked around). An
+    explicit integer bypasses the accounting (the caller's exact contract)."""
+    if requested is not None:
+        yield max(1, int(requested))
+        return
+    global _threads_in_use
+    budget = _default_threads()
+    with _budget_lock:
+        grant = max(1, budget - _threads_in_use)
+        _threads_in_use += grant
+    try:
+        yield grant
+    finally:
+        with _budget_lock:
+            _threads_in_use -= grant
 
 
 def decode_images(buffers, threads=None, min_size=None):
@@ -132,9 +171,9 @@ def decode_images(buffers, threads=None, min_size=None):
         outs.append(arr)
         out_ptrs[i] = arr.ctypes.data
 
-    rc = lib.pstpu_img_decode_batch2(n, ptrs, lens, out_ptrs, infos_p,
-                                     threads if threads is not None else _default_threads(),
-                                     min_w, min_h)
+    with _thread_grant(threads) as fanout:
+        rc = lib.pstpu_img_decode_batch2(n, ptrs, lens, out_ptrs, infos_p, fanout,
+                                         min_w, min_h)
     if rc != -1:
         raise NativeDecodeError('image decode failed at index {}: {}'.format(
             rc, lib.pstpu_img_last_error().decode(errors='replace')), index=rc)
@@ -190,9 +229,9 @@ def decode_images_auto(buffers, threads=None, min_size=None):
             arr = np.empty((h, w) if c == 1 else (h, w, c), dtype=dtype)
             result.append(arr)
             out_ptrs[i] = arr.ctypes.data
-    rc = lib.pstpu_img_decode_batch2(n, ptrs, lens, out_ptrs, infos_p,
-                                     threads if threads is not None else _default_threads(),
-                                     min_w, min_h)
+    with _thread_grant(threads) as fanout:
+        rc = lib.pstpu_img_decode_batch2(n, ptrs, lens, out_ptrs, infos_p, fanout,
+                                         min_w, min_h)
     if rc != -1:
         raise NativeDecodeError('image decode failed at index {}: {}'.format(
             rc, lib.pstpu_img_last_error().decode(errors='replace')), index=rc)
@@ -283,9 +322,9 @@ def decode_images_resized(buffers, size, threads=None, min_size=None):
     stride = out.strides[0]
     base = out.ctypes.data
     out_ptrs = (ctypes.c_void_p * n)(*[base + i * stride for i in range(n)])
-    rc = lib.pstpu_img_decode_resize_batch(n, ptrs, lens, out_ptrs, infos_p,
-                                           threads if threads is not None else _default_threads(),
-                                           min_w, min_h, out_w, out_h)
+    with _thread_grant(threads) as fanout:
+        rc = lib.pstpu_img_decode_resize_batch(n, ptrs, lens, out_ptrs, infos_p,
+                                               fanout, min_w, min_h, out_w, out_h)
     if rc != -1:
         raise NativeDecodeError('image decode+resize failed at index {}: {}'.format(
             rc, lib.pstpu_img_last_error().decode(errors='replace')), index=rc)

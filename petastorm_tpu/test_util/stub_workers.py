@@ -84,3 +84,12 @@ class HardExitWorker(WorkerBase):
         if item == self.args.get('crash_on', 0):
             os._exit(13)
         self.publish([item])
+
+
+class EnvEchoWorker(WorkerBase):
+    """Publishes the value of the env var named in ``args`` as seen INSIDE the
+    worker (process pools: the spawned child's environment)."""
+
+    def process(self, item):
+        import os
+        self.publish((item, os.environ.get(self.args)))

@@ -274,7 +274,7 @@ class ProcessPool(object):
                 target=_worker_bootstrap,
                 args=(worker_id, os.getpid(), setup_blob, vent_addr, result_addr, control_addr,
                       self._results_hwm, ring_names[worker_id],
-                      self._blob_dir, self._blob_threshold),
+                      self._blob_dir, self._blob_threshold, self._workers_count),
                 daemon=True)
             p.start()
             self._processes.append(p)
@@ -428,10 +428,19 @@ class ProcessPool(object):
 # ---------------------------------------------------------------------------
 
 def _worker_bootstrap(worker_id, main_pid, setup_blob, vent_addr, result_addr, control_addr,
-                      results_hwm, ring_name=None, blob_dir=None, blob_threshold=0):
+                      results_hwm, ring_name=None, blob_dir=None, blob_threshold=0,
+                      workers_count=1):
     """Entry point of a spawned worker process. ``ring_name`` selects the shm
     results transport; None = zmq PUSH. ``blob_dir`` enables the large-payload
     /dev/shm sidechannel."""
+    # The native image-decode thread budget is PER-PROCESS state — sibling
+    # workers cannot see each other's grants — so each spawned worker gets an
+    # equal share of the host's cores (unless the user pinned the env var
+    # explicitly, which children inherit and honor).
+    if 'PSTPU_IMG_THREADS' not in os.environ:
+        os.environ['PSTPU_IMG_THREADS'] = str(
+            max(1, (os.cpu_count() or 1) // max(1, workers_count)))
+
     worker_class, worker_setup_args, serializer = pickle.loads(setup_blob)
 
     _start_orphan_monitor(main_pid)

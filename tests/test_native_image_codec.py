@@ -585,3 +585,34 @@ def test_resize_policy_dispatch():
     # fused native path agrees within rounding on the mild branch
     out = image_codec.decode_images_resized([_png(img)], (32, 32))
     assert np.abs(out[0].astype(int) - ref.astype(int)).max() <= 1
+
+
+def test_thread_budget_cooperative_grants(monkeypatch):
+    """threads=None callers share the process budget: the first concurrent
+    caller gets the free budget, later ones get the floor of 1, and every
+    grant is returned."""
+    monkeypatch.setattr(image_codec, '_default_threads', lambda: 4)
+    with image_codec._thread_grant(None) as g1:
+        assert g1 == 4
+        with image_codec._thread_grant(None) as g2:
+            assert g2 == 1  # budget exhausted: floor keeps the caller moving
+        with image_codec._thread_grant(None) as g3:
+            assert g3 == 1
+    with image_codec._thread_grant(None) as g4:
+        assert g4 == 4  # fully returned
+    assert image_codec._threads_in_use == 0
+    # explicit request bypasses the accounting entirely
+    with image_codec._thread_grant(2) as g5:
+        assert g5 == 2
+    assert image_codec._threads_in_use == 0
+
+
+def test_thread_budget_decode_results_identical(monkeypatch):
+    monkeypatch.setattr(image_codec, '_default_threads', lambda: 3)
+    imgs = [rng.integers(0, 256, (30 + i, 20, 3), np.uint8) for i in range(12)]
+    blobs = [_png(im) for im in imgs]
+    budgeted = image_codec.decode_images(blobs)  # threads=None -> grant path
+    single = image_codec.decode_images(blobs, threads=1)
+    for b, s in zip(budgeted, single):
+        np.testing.assert_array_equal(b, s)
+    assert image_codec._threads_in_use == 0

@@ -657,3 +657,26 @@ def test_stale_blob_dirs_swept_on_pool_start(tmp_path):
     finally:
         child.kill()
         child.wait()
+
+def test_process_pool_divides_image_thread_budget(monkeypatch):
+    """Spawned workers cannot see each other's in-process decode-thread
+    accounting, so each gets cpu_count // workers_count via the env var —
+    unless the user pinned it, which children inherit untouched."""
+    from petastorm_tpu.test_util.stub_workers import EnvEchoWorker
+
+    monkeypatch.delenv('PSTPU_IMG_THREADS', raising=False)
+    pool = ProcessPool(2)
+    pool.start(EnvEchoWorker, worker_setup_args='PSTPU_IMG_THREADS')
+    pool.ventilate(1)
+    _, value = pool.get_results()
+    pool.stop(); pool.join()
+    expected = max(1, (os.cpu_count() or 1) // 2)
+    assert value == str(expected)
+
+    monkeypatch.setenv('PSTPU_IMG_THREADS', '7')
+    pool = ProcessPool(2)
+    pool.start(EnvEchoWorker, worker_setup_args='PSTPU_IMG_THREADS')
+    pool.ventilate(1)
+    _, value = pool.get_results()
+    pool.stop(); pool.join()
+    assert value == '7'  # explicit pin inherited as-is
