@@ -607,30 +607,94 @@ void bilinear_axis(int in_len, int out_len, std::vector<int>& lo, std::vector<fl
   }
 }
 
+// Fixed-point separable bilinear (cv2 INTER_LINEAR arithmetic: Q11 coeffs,
+// horizontal pass into Q11-scaled int32 rows, vertical blend in Q22 with
+// round-half-up >> 22). Horizontal-resized source rows are cached in a 2-row
+// rolling window — each source row is h-resized ONCE even though consecutive
+// output rows share taps — and the vertical blend is a contiguous int32 loop
+// the compiler auto-vectorizes.
+constexpr int kResizeBits = 11;
+constexpr int kResizeScale = 1 << kResizeBits;  // 2048, cv2's INTER_RESIZE_COEF_SCALE
+
 void resize_bilinear(const uint8_t* src, int sw, int sh, int c, uint8_t* dst, int dw, int dh) {
   std::vector<int> xlo, ylo;
   std::vector<float> xw, yw;
   bilinear_axis(sw, dw, xlo, xw);
   bilinear_axis(sh, dh, ylo, yw);
-  // horizontal-first separable: one float row reused across the two taps of
-  // each output row would need caching; simpler and still fast — per output
-  // row, blend the two source rows into a float row, then sample horizontally
-  std::vector<float> row(size_t(sw) * c);
-  for (int oy = 0; oy < dh; oy++) {
-    const uint8_t* r0 = src + size_t(ylo[oy]) * sw * c;
-    const uint8_t* r1 = src + size_t(std::min(ylo[oy] + 1, sh - 1)) * sw * c;
-    const float fy = yw[oy], gy = 1.0f - fy;
-    for (int i = 0; i < sw * c; i++) row[size_t(i)] = gy * r0[i] + fy * r1[i];
-    uint8_t* drow = dst + size_t(oy) * dw * c;
-    for (int ox = 0; ox < dw; ox++) {
-      const int s = xlo[ox] * c;
-      const int s2 = std::min(xlo[ox] + 1, sw - 1) * c;
-      const float fx = xw[ox], gx = 1.0f - fx;
-      for (int ch = 0; ch < c; ch++) {
-        const float v = gx * row[size_t(s + ch)] + fx * row[size_t(s2 + ch)];
-        const int q = int(v + 0.5f);
-        drow[ox * c + ch] = uint8_t(q < 0 ? 0 : (q > 255 ? 255 : q));
+  const int row_len = dw * c;
+
+  std::vector<int16_t> xcoef(size_t(dw) * 2);
+  for (int ox = 0; ox < dw; ox++) {
+    const int w1 = int(xw[ox] * kResizeScale + 0.5f);
+    xcoef[size_t(ox) * 2] = int16_t(kResizeScale - w1);
+    xcoef[size_t(ox) * 2 + 1] = int16_t(w1);
+  }
+
+  // rolling cache: h-resized (Q11) versions of the two source rows feeding
+  // the current output row
+  std::vector<int32_t> hbuf(size_t(row_len) * 2);
+  int cached[2] = {-1, -1};
+
+  // precomputed per-output-x source offsets keep the hot loops free of the
+  // min() clamp and the *c multiply
+  std::vector<int> xs0(dw), xs1(dw);
+  for (int ox = 0; ox < dw; ox++) {
+    xs0[ox] = xlo[ox] * c;
+    xs1[ox] = std::min(xlo[ox] + 1, sw - 1) * c;
+  }
+
+  auto hresize = [&](int sy, int slot) {
+    const uint8_t* srow = src + size_t(sy) * sw * c;
+    int32_t* out = hbuf.data() + size_t(slot) * row_len;
+    if (c == 3) {  // the dominant case: unrolled channel chain
+      for (int ox = 0; ox < dw; ox++) {
+        const uint8_t* a = srow + xs0[ox];
+        const uint8_t* b = srow + xs1[ox];
+        const int w0 = xcoef[size_t(ox) * 2], w1 = xcoef[size_t(ox) * 2 + 1];
+        int32_t* o = out + ox * 3;
+        o[0] = w0 * a[0] + w1 * b[0];
+        o[1] = w0 * a[1] + w1 * b[1];
+        o[2] = w0 * a[2] + w1 * b[2];
       }
+    } else {
+      for (int ox = 0; ox < dw; ox++) {
+        const uint8_t* a = srow + xs0[ox];
+        const uint8_t* b = srow + xs1[ox];
+        const int w0 = xcoef[size_t(ox) * 2], w1 = xcoef[size_t(ox) * 2 + 1];
+        for (int ch = 0; ch < c; ch++) {
+          out[ox * c + ch] = w0 * a[ch] + w1 * b[ch];
+        }
+      }
+    }
+    cached[slot] = sy;
+  };
+
+  for (int oy = 0; oy < dh; oy++) {
+    const int y0 = ylo[oy];
+    const int y1 = std::min(y0 + 1, sh - 1);
+    // keep an already-resized row when the window slides by one (y0 ==
+    // previous y1): move it to slot 0 by swapping the slot roles
+    int slot0 = (cached[0] == y0) ? 0 : (cached[1] == y0 ? 1 : -1);
+    if (slot0 < 0) {
+      hresize(y0, 0);
+      slot0 = 0;
+    }
+    const int other = 1 - slot0;
+    int slot1 = (y1 == y0) ? slot0 : ((cached[other] == y1) ? other : -1);
+    if (slot1 < 0) {
+      hresize(y1, other);
+      slot1 = other;
+    }
+    const int32_t* r0 = hbuf.data() + size_t(slot0) * row_len;
+    const int32_t* r1 = hbuf.data() + size_t(slot1) * row_len;
+    const int w1 = int(yw[oy] * kResizeScale + 0.5f);
+    const int w0 = kResizeScale - w1;
+    uint8_t* drow = dst + size_t(oy) * dw * c;
+    constexpr int kRound = 1 << (2 * kResizeBits - 1);
+    for (int i = 0; i < row_len; i++) {
+      // Q11*Q11 = Q22; max 2048*2048*255 < 2^31 — no overflow
+      const int32_t v = (w0 * r0[i] + w1 * r1[i] + kRound) >> (2 * kResizeBits);
+      drow[i] = uint8_t(v < 0 ? 0 : (v > 255 ? 255 : v));
     }
   }
 }

@@ -87,15 +87,25 @@ def is_available():
 
 
 def _default_threads():
-    """The per-PROCESS native decode thread budget (``PSTPU_IMG_THREADS``,
-    default = CPU count). Not a per-call fan-out: concurrent callers share it
-    through :func:`_thread_grant`."""
-    raw = os.environ.get('PSTPU_IMG_THREADS', '')
-    try:
-        if raw:
+    """The per-PROCESS native decode thread budget (``PSTPU_IMG_THREADS``).
+    Not a per-call fan-out: concurrent callers share it through
+    :func:`_thread_grant`.
+
+    Unset: CPU count in a top-level process; 1 in a multiprocessing CHILD not
+    configured by our own pool bootstrap (torch DataLoader workers, user
+    process fan-outs) — sibling processes cannot see each other's grants, so
+    each claiming the full budget would oversubscribe cores by the sibling
+    count. Set-but-unparseable degrades to 1 (the safe floor), never to the
+    full budget."""
+    raw = os.environ.get('PSTPU_IMG_THREADS')
+    if raw is not None:
+        try:
             return max(1, int(raw))
-    except ValueError:
-        pass
+        except ValueError:
+            return 1
+    import multiprocessing
+    if multiprocessing.parent_process() is not None:
+        return 1
     return max(1, os.cpu_count() or 1)
 
 

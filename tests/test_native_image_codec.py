@@ -616,3 +616,37 @@ def test_thread_budget_decode_results_identical(monkeypatch):
     for b, s in zip(budgeted, single):
         np.testing.assert_array_equal(b, s)
     assert image_codec._threads_in_use == 0
+
+
+def test_default_thread_budget_safety(monkeypatch):
+    # garbage env degrades to the safe floor, never the full budget
+    monkeypatch.setenv('PSTPU_IMG_THREADS', 'auto')
+    assert image_codec._default_threads() == 1
+    monkeypatch.setenv('PSTPU_IMG_THREADS', '')
+    assert image_codec._default_threads() == 1
+    monkeypatch.setenv('PSTPU_IMG_THREADS', '6')
+    assert image_codec._default_threads() == 6
+    # unset in a top-level process: CPU count
+    monkeypatch.delenv('PSTPU_IMG_THREADS')
+    import os as os_mod
+    assert image_codec._default_threads() == max(1, os_mod.cpu_count() or 1)
+
+
+def _child_budget(q):
+    import os
+    os.environ.pop('PSTPU_IMG_THREADS', None)
+    from petastorm_tpu.native import image_codec as ic
+    q.put(ic._default_threads())
+
+
+def test_default_thread_budget_in_mp_child_is_one(monkeypatch):
+    """A multiprocessing child NOT configured by our pool bootstrap defaults
+    to 1 — N sibling processes each claiming cpu_count would oversubscribe."""
+    import multiprocessing
+    monkeypatch.delenv('PSTPU_IMG_THREADS', raising=False)
+    ctx = multiprocessing.get_context('spawn')
+    q = ctx.Queue()
+    p = ctx.Process(target=_child_budget, args=(q,))
+    p.start()
+    assert q.get(timeout=60) == 1
+    p.join()
