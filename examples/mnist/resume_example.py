@@ -1,0 +1,148 @@
+"""Crash-safe MNIST training: joint model + data-position checkpointing.
+
+The reference cannot resume a read mid-epoch (its own §5 gap). Here the FULL
+training position checkpoints atomically-enough for real jobs: the flax train
+state goes through orbax (the JAX-native checkpointer, async-safe, versioned)
+and the loader's read position (`JaxDataLoader.state_dict()` — reader
+position + buffered rows + shuffle RNG) rides next to it. A restart resumes
+BOTH: no replayed epochs, no silently skipped rows, and with a fixed seed (and
+a deterministic-order pool — see ``train_with_checkpointing``) the resumed
+stream replays bitwise.
+
+Run:  python examples/mnist/resume_example.py --dataset-url file:///tmp/mnist \
+          --checkpoint-dir /tmp/mnist_ckpt --total-steps 200
+Kill it anywhere; re-run the same command and it continues where it stopped.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import pickle
+import sys
+
+import jax
+import jax.numpy as jnp
+
+try:
+    from examples.mnist.jax_example import TRANSFORM
+except ImportError:
+    # run as a script: the repo root is not on sys.path, and an unrelated
+    # site-packages 'examples' package may already have won the name
+    sys.modules.pop('examples', None)
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))))
+    from examples.mnist.jax_example import TRANSFORM
+from petastorm_tpu import make_reader
+from petastorm_tpu.jax import JaxDataLoader
+from petastorm_tpu.models import MnistCNN
+from petastorm_tpu.models.train import create_train_state, make_train_step
+
+LOADER_STATE_FILE = 'loader_state.pkl'
+
+
+def _save(checkpoint_dir, step, state, loader_state):
+    import orbax.checkpoint as ocp
+
+    path = os.path.join(checkpoint_dir, 'step_{:08d}'.format(step))
+    if os.path.isdir(path) and not os.path.exists(os.path.join(path, 'DONE')):
+        # leftover of a crash INSIDE a previous save of this very step: without
+        # this sweep orbax would refuse the existing destination forever and
+        # the job could never recover past the step it died on
+        import shutil
+        shutil.rmtree(path)
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(os.path.join(path, 'train_state'), state)
+    ckptr.close()  # block until the async finalize (tmp-dir rename) completes
+    with open(os.path.join(path, LOADER_STATE_FILE), 'wb') as f:
+        pickle.dump(loader_state, f)
+    # the marker makes the checkpoint visible only once COMPLETE (a crash
+    # mid-save leaves no half checkpoint to resume from)
+    with open(os.path.join(path, 'DONE'), 'w') as f:
+        f.write(str(step))
+
+
+def _latest(checkpoint_dir):
+    if not os.path.isdir(checkpoint_dir):
+        return None
+    done = [d for d in os.listdir(checkpoint_dir)
+            if d.startswith('step_') and
+            os.path.exists(os.path.join(checkpoint_dir, d, 'DONE'))]
+    if not done:
+        return None
+    return os.path.join(checkpoint_dir, max(done))
+
+
+def _restore(path, template_state):
+    import orbax.checkpoint as ocp
+
+    with ocp.StandardCheckpointer() as ckptr:
+        state = ckptr.restore(os.path.join(path, 'train_state'), template_state)
+    with open(os.path.join(path, LOADER_STATE_FILE), 'rb') as f:
+        loader_state = pickle.load(f)
+    return state, loader_state
+
+
+def train_with_checkpointing(dataset_url, checkpoint_dir, total_steps=100,
+                             checkpoint_every=25, batch_size=32, lr=0.05, seed=0,
+                             reader_pool_type='thread'):
+    """Train to ``total_steps``, checkpointing every ``checkpoint_every``;
+    automatically resumes from the latest complete checkpoint in
+    ``checkpoint_dir``. Returns the final train state.
+
+    Replay semantics: resume never loses or double-counts a DELIVERED row
+    (the loader state carries buffered rows exactly). Bitwise-identical
+    replay of the post-resume stream additionally needs a deterministic
+    delivery ORDER — ``reader_pool_type='dummy'`` (or 1 worker); with a
+    multi-worker pool, row-group arrival order is scheduling-dependent."""
+    model = MnistCNN()
+    state = create_train_state(model, jax.random.PRNGKey(seed),
+                               jnp.zeros((1, 28, 28)), learning_rate=lr)
+    train_step = make_train_step()
+
+    loader_state = None
+    latest = _latest(checkpoint_dir)
+    if latest is not None:
+        state, loader_state = _restore(latest, state)
+        print('resumed from {} (step {})'.format(latest, int(state.step)))
+    if int(state.step) >= total_steps:
+        return state
+
+    reader = make_reader(
+        dataset_url + '/train', num_epochs=None, seed=seed,
+        transform_spec=TRANSFORM, reader_pool_type=reader_pool_type,
+        resume_state=None if loader_state is None else loader_state['reader'])
+    with JaxDataLoader(reader, batch_size, shuffling_queue_capacity=256, seed=seed,
+                       to_device=jax.devices()[0],
+                       resume_state=loader_state) as loader:
+        for batch in loader:
+            state, metrics = train_step(state, batch['image'], batch['digit'])
+            step = int(state.step)
+            if step % checkpoint_every == 0 or step >= total_steps:
+                # state_dict BEFORE touching the next batch: the saved position
+                # is exactly "everything up to and including this step's batch"
+                _save(checkpoint_dir, step, jax.device_get(state), loader.state_dict())
+                print('step {}: loss={:.4f} (checkpointed)'.format(
+                    step, float(metrics['loss'])))
+            if step >= total_steps:
+                break
+    return state
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('--dataset-url', default='file:///tmp/mnist_dataset')
+    parser.add_argument('--checkpoint-dir', default='/tmp/mnist_ckpt')
+    parser.add_argument('--total-steps', type=int, default=100)
+    parser.add_argument('--checkpoint-every', type=int, default=25)
+    parser.add_argument('--batch-size', type=int, default=32)
+    parser.add_argument('--lr', type=float, default=0.05)
+    parser.add_argument('--seed', type=int, default=0)
+    args = parser.parse_args()
+    train_with_checkpointing(args.dataset_url, args.checkpoint_dir,
+                             args.total_steps, args.checkpoint_every,
+                             args.batch_size, lr=args.lr, seed=args.seed)
+
+
+if __name__ == '__main__':
+    main()
