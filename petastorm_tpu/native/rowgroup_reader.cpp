@@ -20,6 +20,9 @@
 #include <parquet/metadata.h>
 #include <parquet/properties.h>
 
+#include <fcntl.h>
+
+#include <algorithm>
 #include <cstring>
 #include <memory>
 #include <mutex>
@@ -35,11 +38,44 @@ void set_error(const std::string& msg) { g_last_error = msg; }
 struct FileHandle {
   std::unique_ptr<parquet::arrow::FileReader> reader;
   std::shared_ptr<parquet::FileMetaData> metadata;
+  int fd = -1;  // borrowed from the underlying ReadableFile (it owns closing)
   // parquet::arrow::FileReader is not thread-safe for concurrent reads of the
   // same handle; worker threads each own a handle, but guard anyway so a
   // shared handle degrades to serialized reads instead of corruption.
   std::mutex mutex;
 };
+
+// Best-effort page-cache readahead of the column chunks the caller is about
+// to decode (the SELECTED columns only — advising the whole group would
+// defeat column projection's IO savings on wide tables). A cold-cache decode
+// otherwise interleaves demand-paged 64-128KB reads with CPU work; WILLNEED
+// lets the kernel stream each chunk's compressed range ahead of the decoder.
+// No next-group prefetch: the ventilator shuffles piece order, so "i+1 of
+// this file" is almost never what gets read next.
+void advise_row_group(FileHandle* h, int i, const int* columns, int n_columns) {
+#if defined(POSIX_FADV_WILLNEED)
+  if (h->fd < 0 || i < 0 || i >= h->metadata->num_row_groups()) return;
+  auto rg = h->metadata->RowGroup(i);
+  const bool subset = columns != nullptr && n_columns >= 0;
+  const int count = subset ? n_columns : rg->num_columns();
+  for (int k = 0; k < count; k++) {
+    const int c = subset ? columns[k] : k;
+    if (c < 0 || c >= rg->num_columns()) continue;
+    auto col = rg->ColumnChunk(c);
+    int64_t chunk_start = col->data_page_offset();
+    if (col->has_dictionary_page() && col->dictionary_page_offset() > 0) {
+      chunk_start = std::min(chunk_start, col->dictionary_page_offset());
+    }
+    const int64_t len = col->total_compressed_size();
+    if (len > 0) (void)posix_fadvise(h->fd, chunk_start, len, POSIX_FADV_WILLNEED);
+  }
+#else
+  (void)h;
+  (void)i;
+  (void)columns;
+  (void)n_columns;
+#endif
+}
 
 }  // namespace
 
@@ -69,6 +105,7 @@ void* pstpu_open(const char* path, int use_threads, long long buffer_size) {
     return nullptr;
   }
   auto handle = std::make_unique<FileHandle>();
+  handle->fd = (*maybe_file)->file_descriptor();
   handle->metadata = pq_reader->metadata();
   parquet::ArrowReaderProperties arrow_props;
   arrow_props.set_use_threads(use_threads != 0);
@@ -134,6 +171,7 @@ int pstpu_read_row_group(void* h, int row_group, const int* columns,
     set_error("row group index out of range");
     return -1;
   }
+  advise_row_group(handle, row_group, columns, n_columns);
   arrow::Result<std::shared_ptr<arrow::Table>> maybe_table =
       (columns != nullptr && n_columns >= 0)
           ? handle->reader->ReadRowGroup(row_group,
