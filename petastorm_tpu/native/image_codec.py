@@ -276,8 +276,11 @@ def _resize_native(img, size, symbol_name):
 def resize_area_image(img, size):
     """Area-resample one decoded uint8 image to ``size=(out_h, out_w)`` with
     the native resampler — the cv2 ``INTER_AREA`` stand-in for OpenCV-less
-    deployments. Returns a new array; raises :class:`NativeDecodeError` when
-    the native library is unavailable."""
+    deployments (within 1 LSB of cv2 when both axes downscale or both
+    upscale; cv2's mixed down+up INTER_AREA is a non-separable special case
+    this separable implementation does not chase). Returns a new array;
+    raises :class:`NativeDecodeError` when the native library is
+    unavailable."""
     return _resize_native(img, size, 'pstpu_img_resize_area')
 
 
