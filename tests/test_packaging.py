@@ -62,41 +62,66 @@ def test_installed_entry_points_run():
         out = subprocess.run([script, '--help'], capture_output=True, timeout=120)
         assert out.returncode == 0, (script, out.stderr[-500:])
 
-
-def test_wheel_builds_with_sources_and_without_tests():
-    """An sdist->wheel build must succeed offline and ship the .cpp kernel
-    sources (compiled on first use) but neither tests nor prebuilt .so.
-
-    Builds from a pristine temp copy of the sources: building in the live tree
-    would drop build/ + egg-info into the repo, and setuptools reuses a stale
-    build/lib without cleaning (deleted modules would silently re-ship)."""
-    import tempfile
-    import zipfile
+@pytest.fixture(scope='module')
+def built_wheel(tmp_path_factory):
+    """Stage a pristine source copy and build the wheel ONCE for the module
+    (building in the live tree would drop build/ + egg-info into the repo, and
+    setuptools reuses a stale build/lib without cleaning). Skips when pip is
+    unavailable."""
     try:
         subprocess.run([sys.executable, '-m', 'pip', '--version'],
                        capture_output=True, check=True, timeout=60)
     except (subprocess.CalledProcessError, OSError):
         pytest.skip('pip unavailable')
-    with tempfile.TemporaryDirectory() as d:
-        srcdir = os.path.join(d, 'src')
-        os.makedirs(srcdir)
-        for f in ('pyproject.toml', 'README.md'):
-            shutil.copy(os.path.join(REPO, f), srcdir)
-        shutil.copytree(
-            os.path.join(REPO, 'petastorm_tpu'), os.path.join(srcdir, 'petastorm_tpu'),
-            ignore=shutil.ignore_patterns('__pycache__', '*.so', '*.so.*', '*.lock', '*.stamp'))
-        out = subprocess.run(
-            [sys.executable, '-m', 'pip', 'wheel', srcdir, '--no-build-isolation',
-             '--no-deps', '-w', d, '-q'],
-            capture_output=True, timeout=600)
-        # offline-safe flags: a nonzero exit is a real packaging regression
-        assert out.returncode == 0, out.stderr[-1000:]
-        wheels = [f for f in os.listdir(d) if f.endswith('.whl')]
-        assert len(wheels) == 1
-        names = zipfile.ZipFile(os.path.join(d, wheels[0])).namelist()
-        from petastorm_tpu.native import build
-        expected = {'petastorm_tpu/native/' + os.path.basename(s)
-                    for s in (build.SOURCE, build.SHM_SOURCE, build.IMG_SOURCE)}
-        assert {n for n in names if n.endswith('.cpp')} == expected
-        assert not any(n.startswith('tests/') for n in names)
-        assert not any(n.endswith('.so') for n in names)
+    d = tmp_path_factory.mktemp('wheelbuild')
+    srcdir = os.path.join(str(d), 'src')
+    os.makedirs(srcdir)
+    for f in ('pyproject.toml', 'README.md'):
+        shutil.copy(os.path.join(REPO, f), srcdir)
+    shutil.copytree(
+        os.path.join(REPO, 'petastorm_tpu'), os.path.join(srcdir, 'petastorm_tpu'),
+        ignore=shutil.ignore_patterns('__pycache__', '*.so', '*.so.*', '*.lock', '*.stamp'))
+    wheeldir = os.path.join(str(d), 'wheels')
+    out = subprocess.run(
+        [sys.executable, '-m', 'pip', 'wheel', srcdir, '--no-build-isolation',
+         '--no-deps', '-w', wheeldir, '-q'], capture_output=True, timeout=600)
+    # offline-safe flags: a nonzero exit is a real packaging regression
+    assert out.returncode == 0, out.stderr[-1000:]
+    wheels = [f for f in os.listdir(wheeldir) if f.endswith('.whl')]
+    assert len(wheels) == 1
+    return os.path.join(wheeldir, wheels[0])
+
+
+def test_wheel_builds_with_sources_and_without_tests(built_wheel):
+    """The wheel ships the .cpp kernel sources (compiled on first use) but
+    neither tests nor prebuilt .so."""
+    import zipfile
+    names = zipfile.ZipFile(built_wheel).namelist()
+    from petastorm_tpu.native import build
+    expected = {'petastorm_tpu/native/' + os.path.basename(s)
+                for s in (build.SOURCE, build.SHM_SOURCE, build.IMG_SOURCE)}
+    assert {n for n in names if n.endswith('.cpp')} == expected
+    assert not any(n.startswith('tests/') for n in names)
+    assert not any(n.endswith('.so') for n in names)
+
+
+def test_wheel_installs_and_imports_from_target(built_wheel, tmp_path):
+    """The built wheel must actually import when installed standalone (catches
+    missing py files/package-data that content listing alone would not)."""
+    target = str(tmp_path / 'site')
+    out = subprocess.run(
+        [sys.executable, '-m', 'pip', 'install', built_wheel, '--no-deps',
+         '--target', target, '-q'], capture_output=True, timeout=600)
+    assert out.returncode == 0, out.stderr[-500:]
+    # import ONLY from the target (cwd moved away; repo not on path)
+    probe = ("import sys; sys.path.insert(0, {!r}); "
+             "import petastorm_tpu; "
+             "assert petastorm_tpu.__file__.startswith({!r}), petastorm_tpu.__file__; "
+             "from petastorm_tpu import make_reader, make_batch_reader; "
+             "from petastorm_tpu.native import build; "
+             "import os; assert os.path.exists(build.IMG_SOURCE); "
+             "print('WHEEL IMPORT OK')").format(target, target)
+    out = subprocess.run([sys.executable, '-c', probe], capture_output=True,
+                         text=True, timeout=120, cwd=str(tmp_path))
+    assert out.returncode == 0, out.stderr[-500:]
+    assert 'WHEEL IMPORT OK' in out.stdout
