@@ -9,7 +9,8 @@ device-side half of the input pipeline.
 """
 
 from petastorm_tpu.ops.preprocess import normalize_images  # noqa: F401
-from petastorm_tpu.ops.augment import random_flip, random_crop  # noqa: F401
+from petastorm_tpu.ops.augment import (random_flip, random_crop,  # noqa: F401
+                                       mixup, cutmix)
 from petastorm_tpu.ops.ring_attention import make_ring_attention, ring_attention  # noqa: F401
 from petastorm_tpu.ops.ulysses_attention import (make_ulysses_attention,  # noqa: F401
                                                  ulysses_attention)

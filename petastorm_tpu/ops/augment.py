@@ -1,11 +1,12 @@
-"""On-device batch augmentation (random flip, random crop).
+"""On-device batch augmentation (random flip/crop, mixup, cutmix).
 
 The reference leaves augmentation to user TransformSpec functions running on
 CPU workers (reference transform.py:19-40, examples/mnist/pytorch_example.py).
 These equivalents run inside jit on the TPU: static output shapes, no Python
-control flow, per-image randomness from a single threaded `jax.random` key —
-so the augmentation is reproducible under the reader's seed and costs no host
-CPU.
+control flow, randomness from threaded `jax.random` keys — reproducible under
+the reader's seed, zero host CPU. Flip/crop draw PER-IMAGE randomness; mixup
+and cutmix follow their papers' standard batch formulation (ONE lam — and for
+cutmix one rectangle — per step, shared across the batch).
 """
 
 from __future__ import annotations
@@ -47,3 +48,74 @@ def random_crop(images, key, crop_h, crop_w):
         return jax.lax.dynamic_slice(img, (y, x, 0), (crop_h, crop_w, c))
 
     return jax.vmap(crop_one)(images, ys, xs)
+
+
+def mixup(images, labels, key, alpha=0.2, num_classes=None):
+    """Batch mixup (Zhang et al. 2018) on device: each image blends with a
+    permuted partner, ``lam ~ Beta(alpha, alpha)`` shared across the batch
+    (the standard formulation — one draw per step keeps the op a fused
+    elementwise blend on the TPU, no per-image gathers beyond the permutation).
+
+    ``labels``: integer ``(B,)`` (requires ``num_classes``; returns soft
+    ``(B, num_classes)``) or already-soft ``(B, num_classes)``.
+    Returns ``(mixed_images, mixed_labels)``; images blend in float32 and are
+    cast back to the input dtype (uint8 batches round).
+    """
+    if images.ndim != 4:
+        raise ValueError('images must be (B, H, W, C), got shape {}'.format(images.shape))
+    b = images.shape[0]
+    kperm, klam = jax.random.split(key)
+    perm = jax.random.permutation(kperm, b)
+    lam = jax.random.beta(klam, alpha, alpha)
+    lam = jnp.maximum(lam, 1.0 - lam)  # keep the ORIGINAL image dominant
+    soft = _soft_labels(labels, num_classes)
+    x = images.astype(jnp.float32)
+    mixed = lam * x + (1.0 - lam) * x[perm]
+    if jnp.issubdtype(images.dtype, jnp.integer):
+        mixed = jnp.round(mixed)
+    return mixed.astype(images.dtype), lam * soft + (1.0 - lam) * soft[perm]
+
+
+def cutmix(images, labels, key, alpha=1.0, num_classes=None):
+    """Batch CutMix (Yun et al. 2019) on device: ONE random rectangle per
+    step (shared across the batch, per the paper's batch formulation) is
+    replaced in each image by its permuted partner's pixels; labels blend by
+    the realized pasted-area fraction. The rectangle is applied as a coordinate MASK
+    (broadcasted iota comparisons), so shapes stay static under jit — no
+    dynamic-size slices.
+    """
+    if images.ndim != 4:
+        raise ValueError('images must be (B, H, W, C), got shape {}'.format(images.shape))
+    b, h, w, _ = images.shape
+    kperm, klam, ky, kx = jax.random.split(key, 4)
+    perm = jax.random.permutation(kperm, b)
+    lam = jax.random.beta(klam, alpha, alpha)
+    cut_ratio = jnp.sqrt(1.0 - lam)
+    cut_h = (cut_ratio * h).astype(jnp.int32)
+    cut_w = (cut_ratio * w).astype(jnp.int32)
+    cy = jax.random.randint(ky, (), 0, h)
+    cx = jax.random.randint(kx, (), 0, w)
+    y0 = jnp.clip(cy - cut_h // 2, 0, h)
+    y1 = jnp.clip(cy + cut_h // 2, 0, h)
+    x0 = jnp.clip(cx - cut_w // 2, 0, w)
+    x1 = jnp.clip(cx + cut_w // 2, 0, w)
+    rows = jnp.arange(h)[:, None]
+    cols = jnp.arange(w)[None, :]
+    in_box = ((rows >= y0) & (rows < y1) & (cols >= x0) & (cols < x1))
+    mixed = jnp.where(in_box[None, :, :, None], images[perm], images)
+    # label weight from the REALIZED box (clipping can shrink it)
+    box_frac = ((y1 - y0) * (x1 - x0)) / float(h * w)
+    lam_adj = 1.0 - box_frac.astype(jnp.float32)
+    soft = _soft_labels(labels, num_classes)
+    return mixed, lam_adj * soft + (1.0 - lam_adj) * soft[perm]
+
+
+def _soft_labels(labels, num_classes):
+    if labels.ndim == 1:
+        if num_classes is None:
+            raise ValueError('integer labels need num_classes for the soft-label blend')
+        return jax.nn.one_hot(labels, num_classes)
+    if labels.ndim == 2:
+        return labels.astype(jnp.float32)
+    raise ValueError('labels must be (B,) ints or (B, num_classes), got shape {}'.format(
+        labels.shape))

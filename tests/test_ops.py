@@ -297,3 +297,47 @@ def test_pipeline_rejects_wrong_stage_count(rng):
     apply = make_pipelined_apply(mesh, _pipeline_stage, num_microbatches=2)
     with mesh, pytest.raises(ValueError, match='one stage per device'):
         apply(params, jnp.zeros((4, 8)))
+
+
+def test_mixup_blend_and_labels(rng):
+    from petastorm_tpu.ops import mixup
+
+    images = jnp.asarray(rng.integers(0, 255, (8, 6, 6, 3), dtype=np.uint8))
+    labels = jnp.asarray(rng.integers(0, 5, (8,)))
+    key = jax.random.PRNGKey(3)
+    out, soft = jax.jit(lambda i, l, k: mixup(i, l, k, num_classes=5))(images, labels, key)
+    assert out.shape == images.shape and out.dtype == images.dtype
+    assert soft.shape == (8, 5)
+    np.testing.assert_allclose(np.asarray(soft).sum(axis=1), 1.0, atol=1e-5)
+    # deterministic under the same key
+    out2, soft2 = mixup(images, labels, key, num_classes=5)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(out2))
+    # lam >= 0.5: the original image dominates every blend
+    orig = images.astype(np.float32)
+    assert np.abs(np.asarray(out).astype(np.float32) - orig).max() <= 255 * 0.5 + 1
+    # already-soft labels pass through the same blend
+    _, soft3 = mixup(images, jax.nn.one_hot(labels, 5), key)
+    np.testing.assert_allclose(np.asarray(soft3), np.asarray(soft), atol=1e-6)
+    with pytest.raises(ValueError, match='num_classes'):
+        mixup(images, labels, key)  # int labels need num_classes
+
+
+def test_cutmix_box_and_label_fraction(rng):
+    from petastorm_tpu.ops import cutmix
+
+    images = jnp.asarray(rng.integers(0, 255, (6, 16, 16, 3), dtype=np.uint8))
+    labels = jnp.asarray(rng.integers(0, 4, (6,)))
+    key = jax.random.PRNGKey(11)
+    out, soft = jax.jit(lambda i, l, k: cutmix(i, l, k, num_classes=4))(images, labels, key)
+    assert out.shape == images.shape and out.dtype == images.dtype
+    np.testing.assert_allclose(np.asarray(soft).sum(axis=1), 1.0, atol=1e-5)
+    # every pixel comes from either the original or SOME other batch image
+    out_np, img_np = np.asarray(out), np.asarray(images)
+    from_self = (out_np == img_np).all(axis=3)
+    changed_frac = 1.0 - from_self.mean()
+    # the label fraction and the pixel fraction agree (same realized box);
+    # soft rows are lam*self + (1-lam)*partner, so off-own-class mass = 1-lam
+    own = np.take_along_axis(np.asarray(soft), np.asarray(labels)[:, None], axis=1).ravel()
+    # box fraction bound: pixels equal by coincidence can only OVERSTATE
+    # from_self, so changed_frac <= 1-lam_adj
+    assert changed_frac <= (1.0 - own.min()) + 1e-6
