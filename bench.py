@@ -1,19 +1,29 @@
 #!/usr/bin/env python
-"""Headline benchmark: hello_world reader throughput vs the reference.
+"""Headline benchmark: hello_world reader throughput vs the reference, plus
+the north-star duty-cycle sweep whenever a TPU is reachable.
 
 Reproduces the reference's published benchmark configuration
 (docs/benchmarks_tutorial.rst:20-21 -> 709.84 samples/sec): the HelloWorld
 schema (README.rst:70-103 — int32 id + 128x256x3 png image + ragged uint8
 array), default 3 thread workers, pure-python read path, warmup then measured
-cycles. Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+cycles.
 
-Capture hardening (the number recorded by the driver must reflect the
-framework, not cold caches): all three native targets are built BEFORE the
-timed region, the cached dataset is rebuilt when its format stamp is stale,
-one full pass warms the page cache, and the reported value is the median of
-five measured runs, each long enough (~1.5s of reading) that transient host
-contention on the 1-core bench container averages out instead of deciding
-the number.
+Output: one JSON line per duty-sweep point (when a TPU is reachable — probed
+in a killable subprocess, because a wedged tunnel hangs TPU client init
+forever), then a ``duty_sweep_best`` or ``duty_sweep_skipped`` line, then the
+headline ``hello_world_reader_throughput`` line LAST (the driver records the
+stdout tail; the headline must survive truncation). The headline line embeds
+a compact ``duty`` summary so a one-line capture still carries the
+north-star number.
+
+Capture hardening (the recorded number must reflect the framework, not the
+container): native targets are built before timing, the cached dataset is
+rebuilt when its format stamp is stale, one full measured run is discarded as
+warmup, and each of the 7 counted runs records its own CPU share
+(process-CPU-time / wall) — on this 1-core host a run that lost the core to a
+neighbour shows a visibly lower share, and such contended runs are excluded
+from the median with the exclusion recorded, instead of silently bimodalizing
+the number (BENCH_r04 spread 0.117 came from exactly this).
 """
 
 from __future__ import annotations
@@ -22,6 +32,7 @@ import json
 import os
 import statistics
 import sys
+import time
 
 REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, REPO_ROOT)
@@ -32,6 +43,10 @@ NUM_ROWS = 1000
 # bump when the on-disk layout the writer produces changes (a stale cached
 # store would otherwise benchmark an older format forever)
 DATASET_FORMAT_STAMP = 'v2-percolumn-compression'
+
+#: wall-clock budget for the duty sweep subprocess; points stream as they
+#: complete, so a deadline hit still records every finished point
+DUTY_SWEEP_TIMEOUT_S = int(os.environ.get('PSTPU_BENCH_DUTY_TIMEOUT', '2400'))
 
 
 def _build_dataset(url):
@@ -88,6 +103,170 @@ def _warm(url):
             pass
 
 
+def _probe_tpu(timeout_s=90):
+    """(platform, device_count) of the ambient jax backend, probed in a
+    killable subprocess — TPU client init blocks indefinitely when the tunnel
+    is down, so the probe must never run in this process. ('none', 0) on
+    timeout/failure."""
+    import signal
+    import subprocess
+    proc = subprocess.Popen(
+        [sys.executable, '-c',
+         'import jax; d = jax.devices(); print(d[0].platform, len(d))'],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        start_new_session=True)
+    try:
+        out, _ = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)  # pgid == pid (new session)
+        except (OSError, ProcessLookupError):
+            pass
+        proc.wait()
+        return 'none', 0
+    try:
+        platform, count = out.strip().splitlines()[-1].split()
+        return platform, int(count)
+    except (ValueError, IndexError):
+        return 'none', 0
+
+
+def _stream_duty_sweep(deadline_s, cmd=None):
+    """Run ``bench_duty.py --sweep`` in its own session, re-emitting its JSON
+    lines as they arrive so a deadline kill still leaves every completed point
+    on stdout. Reads the pipe with raw ``os.read`` (a buffered TextIOWrapper
+    would hold complete lines where select can't see them) and sends the
+    child's stderr to a temp file (an undrained 64 KiB stderr pipe would
+    deadlock a chatty TPU runtime mid-sweep). Returns
+    (points, error_reason_or_None)."""
+    import selectors
+    import signal
+    import subprocess
+    import tempfile
+
+    cmd = cmd or [sys.executable, os.path.join(REPO_ROOT, 'bench_duty.py'), '--sweep']
+    points = []
+    buf = b''
+
+    def drain(data):
+        nonlocal buf
+        buf += data
+        while b'\n' in buf:
+            line, buf = buf.split(b'\n', 1)
+            line = line.strip()
+            if not line.startswith(b'{'):
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if rec.get('metric') == 'duty_sweep':
+                points.append(rec)
+                print(line.decode(), flush=True)
+
+    with tempfile.TemporaryFile() as errf:
+        proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, stderr=errf,
+                                start_new_session=True, cwd=REPO_ROOT)
+        fd = proc.stdout.fileno()
+        sel = selectors.DefaultSelector()
+        sel.register(proc.stdout, selectors.EVENT_READ)
+        deadline = time.monotonic() + deadline_s
+        timed_out = False
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                timed_out = True
+                break
+            if not sel.select(timeout=min(remaining, 5.0)):
+                if proc.poll() is not None:
+                    break
+                continue
+            data = os.read(fd, 1 << 16)
+            if not data:  # EOF
+                break
+            drain(data)
+        sel.close()
+        if timed_out:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except (OSError, ProcessLookupError):
+                pass
+        proc.wait()
+        while True:  # salvage points already in the pipe at kill/EOF time
+            data = os.read(fd, 1 << 16)
+            if not data:
+                break
+            drain(data)
+        proc.stdout.close()
+        if timed_out:
+            return points, 'deadline ({}s) hit after {} points'.format(
+                deadline_s, len(points))
+        if proc.returncode != 0:
+            errf.seek(0, os.SEEK_END)
+            errf.seek(max(0, errf.tell() - 500))
+            err_tail = errf.read().decode(errors='replace')
+            return points, 'bench_duty exited rc={}: {}'.format(
+                proc.returncode, err_tail.strip().replace('\n', ' | '))
+    return points, None
+
+
+def _duty_section():
+    """The north-star: duty-cycle sweep on the real chip when one is
+    reachable; a recorded, honest skip when the tunnel is down. Returns the
+    compact summary embedded in the headline line."""
+    platform, count = _probe_tpu()
+    if platform != 'tpu' or count < 1:
+        reason = ('no TPU reachable (ambient backend: {}, {} devices; '
+                  'probe runs in a killable subprocess — a wedged tunnel '
+                  'times out instead of hanging)'.format(platform, count))
+        print(json.dumps({'metric': 'duty_sweep_skipped', 'reason': reason}),
+              flush=True)
+        return {'skipped': True, 'reason': reason}
+    points, error = _stream_duty_sweep(DUTY_SWEEP_TIMEOUT_S)
+    if not points:
+        reason = error or 'sweep produced no points'
+        print(json.dumps({'metric': 'duty_sweep_skipped', 'reason': reason,
+                          'device': platform}), flush=True)
+        return {'skipped': True, 'reason': reason}
+    best = min(points, key=lambda p: p['input_stall_fraction'])
+    summary = {
+        'metric': 'duty_sweep_best',
+        'model': best['model'],
+        'step_ms': best['step_ms'],
+        'input_stall_fraction': best['input_stall_fraction'],
+        'duty_cycle': best['duty_cycle'],
+        'examples_per_sec': best['examples_per_sec'],
+        'points': len(points),
+        'meets_bar': best['input_stall_fraction'] <= 0.05,
+        'device': platform,
+    }
+    if error:
+        summary['partial'] = error
+    print(json.dumps(summary), flush=True)
+    return {k: v for k, v in summary.items() if k != 'metric'}
+
+
+def _select_runs(runs):
+    """Contention-aware capture filter: ``runs`` is [(samples_per_sec,
+    cpu_share)]. Runs whose CPU share fell >5 points below the best-observed
+    share lost the core to a neighbour and are excluded (BENCH_r04's 0.117
+    spread was two such runs sitting ~10% low). The median needs >=4 clean
+    runs to use the filter; a capture contended throughout reports all runs,
+    honestly. Returns (median, spread, excluded_throughputs)."""
+    shares = [s for _, s in runs]
+    share_floor = max(shares) - 0.05
+    clean = [r for r, s in runs if s >= share_floor]
+    excluded = [round(r, 2) for r, s in runs if s < share_floor]
+    if len(clean) >= 4:
+        value = statistics.median(clean)
+        spread = (max(clean) - min(clean)) / value if value else 0.0
+    else:
+        value = statistics.median([r for r, _ in runs])
+        spread = (max(r for r, _ in runs) - min(r for r, _ in runs)) / value
+        excluded = []
+    return value, spread, excluded
+
+
 def main():
     url = 'file://' + CACHE_DIR
     _prebuild_native()
@@ -96,28 +275,46 @@ def main():
 
     from petastorm_tpu.tools.throughput import reader_throughput
 
-    def one_run():
-        return reader_throughput(url, warmup_cycles=200, measure_cycles=6000,
-                                 pool_type='thread', workers_count=3,
-                                 shuffle_row_groups=True,
-                                 read_method='python').samples_per_second
+    import functools
 
-    # The r3 capture's 5 runs trended UP monotonically (3904..4934, spread
-    # 0.23): the single warm pass did not fully settle allocator/alloc-cache/
-    # CPU-state warmup on the 1-core container. One full-length measured run
-    # is DISCARDED before the 5 that count.
-    discarded = one_run()
-    runs = [one_run() for _ in range(5)]
-    value = statistics.median(runs)
-    spread = (max(runs) - min(runs)) / value if value else 0.0
+    from petastorm_tpu import make_reader
+
+    def one_run():
+        """(samples/sec, cpu_share): cpu_share = this process's CPU seconds /
+        wall seconds. On the 1-core bench host an uncontended run sits near
+        1.0; a neighbour stealing the core shows directly as a lower share.
+        seed=0 pins the shuffle order so every run decodes the IDENTICAL row
+        sequence — row-group order must not be a variance source."""
+        wall0, cpu0 = time.perf_counter(), time.process_time()
+        r = reader_throughput(url, warmup_cycles=200, measure_cycles=8000,
+                              pool_type='thread', workers_count=3,
+                              shuffle_row_groups=True,
+                              read_method='python',
+                              make_reader_fn=functools.partial(make_reader, seed=0)
+                              ).samples_per_second
+        wall = time.perf_counter() - wall0
+        return r, (time.process_time() - cpu0) / wall if wall else 0.0
+
+    # One full-length measured run is DISCARDED (allocator/CPU-state warmup on
+    # the 1-core container — the r3 capture trended up monotonically without
+    # it), then 7 runs are counted with contention-aware filtering.
+    discarded, _ = one_run()
+    runs = [one_run() for _ in range(7)]
+    value, spread, excluded = _select_runs(runs)
+
+    duty = _duty_section()
+
     print(json.dumps({
         'metric': 'hello_world_reader_throughput',
         'value': round(value, 2),
         'unit': 'samples/sec',
         'vs_baseline': round(value / BASELINE_SAMPLES_PER_SEC, 3),
-        'runs': [round(r, 2) for r in runs],
+        'runs': [round(r, 2) for r, _ in runs],
+        'cpu_shares': [round(s, 3) for _, s in runs],
+        'excluded_contended': excluded,
         'spread': round(spread, 4),
         'discarded_warm_run': round(discarded, 2),
+        'duty': duty,
     }))
 
 

@@ -543,10 +543,17 @@ def _bilinear_resize_numpy(img, out_h, out_w):
 
 
 def _mild_ratio(in_h, in_w, out_h, out_w):
-    """True when both axis ratios are under 2x decimation — the regime where a
-    box (area) filter spans <= 2 source pixels per axis and degenerates to the
-    same support as bilinear. The scaled-JPEG decode path lands here by
-    construction (the covering m/8 scale is < 2x the target)."""
+    """True when bilinear is the right filter: any upscaled axis, or both-axis
+    decimation under 2x — the regime where a box (area) filter spans <= 2
+    source pixels per axis and degenerates to the same support as bilinear.
+    Mixed down+up shapes go bilinear on EVERY backend: area's anti-aliasing
+    premise needs decimation on both axes, and on such shapes the native area
+    resampler legitimately diverges from cv2 INTER_AREA (~100 LSB on the
+    upscaled axis) — the same store must decode identically with or without
+    OpenCV installed. The scaled-JPEG decode path lands here by construction
+    (the covering m/8 scale is < 2x the target)."""
+    if out_h > in_h or out_w > in_w:
+        return True
     return in_h < 2 * out_h and in_w < 2 * out_w
 
 

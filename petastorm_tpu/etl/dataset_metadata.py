@@ -491,8 +491,11 @@ def get_schema(dataset_url, retry_policy=None):
     return schema
 
 
-def get_schema_from_dataset_url(dataset_url):
-    return get_schema(dataset_url)
+def get_schema_from_dataset_url(dataset_url, storage_retry_policy=None):
+    """Reference-parity alias for :func:`get_schema`; ``storage_retry_policy``
+    is threaded through exactly as ``make_reader(storage_retry_policy=)`` does,
+    so a user-tuned (or disabled) policy is honored on this path too."""
+    return get_schema(dataset_url, retry_policy=storage_retry_policy)
 
 
 def infer_or_load_unischema(dataset_url, retry_policy=None):

@@ -699,8 +699,10 @@ void resize_bilinear(const uint8_t* src, int sw, int sh, int c, uint8_t* dst, in
   }
 }
 
-// mirror of the python-side policy (codecs._mild_ratio): keep in sync
+// mirror of the python-side policy (codecs._mild_ratio): keep in sync.
+// Mixed down+up shapes use bilinear (area needs decimation on both axes).
 bool mild_ratio(int in_h, int in_w, int out_h, int out_w) {
+  if (out_h > in_h || out_w > in_w) return true;
   return in_h < 2 * out_h && in_w < 2 * out_w;
 }
 

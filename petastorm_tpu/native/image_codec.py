@@ -121,8 +121,12 @@ def _thread_grant(requested):
     a column fans out across all idle cores, while a full worker pool's
     concurrent calls naturally degrade to ~1 thread each instead of
     oversubscribing cores by pool_width x budget (the failure mode the old
-    'leave PSTPU_IMG_THREADS=1 inside pools' guidance worked around). An
-    explicit integer bypasses the accounting (the caller's exact contract)."""
+    'leave PSTPU_IMG_THREADS=1 inside pools' guidance worked around). The
+    floor means N concurrent callers can transiently hold budget + (N - 1)
+    threads (first caller takes the free budget, later ones still get 1) —
+    bounded by the pool width and accepted so callers never block on the
+    grant. An explicit integer bypasses the accounting (the caller's exact
+    contract)."""
     if requested is not None:
         yield max(1, int(requested))
         return

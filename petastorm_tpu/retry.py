@@ -103,6 +103,16 @@ class RetryPolicy(object):
         self.jitter = jitter
         self.classify = classify
 
+    def _key(self):
+        return (self.max_attempts, self.initial_backoff_s, self.multiplier,
+                self.max_backoff_s, self.jitter, self.classify)
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self._key() == other._key()
+
+    def __hash__(self):
+        return hash(self._key())
+
     def backoff_s(self, attempt):
         """Sleep before retry number ``attempt`` (1-based)."""
         base = min(self.initial_backoff_s * self.multiplier ** (attempt - 1),
@@ -209,6 +219,13 @@ class RetryingHandler(DelegatingHandler):
     def __init__(self, fs, policy=None):
         super(RetryingHandler, self).__init__(fs)
         self.policy = policy or RetryPolicy()
+
+    def __eq__(self, other):
+        # pyarrow dataset machinery dedupes on filesystem equality: the same
+        # store under DIFFERENT retry policies must not compare equal
+        if type(other) is type(self):
+            return self.fs == other.fs and self.policy == other.policy
+        return NotImplemented
 
     def _invoke(self, fn, *args, **kwargs):
         return self.policy.call(fn, *args, **kwargs)

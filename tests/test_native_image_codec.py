@@ -582,6 +582,17 @@ def test_resize_policy_dispatch():
     np.testing.assert_array_equal(got2, ref2)
     assert _mild_ratio(48, 48, 32, 32) and not _mild_ratio(96, 96, 32, 32)
     assert not _mild_ratio(64, 40, 32, 32)  # boundary: exactly 2x is NOT mild
+    # mixed down+up (h 3x down, w upscaled): bilinear on EVERY backend — the
+    # same store must decode identically with or without OpenCV installed
+    assert _mild_ratio(96, 24, 32, 32)
+    img3 = rng.integers(0, 255, (96, 24, 3), dtype=np.uint8)
+    got3 = _resize_image(img3, 32, 32)
+    ref3 = cv2.resize(img3, (32, 32), interpolation=cv2.INTER_LINEAR)
+    np.testing.assert_array_equal(got3, ref3)
+    native3 = image_codec.resize_bilinear_image(img3, (32, 32))
+    assert np.abs(native3.astype(int) - ref3.astype(int)).max() <= 1
+    out3 = image_codec.decode_images_resized([_png(img3)], (32, 32))
+    assert np.abs(out3[0].astype(int) - ref3.astype(int)).max() <= 1
     # fused native path agrees within rounding on the mild branch
     out = image_codec.decode_images_resized([_png(img)], (32, 32))
     assert np.abs(out[0].astype(int) - ref.astype(int)).max() <= 1

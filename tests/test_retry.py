@@ -295,6 +295,34 @@ def test_retry_policy_survives_factory_pickle():
     assert r2._retry_policy.max_attempts == 7
 
 
+def test_retrying_fs_equality_respects_policy():
+    """PyFileSystems wrapping the same store under DIFFERENT policies must not
+    compare equal — pyarrow dataset machinery dedupes on filesystem equality,
+    so policy-blind equality could silently swap a tuned policy for another."""
+    local = pafs.LocalFileSystem()
+    fast = wrap_retrying(local, RetryPolicy(max_attempts=2, initial_backoff_s=0.01))
+    slow = wrap_retrying(local, RetryPolicy(max_attempts=9, initial_backoff_s=0.01))
+    same = wrap_retrying(local, RetryPolicy(max_attempts=2, initial_backoff_s=0.01))
+    assert fast.equals(same)
+    assert not fast.equals(slow)
+
+
+def test_get_schema_from_dataset_url_honors_policy(tmp_path):
+    """The reference-parity alias must thread storage_retry_policy through
+    (ADVICE r4: it silently used default wrapping)."""
+    from petastorm_tpu.codecs import ScalarCodec
+    from petastorm_tpu.etl.dataset_metadata import (get_schema_from_dataset_url,
+                                                    write_petastorm_dataset)
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+
+    schema = Unischema('S', [UnischemaField('id', np.int64, (), ScalarCodec(), False)])
+    url = 'file://' + str(tmp_path / 'ds')
+    write_petastorm_dataset(url, schema, ({'id': i} for i in range(4)),
+                            rows_per_row_group=2)
+    loaded = get_schema_from_dataset_url(url, storage_retry_policy=False)
+    assert [f for f in loaded.fields] == ['id']
+
+
 def test_retry_policy_false_reaches_discovery_path(tmp_path, monkeypatch):
     """storage_retry_policy=False must disable retries EVERYWHERE, including
     schema/row-group discovery — a transient failure during get_schema then
