@@ -186,11 +186,15 @@ def _stream_duty_sweep(deadline_s, cmd=None):
                 break
             drain(data)
         sel.close()
-        if timed_out:
-            try:
-                os.killpg(proc.pid, signal.SIGKILL)
-            except (OSError, ProcessLookupError):
-                pass
+        # Kill the child's whole session unconditionally before the salvage
+        # read: a grandchild (reader worker, runtime helper) that inherited
+        # stdout would otherwise hold the pipe open and block os.read forever
+        # after the child itself died without EOF. On a clean EOF exit the
+        # group is already gone and the kill is a no-op.
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (OSError, ProcessLookupError):
+            pass
         proc.wait()
         while True:  # salvage points already in the pipe at kill/EOF time
             data = os.read(fd, 1 << 16)

@@ -1,10 +1,17 @@
 #!/usr/bin/env python
 """Worker-pool scaling curve: samples/sec at workers in {1,2,4,8} for thread
-and process pools, on a PNG-decode workload (the reader's dominant real cost).
+and process pools, on a PNG-decode workload (the reader's dominant real cost)
+or the decode-free raw-tensor store (``--store raw`` — the pure-transport
+stress case).
 
 One JSON line per point:
   {"metric": "scaling", "pool": "thread", "workers": 4, "samples_per_sec": ...,
    "host_cores": N}
+
+Each point is the MEDIAN of ``--reps`` runs of ``--measure-rows`` rows —
+sub-second single runs on a contended 1-core host spread +-20% and made the
+round-4 table misleading (process/thread looked like 0.64 when the stable
+ratio is ~0.78).
 
 The docs/benchmarks.md "cores_needed" budget formula is backed by this curve —
 run it on the host whose budget you are sizing (scaling is flat on a 1-core
@@ -16,6 +23,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import statistics
 import sys
 import tempfile
 import time
@@ -25,9 +33,13 @@ if REPO_ROOT not in sys.path:
     sys.path.insert(0, REPO_ROOT)
 
 
-def build_store(url, rows):
-    from bench_duty import build_png_store
-    build_png_store(url, rows)
+def build_store(url, rows, store='png', image_size=160, num_classes=1000):
+    if store == 'png':
+        from bench_duty import build_png_store
+        build_png_store(url, rows)
+    else:
+        from bench_duty import build_raw_store
+        build_raw_store(url, rows, image_size, num_classes)
 
 
 def measure(url, pool, workers, measure_rows=2000, warmup_rows=200):
@@ -51,24 +63,30 @@ def main(argv=None):
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument('--workers', default='1,2,4,8')
     parser.add_argument('--pools', default='thread,process')
+    parser.add_argument('--store', default='png', choices=('png', 'raw'))
     parser.add_argument('--rows', type=int, default=512)
-    parser.add_argument('--measure-rows', type=int, default=2000)
+    parser.add_argument('--measure-rows', type=int, default=9000)
+    parser.add_argument('--reps', type=int, default=3,
+                        help='runs per point; the median is reported')
     parser.add_argument('--keep-dir', default=None)
     args = parser.parse_args(argv)
 
     tmpdir = args.keep_dir or tempfile.mkdtemp(prefix='bench_scaling_')
-    # stamp the kept store with its row count so a changed --rows rebuilds
+    # stamp the kept store with its flavor+row count so changed args rebuild
     # instead of silently measuring a stale store
-    store_dir = os.path.join(tmpdir, 'store_{}rows'.format(args.rows))
+    store_dir = os.path.join(tmpdir, 'store_{}_{}rows'.format(args.store, args.rows))
     url = 'file://' + store_dir
     if not os.path.exists(os.path.join(store_dir, '_common_metadata')):
-        build_store(url, args.rows)
+        build_store(url, args.rows, store=args.store)
 
     for pool in args.pools.split(','):
         for w in (int(x) for x in args.workers.split(',')):
-            rate = measure(url, pool.strip(), w, measure_rows=args.measure_rows)
+            runs = [measure(url, pool.strip(), w, measure_rows=args.measure_rows)
+                    for _ in range(args.reps)]
             print(json.dumps({'metric': 'scaling', 'pool': pool.strip(), 'workers': w,
-                              'samples_per_sec': round(rate, 1),
+                              'store': args.store,
+                              'samples_per_sec': round(statistics.median(runs), 1),
+                              'runs': [round(r, 1) for r in runs],
                               'host_cores': os.cpu_count()}), flush=True)
 
 

@@ -1,0 +1,30 @@
+"""Read a plain Parquet store through the torch DataLoader adapter, using
+``make_batch_reader`` instead of ``make_reader``.
+
+Parity: reference examples/hello_world/external_dataset/pytorch_hello_world.py.
+Because the reader is batched, each DataLoader sample is a batch of rows.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from petastorm_tpu import make_batch_reader
+from petastorm_tpu.torch_utils import DataLoader
+
+
+def pytorch_hello_world(dataset_url='file:///tmp/external_dataset'):
+    with DataLoader(make_batch_reader(dataset_url)) as train_loader:
+        sample = next(iter(train_loader))
+        print('id batch: {}'.format(sample['id']))
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('--dataset-url', default='file:///tmp/external_dataset')
+    args = parser.parse_args()
+    pytorch_hello_world(args.dataset_url)
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,33 @@
+"""Read a plain Parquet store through the TensorFlow ``tf.data`` adapter,
+using ``make_batch_reader`` instead of ``make_reader``.
+
+Parity: reference examples/hello_world/external_dataset/tensorflow_hello_world.py,
+re-done for TF2 eager (the reference's ``tf_tensors`` TF1 session pump and
+one-shot iterator both collapse to plain eager iteration; see
+docs/migration.md). Each element is a batch of rows spanning one row group.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from petastorm_tpu import make_batch_reader
+from petastorm_tpu.tf_utils import make_petastorm_dataset
+
+
+def tensorflow_hello_world(dataset_url='file:///tmp/external_dataset'):
+    with make_batch_reader(dataset_url) as reader:
+        dataset = make_petastorm_dataset(reader)
+        batched_sample = next(iter(dataset))
+        print('id batch: {}'.format(batched_sample.id))
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('--dataset-url', default='file:///tmp/external_dataset')
+    args = parser.parse_args()
+    tensorflow_hello_world(args.dataset_url)
+
+
+if __name__ == '__main__':
+    main()

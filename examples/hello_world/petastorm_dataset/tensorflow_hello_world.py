@@ -1,0 +1,33 @@
+"""Read a HelloWorld dataset through the TensorFlow ``tf.data`` adapter.
+
+Parity: reference examples/hello_world/petastorm_dataset/tensorflow_hello_world.py,
+re-done for TF2 eager. The reference shows two TF1 idioms — a ``tf_tensors``
+session pump and a ``make_one_shot_iterator`` over ``make_petastorm_dataset``;
+both collapse to plain eager iteration here (docs/migration.md maps the
+TF1 recipe, and the queue-size diagnostic op to ``reader.diagnostics``).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from petastorm_tpu import make_reader
+from petastorm_tpu.tf_utils import make_petastorm_dataset
+
+
+def tensorflow_hello_world(dataset_url='file:///tmp/hello_world_dataset'):
+    with make_reader(dataset_url) as reader:
+        dataset = make_petastorm_dataset(reader)
+        sample = next(iter(dataset))
+        print(sample.id)
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('--dataset-url', default='file:///tmp/hello_world_dataset')
+    args = parser.parse_args()
+    tensorflow_hello_world(args.dataset_url)
+
+
+if __name__ == '__main__':
+    main()

@@ -204,6 +204,34 @@ int pstpu_ring_write2(void* h, const void* a, uint64_t a_len, const void* b, uin
   return 1;
 }
 
+// Gather write of N segments as ONE message — the generalization of write2
+// the serializer's parts channel uses: a whole column block (header + every
+// column/cell buffer) lands in the ring with exactly one copy per byte and no
+// caller-side join. Same return convention as pstpu_ring_write.
+int pstpu_ring_writev(void* h, const void* const* bufs, const uint64_t* lens, int32_t n) {
+  auto* r = static_cast<RingHandle*>(h);
+  uint64_t len = 0;
+  for (int32_t i = 0; i < n; i++) len += lens[i];
+  const uint64_t need = len + 8;
+  if (need > r->hdr->capacity) {
+    set_error("message larger than ring capacity");
+    return -1;
+  }
+  const uint64_t head = r->hdr->head.load(std::memory_order_acquire);
+  const uint64_t tail = r->hdr->tail.load(std::memory_order_relaxed);
+  if (r->hdr->capacity - (tail - head) < need) return 0;
+  uint64_t len_le = len;
+  copy_in(r, tail, reinterpret_cast<const uint8_t*>(&len_le), 8);
+  uint64_t off = tail + 8;
+  for (int32_t i = 0; i < n; i++) {
+    if (lens[i] == 0) continue;
+    copy_in(r, off, static_cast<const uint8_t*>(bufs[i]), lens[i]);
+    off += lens[i];
+  }
+  r->hdr->tail.store(tail + need, std::memory_order_release);
+  return 1;
+}
+
 // Length of the next unread message, or -1 when the ring is empty.
 int64_t pstpu_ring_next_len(void* h) {
   auto* r = static_cast<RingHandle*>(h);

@@ -50,6 +50,11 @@ def _load_library():
         lib.pstpu_ring_write2.restype = ctypes.c_int
         lib.pstpu_ring_write2.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64,
                                           ctypes.c_char_p, ctypes.c_uint64]
+        lib.pstpu_ring_writev.restype = ctypes.c_int
+        lib.pstpu_ring_writev.argtypes = [ctypes.c_void_p,
+                                          ctypes.POINTER(ctypes.c_void_p),
+                                          ctypes.POINTER(ctypes.c_uint64),
+                                          ctypes.c_int32]
         lib.pstpu_ring_next_len.restype = ctypes.c_int64
         lib.pstpu_ring_next_len.argtypes = [ctypes.c_void_p]
         lib.pstpu_ring_read.restype = ctypes.c_int64
@@ -130,6 +135,53 @@ class ShmRing(object):
                 return False
             time.sleep(poll_s)
         return True
+
+    @staticmethod
+    def _gather(parts):
+        """(ptr_array, len_array, total, keepalive) for a list of bytes-likes /
+        contiguous numpy arrays. Pointers are raw addresses — the keepalive
+        list MUST outlive the write call (it does: writev holds it)."""
+        import numpy as np
+        n = len(parts)
+        ptrs = (ctypes.c_void_p * n)()
+        lens = (ctypes.c_uint64 * n)()
+        keepalive = []
+        total = 0
+        for i, p in enumerate(parts):
+            if not isinstance(p, np.ndarray):
+                # read-only buffers (bytes) export fine through frombuffer
+                p = np.frombuffer(p, np.uint8) if len(p) else np.empty(0, np.uint8)
+            keepalive.append(p)
+            ptrs[i] = p.ctypes.data if p.size else None
+            lens[i] = p.nbytes
+            total += p.nbytes
+        return ptrs, lens, total, keepalive
+
+    def try_writev(self, parts):
+        """Gather write of N bytes-like/ndarray segments as one message — the
+        zero-join publish channel for whole column blocks."""
+        ptrs, lens, total, keepalive = self._gather(parts)
+        rc = self._lib.pstpu_ring_writev(self._handle, ptrs, lens, len(parts))
+        del keepalive
+        if rc < 0:
+            raise ValueError('message of {} bytes exceeds ring capacity {} — increase the '
+                             'process pool ring_bytes (or shrink row groups)'.format(
+                                 total, self.capacity))
+        return rc == 1
+
+    def writev(self, parts, stop_check=None, poll_s=0.0002):
+        ptrs, lens, total, keepalive = self._gather(parts)
+        if total + 8 > self.capacity:
+            raise ValueError('message of {} bytes exceeds ring capacity {} — increase the '
+                             'process pool ring_bytes (or shrink row groups)'.format(
+                                 total, self.capacity))
+        while True:
+            rc = self._lib.pstpu_ring_writev(self._handle, ptrs, lens, len(parts))
+            if rc == 1:
+                return True
+            if stop_check is not None and stop_check():
+                return False
+            time.sleep(poll_s)
 
     def try_read(self):
         """One message as bytes, or None when the ring is empty."""

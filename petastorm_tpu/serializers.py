@@ -39,51 +39,136 @@ class NumpyBlockSerializer(object):
     received message (~zero cost), which is safe for both transports: the shm
     ring copies each message into a fresh per-message buffer
     (native/shm_ring.py:try_read_view) and zmq hands out an owning bytes — the
-    views keep either alive. Object-dtype columns and non-block payloads
-    (NGram window lists, exceptions, sentinels) ride an embedded pickle.
+    views keep either alive. RAGGED object columns whose cells are
+    uniform-dtype ndarrays (variable-size decoded images, the PNG/JPEG
+    columnar block shape) ride the same raw-buffer channel — one buffer per
+    cell, shapes in the header — instead of a full pickle copy of the pixels;
+    other object columns and non-block payloads (NGram window lists,
+    exceptions, sentinels) ride an embedded pickle.
     """
 
     _BLOCK = b'N'
     _PICKLE = b'P'
 
     @staticmethod
-    def _split_block(obj):
+    def _ragged_buffers(v):
+        """``(cell_arrays, dtype_str, shapes)`` when every non-None cell of the
+        1-D object column ``v`` is an ndarray of ONE simple dtype (None cells
+        allowed: nullable fields); else None. ``shapes`` has a None per None
+        cell; ``cell_arrays`` holds only the present cells, contiguous."""
+        if v.ndim != 1 or v.size == 0:
+            return None
+        dtype = None
+        cells, shapes = [], []
+        for el in v:
+            if el is None:
+                shapes.append(None)
+                continue
+            if not isinstance(el, np.ndarray) or el.dtype.hasobject or \
+                    el.dtype.names is not None:
+                return None
+            if dtype is None:
+                dtype = el.dtype
+            elif el.dtype != dtype:
+                return None
+            el = np.ascontiguousarray(el)
+            cells.append(el)
+            shapes.append(el.shape)
+        if dtype is None:  # all-None column: nothing raw to frame
+            return None
+        return cells, dtype.str, shapes
+
+    @classmethod
+    def _split_block(cls, obj):
         """THE block-eligibility classification + header framing, shared by
-        :meth:`serialize` and :meth:`serialize_into` (the two channels must
-        stay byte-identical for :meth:`deserialize`): returns
-        ``(raw_arrays, header_bytes)`` or ``None`` when the payload must ride
-        plain pickle."""
+        every channel (join, parts, blob — all must stay byte-identical for
+        :meth:`deserialize`): returns ``(buffers, header_bytes)`` — buffers is
+        the ordered flat list of contiguous arrays whose raw bytes follow the
+        header — or ``None`` when the payload must ride plain pickle. Header
+        meta entries are ``(name, dtype_str, shape, ragged_shapes)`` with
+        exactly one of shape/ragged_shapes set."""
         if not isinstance(obj, dict) or not obj:
             return None
-        raw = {}
+        meta = []
+        buffers = []
         others = {}
         for k, v in obj.items():
-            if (isinstance(v, np.ndarray) and v.dtype != object and not v.dtype.hasobject
-                    and v.dtype.names is None):  # structured dtypes lose field
-                raw[k] = np.ascontiguousarray(v)  # names through dtype.str: pickle them
-            else:
+            if not isinstance(v, np.ndarray):
                 others[k] = v
+            elif v.dtype != object and not v.dtype.hasobject and v.dtype.names is None:
+                v = np.ascontiguousarray(v)  # structured dtypes lose field
+                meta.append((k, v.dtype.str, v.shape, None))  # names via str: pickled
+                buffers.append(v)
+            else:
+                ragged = cls._ragged_buffers(v) if v.dtype == object else None
+                if ragged is None:
+                    others[k] = v
+                else:
+                    cells, dtype_str, shapes = ragged
+                    meta.append((k, dtype_str, None, shapes))
+                    buffers.extend(cells)
         try:
-            header = pickle.dumps(
-                ([(k, v.dtype.str, v.shape) for k, v in raw.items()], others),
-                protocol=pickle.HIGHEST_PROTOCOL)
+            header = pickle.dumps((meta, others), protocol=pickle.HIGHEST_PROTOCOL)
         except Exception:  # noqa: BLE001 - unpicklable extras: plain pickle
             return None
-        return raw, header
+        return buffers, header
 
     @staticmethod
     def _array_bytes(v):
-        # datetime/timedelta arrays refuse buffer export (PEP 3118); tobytes
-        return v.tobytes() if v.dtype.kind in 'Mm' else memoryview(v).cast('B')
+        # datetime/timedelta arrays refuse buffer export (PEP 3118), and
+        # memoryview.cast('B') rejects views with zeros in shape/strides
+        # (empty blocks — e.g. a predicate filtering a row group to nothing);
+        # tobytes() for both, b'' is free anyway
+        if v.dtype.kind in 'Mm' or v.size == 0:
+            return v.tobytes()
+        return memoryview(v).cast('B')
 
     def serialize(self, obj):
+        parts = self.serialize_parts(obj)
+        if parts is None:
+            return self._PICKLE + pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+        return self.join_parts(parts)
+
+    def serialize_parts(self, obj):
+        """The zero-join channel: the framed message as a LIST of segments
+        (one leading bytes prefix, then the raw column/cell arrays) for a
+        gather-writing transport (``ShmRing.writev``) — the concatenation of
+        the segments is byte-identical to :meth:`serialize` output. Returns
+        None when the payload must ride plain pickle (callers then use
+        :meth:`serialize`)."""
         split = self._split_block(obj)
         if split is None:
-            return self._PICKLE + pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
-        raw, header = split
-        parts = [self._BLOCK, struct.pack('<I', len(header)), header]
-        parts.extend(self._array_bytes(v) for v in raw.values())
-        return b''.join(parts)
+            return None
+        buffers, header = split
+        return [b''.join((self._BLOCK, struct.pack('<I', len(header)), header))] + buffers
+
+    @classmethod
+    def parts_size(cls, parts):
+        return sum(p.nbytes if isinstance(p, np.ndarray) else len(p) for p in parts)
+
+    @classmethod
+    def join_parts(cls, parts):
+        """In-band fallback for an already-split payload (byte-identical to
+        :meth:`serialize` output) — the split never runs twice."""
+        return b''.join(cls._array_bytes(p) if isinstance(p, np.ndarray) else p
+                        for p in parts)
+
+    @classmethod
+    def write_parts_into(cls, parts, target):
+        """Write a :meth:`serialize_parts` result into ``target`` (e.g. an
+        mmapped /dev/shm blob) — the single-copy channel for payloads already
+        split once; bytes are identical to :meth:`serialize` output."""
+        buf = memoryview(target)
+        off = 0
+        for p in parts:
+            if isinstance(p, np.ndarray):
+                n = p.nbytes
+                buf[off:off + n] = cls._array_bytes(p)
+            else:
+                n = len(p)
+                buf[off:off + n] = p
+            off += n
+        return buf
 
     def deserialize(self, data):
         mv = memoryview(data)
@@ -93,43 +178,26 @@ class NumpyBlockSerializer(object):
         (hlen,) = struct.unpack('<I', mv[1:5])
         meta, out = pickle.loads(mv[5:5 + hlen])
         off = 5 + hlen
-        for name, dtype_str, shape in meta:
+        for name, dtype_str, shape, ragged in meta:
             dt = np.dtype(dtype_str)
-            n = dt.itemsize
-            for dim in shape:
-                n *= dim
-            out[name] = np.frombuffer(mv[off:off + n], dtype=dt).reshape(shape)
-            off += n
+            if ragged is None:
+                n = dt.itemsize
+                for dim in shape:
+                    n *= dim
+                out[name] = np.frombuffer(mv[off:off + n], dtype=dt).reshape(shape)
+                off += n
+            else:
+                col = np.empty(len(ragged), dtype=object)
+                for i, shp in enumerate(ragged):
+                    if shp is None:
+                        continue
+                    n = dt.itemsize
+                    for dim in shp:
+                        n *= dim
+                    col[i] = np.frombuffer(mv[off:off + n], dtype=dt).reshape(shp)
+                    off += n
+                out[name] = col
         return out
-
-    def serialize_routed(self, obj, alloc, min_size=0):
-        """One-pass channel routing for the process-pool publish path: the
-        block classification/framing runs ONCE, then large raw blocks are
-        written via ``alloc`` (single copy) and everything else is framed
-        in-band. Returns ``('blob', buffer)`` or ``('bytes', message)``."""
-        split = self._split_block(obj)
-        if split is None:
-            return 'bytes', self._PICKLE + pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
-        raw, header = split
-        total = 5 + len(header) + sum(v.nbytes for v in raw.values())
-        if raw and total >= min_size:
-            return 'blob', self._write_frame_into(raw, header, alloc(total))
-        parts = [self._BLOCK, struct.pack('<I', len(header)), header]
-        parts.extend(self._array_bytes(v) for v in raw.values())
-        return 'bytes', b''.join(parts)
-
-    @classmethod
-    def _write_frame_into(cls, raw, header, target):
-        buf = memoryview(target)
-        buf[0:1] = cls._BLOCK
-        struct.pack_into('<I', buf, 1, len(header))
-        buf[5:5 + len(header)] = header
-        off = 5 + len(header)
-        for v in raw.values():
-            n = v.nbytes
-            buf[off:off + n] = cls._array_bytes(v)
-            off += n
-        return buf
 
     def serialize_into(self, obj, alloc, min_size=0):
         """Single-copy serialize: compute the exact framed-message size, obtain
@@ -139,16 +207,13 @@ class NumpyBlockSerializer(object):
         qualify (non-block payload, object columns only, or total < ``min_size``
         — callers then use the regular :meth:`serialize` channel). The written
         bytes :meth:`deserialize` identically to :meth:`serialize` output."""
-        split = self._split_block(obj)
-        if split is None:
+        parts = self.serialize_parts(obj)
+        if parts is None or len(parts) == 1:  # non-block, or no raw buffers
             return None
-        raw, header = split
-        if not raw:
-            return None
-        total = 5 + len(header) + sum(v.nbytes for v in raw.values())
+        total = self.parts_size(parts)
         if total < min_size:
             return None
-        return self._write_frame_into(raw, header, alloc(total))
+        return self.write_parts_into(parts, alloc(total))
 
 
 class ArrowTableSerializer(object):

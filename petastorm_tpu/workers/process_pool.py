@@ -250,7 +250,7 @@ class ProcessPool(object):
         # the serializer can route payloads in one pass and tmpfs has at least
         # token headroom (workers additionally self-disable after persistent
         # ENOSPC — the capacity can change under us at runtime)
-        if (self._blob_threshold and hasattr(self._serializer, 'serialize_routed')
+        if (self._blob_threshold and hasattr(self._serializer, 'serialize_parts')
                 and os.path.isdir('/dev/shm')):
             _sweep_stale_blob_dirs('/dev/shm')
             try:
@@ -503,76 +503,82 @@ def _worker_bootstrap(worker_id, main_pid, setup_blob, vent_addr, result_addr, c
                 return
             time.sleep(0.002)
 
-    class _BlobAllocFailed(Exception):
-        pass
-
     # persistent tmpfs exhaustion must not degrade into a warn+retry treadmill
     # on every message: give up on the sidechannel after a few consecutive
     # allocation failures (the in-band path keeps working regardless)
     blob_fail = {'consecutive': 0, 'disabled': False}
     _BLOB_DISABLE_AFTER = 3
 
-    def publish(data):
-        use_blob = (blob_dir is not None and not blob_fail['disabled']
-                    and hasattr(serializer, 'serialize_routed'))
-        if use_blob:
-            import mmap
-            state = {}
+    def _note_blob_failure(e):
+        blob_fail['consecutive'] += 1
+        if blob_fail['consecutive'] >= _BLOB_DISABLE_AFTER:
+            blob_fail['disabled'] = True
+            logger.warning('blob allocation failed %d times (%s); disabling the '
+                           '/dev/shm sidechannel for this worker',
+                           blob_fail['consecutive'], e)
+        else:
+            logger.warning('blob allocation failed (%s); payload falling back '
+                           'in-band', e)
 
-            def alloc(size):
-                # file creation is deferred to HERE: payloads routed in-band
-                # (sub-threshold/non-block) never touch the filesystem
-                _blob_backpressure(size)
-                try:
-                    fd, path = tempfile.mkstemp(prefix='b', dir=blob_dir)
-                except OSError as e:  # unwritable/vanished dir: degrade, not die
-                    raise _BlobAllocFailed(str(e))
-                state['fd'], state['path'] = fd, path
-                try:
-                    # posix_fallocate: tmpfs exhaustion surfaces as a catchable
-                    # ENOSPC here, NOT as a SIGBUS when the mmap write faults a
-                    # page that cannot be backed (same stance as the ring's
-                    # pre-faulting create)
-                    os.posix_fallocate(fd, 0, size)
-                except OSError as e:
-                    raise _BlobAllocFailed(str(e))
-                try:
-                    state['mm'] = mmap.mmap(fd, size)
-                except OSError as e:  # e.g. ENOMEM under address-space pressure
-                    raise _BlobAllocFailed(str(e))
-                return state['mm']
-
+    def _try_blob_write(parts, total):
+        """Write an already-split payload into a fresh /dev/shm blob and send
+        its name. False = allocation failed (noted; caller falls back in-band).
+        posix_fallocate first: tmpfs exhaustion surfaces as a catchable ENOSPC
+        here, NOT as a SIGBUS when an mmap write faults an unbackable page
+        (same stance as the ring's pre-faulting create)."""
+        import mmap
+        _blob_backpressure(total)
+        try:
+            fd, path = tempfile.mkstemp(prefix='b', dir=blob_dir)
+        except OSError as e:  # unwritable/vanished dir: degrade, not die
+            _note_blob_failure(e)
+            return False
+        try:
             try:
-                kind, payload = serializer.serialize_routed(data, alloc,
-                                                            min_size=blob_threshold)
-            except _BlobAllocFailed as e:
-                if 'fd' in state:
-                    os.close(state['fd'])
-                    os.unlink(state['path'])
-                blob_fail['consecutive'] += 1
-                if blob_fail['consecutive'] >= _BLOB_DISABLE_AFTER:
-                    blob_fail['disabled'] = True
-                    logger.warning('blob allocation failed %d times (%s); disabling the '
-                                   '/dev/shm sidechannel for this worker',
-                                   blob_fail['consecutive'], e)
-                else:
-                    logger.warning('blob allocation failed (%s); payload falling back '
-                                   'in-band', e)
-            except BaseException:
-                if 'fd' in state:
-                    os.close(state['fd'])
-                    os.unlink(state['path'])
-                raise
-            else:
-                blob_fail['consecutive'] = 0
-                if kind == 'bytes':
-                    send(_DATA, current['seq'], payload)
-                else:
-                    payload.release()  # the mmap refuses to close with views
-                    state['mm'].close()
-                    os.close(state['fd'])
-                    send(_BLOB, current['seq'], state['path'].encode())
+                os.posix_fallocate(fd, 0, total)
+                mm = mmap.mmap(fd, total)
+            except OSError as e:  # ENOSPC / ENOMEM under pressure
+                os.close(fd)
+                os.unlink(path)
+                _note_blob_failure(e)
+                return False
+            buf = serializer.write_parts_into(parts, mm)
+            buf.release()  # the mmap refuses to close with live views
+            mm.close()
+            os.close(fd)
+        except BaseException:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            raise
+        blob_fail['consecutive'] = 0
+        send(_BLOB, current['seq'], path.encode())
+        return True
+
+    def publish(data):
+        # The payload is classified/framed ONCE (serialize_parts); every
+        # channel consumes the same parts list. Routing: sub-blob-threshold
+        # blocks gather-write STRAIGHT into the shm ring — one copy per byte
+        # into warm pages, no b''.join staging, ragged image columns as raw
+        # cell buffers instead of a pickle of the pixels. Blocks at/above the
+        # threshold ride the /dev/shm blob sidechannel: its consumer views
+        # are COW-mmap lazy (no upfront read-out copy), which beats a ring
+        # copy-out for multi-MB payloads. Everything else goes in-band.
+        blob_live = (blob_dir is not None and not blob_fail['disabled'])
+        parts = (serializer.serialize_parts(data)
+                 if hasattr(serializer, 'serialize_parts') else None)
+        if parts is not None:
+            total = serializer.parts_size(parts)
+            fits_ring = ring is not None and total + 17 <= ring.capacity  # 9B+8B framing
+            if fits_ring and (total < blob_threshold or not blob_live):
+                ring.writev([_ring_header(_DATA, current['seq'])] + parts,
+                            stop_check=check_finished)
                 return
+            if blob_live and total >= blob_threshold and _try_blob_write(parts, total):
+                return
+            send(_DATA, current['seq'], serializer.join_parts(parts))
+            return
         send(_DATA, current['seq'], serializer.serialize(data))
 
     worker = worker_class(worker_id, publish, worker_setup_args)

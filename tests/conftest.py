@@ -24,6 +24,11 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        'markers', 'slow: spawn-heavy end-to-end matrix tests (process pool)')
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)

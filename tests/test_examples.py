@@ -44,14 +44,39 @@ def test_hello_world_pytorch_read(hello_world_url):
     pytorch_hello_world(hello_world_url)
 
 
-def test_external_dataset_roundtrip(tmp_path):
+def test_hello_world_tensorflow_read(hello_world_url):
+    pytest.importorskip('tensorflow')
+    from examples.hello_world.petastorm_dataset.tensorflow_hello_world import \
+        tensorflow_hello_world
+    tensorflow_hello_world(hello_world_url)
+
+
+@pytest.fixture(scope='module')
+def external_dataset_url(tmp_path_factory):
     from examples.hello_world.external_dataset.generate_external_dataset import \
         generate_external_dataset
-    url = 'file://' + str(tmp_path / 'ext')
+    url = 'file://' + str(tmp_path_factory.mktemp('ext_ds'))
     generate_external_dataset(url, rows_count=50)
-    with make_batch_reader(url) as reader:
+    return url
+
+
+def test_external_dataset_roundtrip(external_dataset_url):
+    with make_batch_reader(external_dataset_url) as reader:
         ids = np.concatenate([batch.id for batch in reader])
     assert sorted(ids.tolist()) == list(range(50))
+
+
+def test_external_dataset_tensorflow_read(external_dataset_url):
+    pytest.importorskip('tensorflow')
+    from examples.hello_world.external_dataset.tensorflow_hello_world import \
+        tensorflow_hello_world
+    tensorflow_hello_world(external_dataset_url)
+
+
+def test_external_dataset_pytorch_read(external_dataset_url):
+    from examples.hello_world.external_dataset.pytorch_hello_world import \
+        pytorch_hello_world
+    pytorch_hello_world(external_dataset_url)
 
 
 @pytest.fixture(scope='module')

@@ -223,6 +223,30 @@ class TestProcessPoolTransports:
         with pytest.raises(ValueError, match='transport'):
             ProcessPool(1, transport='carrier-pigeon')
 
+    def test_shm_writev_gather_segments(self):
+        """writev lands N segments as ONE message, byte-identical to their
+        concatenation — including wrap-around and numpy (read-only) inputs."""
+        import os
+
+        import numpy as np
+
+        from petastorm_tpu.native.shm_ring import ShmRing
+        name = '/pstpu_wv_{}'.format(os.getpid())
+        ring = ShmRing.create(name, 64 << 10)
+        w = ShmRing.attach(name)
+        arr = np.arange(777, dtype=np.uint8)
+        arr.setflags(write=False)  # Arrow-buffer views are read-only too
+        parts = [b'H' + b'\x01' * 8, arr, b'', np.full((3, 5), 7, np.int32)]
+        expect = b''.join(bytes(p) if not isinstance(p, np.ndarray) else p.tobytes()
+                          for p in parts)
+        for spin in range(40):  # enough messages to wrap the 64KB ring
+            assert w.writev(parts)
+            got = ring.try_read()
+            assert got == expect, 'mismatch at message {}'.format(spin)
+        with pytest.raises(ValueError, match='exceeds ring capacity'):
+            w.writev([np.zeros(128 << 10, np.uint8)])
+        w.close(); ring.close()
+
 
 class TestNumpyBlockSerializer:
     """Raw-buffer block serializer: the process-pool default (round 3)."""
@@ -252,6 +276,66 @@ class TestNumpyBlockSerializer:
         np.testing.assert_array_equal(out['a'], np.arange(3))
         assert out['ragged'][1].shape == (5,)
         assert out['s'].tolist() == ['x', 'yy']
+
+    def test_ragged_object_column_rides_raw_buffers(self):
+        """Uniform-dtype ndarray cells (variable-size decoded images) must ride
+        the raw-buffer channel — one buffer per cell, shapes in the header —
+        not a pickle copy of the pixels; None cells (nullable) pass through."""
+        import numpy as np
+        from petastorm_tpu.serializers import NumpyBlockSerializer
+        rng = np.random.default_rng(5)
+        ragged = np.empty(5, dtype=object)
+        for i in range(4):
+            ragged[i] = rng.integers(0, 255, (8 + i, 6, 3), dtype=np.uint8)
+        ragged[4] = None
+        strings = np.array(['a', 'bb'], dtype=object)  # non-ndarray cells: pickled
+        block = {'img': ragged, 'label': np.arange(5), 's': strings}
+        s = NumpyBlockSerializer()
+        data = s.serialize(block)
+        # the pixels appear as raw bytes exactly once (no embedded pickle copy)
+        assert data.count(ragged[0].tobytes()) == 1
+        out = s.deserialize(bytearray(data))
+        for i in range(4):
+            np.testing.assert_array_equal(out['img'][i], ragged[i])
+            assert out['img'][i].flags.writeable
+        assert out['img'][4] is None
+        assert out['s'].tolist() == ['a', 'bb']
+        # mixed-dtype cells cannot share a buffer framing: whole column pickles
+        mixed = np.empty(2, dtype=object)
+        mixed[0], mixed[1] = np.ones(2, np.float32), np.ones(2, np.int64)
+        out2 = s.deserialize(bytearray(s.serialize({'m': mixed, 'x': np.arange(2)})))
+        np.testing.assert_array_equal(out2['m'][1], np.ones(2, np.int64))
+
+    def test_serialize_parts_matches_serialize_framing(self):
+        """The gather-write channel's concatenated segments must be
+        byte-identical to serialize() output (one deserializer serves both)."""
+        import numpy as np
+        from petastorm_tpu.serializers import NumpyBlockSerializer
+        rng = np.random.default_rng(6)
+        ragged = np.empty(3, dtype=object)
+        for i in range(3):
+            ragged[i] = rng.integers(0, 255, (4 + i, 5), dtype=np.uint8)
+        block = {'img': ragged, 'label': np.arange(3),
+                 'ts': np.array(['2024-01-01'], dtype='datetime64[ns]')}
+        s = NumpyBlockSerializer()
+        parts = s.serialize_parts(block)
+        joined = b''.join(bytes(p) if not isinstance(p, np.ndarray) else p.tobytes()
+                          for p in parts)
+        assert joined == s.serialize(block)
+        assert s.serialize_parts([1, 2]) is None  # non-block: caller pickles
+
+    def test_empty_block_roundtrip(self):
+        """Zero-row blocks (a predicate filtering a row group to nothing) must
+        serialize: memoryview.cast('B') rejects zeros in shape/strides, so the
+        serializer routes empties through tobytes (r5 e2e-matrix regression)."""
+        import numpy as np
+        block = {'id': np.empty((0,), np.int64),
+                 'img': np.empty((0, 4, 4, 3), np.uint8),
+                 'f': np.arange(3, dtype=np.float32)}
+        out = self._rt(block)
+        assert out['id'].shape == (0,)
+        assert out['img'].shape == (0, 4, 4, 3) and out['img'].dtype == np.uint8
+        np.testing.assert_array_equal(out['f'], block['f'])
 
     def test_non_block_payloads_roundtrip(self):
         import numpy as np
@@ -462,29 +546,23 @@ class TestBlobSidechannel:
             np.testing.assert_array_equal(seen[i], arr)
         assert not os.path.exists(blob_dir)  # swept on join
 
-    def test_serialize_routed_picks_channel_once(self):
+    def test_parts_channel_blob_write_roundtrip(self):
+        """The split-once publish path: serialize_parts -> write_parts_into a
+        blob-style buffer -> deserialize, and join_parts for the in-band
+        fallback — one classification, every channel byte-identical."""
         import numpy as np
         from petastorm_tpu.serializers import NumpyBlockSerializer
         s = NumpyBlockSerializer()
         big = {'a': np.zeros((1 << 18,), np.uint8)}
-        allocs = []
-
-        def alloc(size):
-            buf = bytearray(size)
-            allocs.append(buf)
-            return memoryview(buf)
-
-        kind, payload = s.serialize_routed(big, alloc, min_size=1024)
-        assert kind == 'blob' and len(allocs) == 1
-        np.testing.assert_array_equal(s.deserialize(bytes(allocs[0]))['a'], big['a'])
-        # sub-threshold: framed in-band, alloc untouched, bytes identical to serialize
-        small = {'a': np.arange(4, dtype=np.int64)}
-        kind, payload = s.serialize_routed(small, alloc, min_size=1 << 20)
-        assert kind == 'bytes' and len(allocs) == 1
-        assert payload == s.serialize(small)
-        # non-block: pickle channel
-        kind, payload = s.serialize_routed(['x'], alloc, min_size=0)
-        assert kind == 'bytes' and s.deserialize(payload) == ['x']
+        parts = s.serialize_parts(big)
+        total = s.parts_size(parts)
+        buf = bytearray(total)
+        s.write_parts_into(parts, memoryview(buf))
+        np.testing.assert_array_equal(s.deserialize(bytes(buf))['a'], big['a'])
+        assert bytes(buf) == s.join_parts(parts) == s.serialize(big)
+        # non-block: no parts; the pickle channel serves it
+        assert s.serialize_parts(['x']) is None
+        assert s.deserialize(s.serialize(['x'])) == ['x']
 
     @pytest.mark.skipif(not os.path.isdir('/dev/shm'), reason='needs /dev/shm')
     @pytest.mark.parametrize('rows_per_group,label', [(30, 'blob'), (4, 'inband')])
