@@ -35,7 +35,7 @@ import numpy as np
 
 # bump when build_raw_store's on-disk layout changes (reused --keep-dir stores
 # are rebuilt instead of silently benchmarked under the new label)
-RAW_STORE_FORMAT = 'v2-raw-tensor-codec'
+RAW_STORE_FORMAT = 'v3-flba-pagescan'
 
 REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
 if REPO_ROOT not in sys.path:

@@ -72,9 +72,11 @@ def main(argv=None):
     args = parser.parse_args(argv)
 
     tmpdir = args.keep_dir or tempfile.mkdtemp(prefix='bench_scaling_')
-    # stamp the kept store with its flavor+row count so changed args rebuild
-    # instead of silently measuring a stale store
-    store_dir = os.path.join(tmpdir, 'store_{}_{}rows'.format(args.store, args.rows))
+    # stamp the kept store with its flavor+layout+row count so changed args or
+    # a writer-layout change rebuild instead of silently measuring stale bytes
+    from bench_duty import RAW_STORE_FORMAT
+    flavor = '{}-{}'.format(args.store, RAW_STORE_FORMAT) if args.store == 'raw' else args.store
+    store_dir = os.path.join(tmpdir, 'store_{}_{}rows'.format(flavor, args.rows))
     url = 'file://' + store_dir
     if not os.path.exists(os.path.join(store_dir, '_common_metadata')):
         build_store(url, args.rows, store=args.store)

@@ -393,18 +393,36 @@ class RawTensorCodec(DataFieldCodec):
 
     def decode_column(self, field, column):
         """Whole-column zero-copy decode: one reshape view over the Arrow
-        values buffer. ``None`` (-> per-cell path) for nulls, non-binary
-        storage, or cells whose length disagrees with the schema."""
+        values buffer — fixed-size-binary storage (current writer) and plain
+        binary (stores written before round 5) both serve it. ``None``
+        (-> per-cell path) for nulls, other storage, or cells whose length
+        disagrees with the schema."""
         if column.null_count:
             return None
+        dtype, shape, count = self._cell_spec(field)
+        cell_len = count * dtype.itemsize
+        if column.num_chunks > 1 and pa.types.is_fixed_size_binary(column.type):
+            # page-scanned columns arrive one chunk per page; a per-chunk view
+            # + one stack beats falling to the per-cell path
+            views = [self.decode_column(field, pa.chunked_array([c]))
+                     for c in column.chunks]
+            if any(v is None for v in views):
+                return None
+            return np.concatenate(views, axis=0)
         # combine_chunks copies even for a single chunk — take the chunk
         # directly in the (overwhelmingly common) one-chunk-per-row-group case
         col = column.chunk(0) if column.num_chunks == 1 else column.combine_chunks()
         n = len(col)
-        if not n or col.type not in (pa.binary(), pa.large_binary()):
+        if not n:
             return None
-        dtype, shape, count = self._cell_spec(field)
-        cell_len = count * dtype.itemsize
+        if pa.types.is_fixed_size_binary(col.type):
+            if col.type.byte_width != cell_len:
+                return None
+            payload = np.frombuffer(col.buffers()[1], dtype=np.uint8)[
+                col.offset * cell_len: (col.offset + n) * cell_len]
+            return payload.view(dtype).reshape((n,) + shape)
+        if col.type not in (pa.binary(), pa.large_binary()):
+            return None
         bufs = col.buffers()
         off_dtype = np.int64 if col.type == pa.large_binary() else np.int32
         offsets = np.frombuffer(bufs[1], dtype=off_dtype)[col.offset: col.offset + n + 1]
@@ -414,8 +432,18 @@ class RawTensorCodec(DataFieldCodec):
         payload = np.frombuffer(bufs[2], dtype=np.uint8)[int(offsets[0]):int(offsets[-1])]
         return payload.view(dtype).reshape((n,) + shape)
 
+    #: cells are raw pixels/weights — snappy buys ~nothing on typical tensor
+    #: payloads and costs read-side decompression; 'none' additionally makes
+    #: the column servable by the zero-copy page scanner (native/pagescan.py)
+    preferred_column_compression = 'none'
+
     def arrow_type(self, field):
-        return pa.binary()
+        # fixed-size binary: the parquet physical type becomes
+        # FIXED_LEN_BYTE_ARRAY whose PLAIN pages carry NO per-value length
+        # prefixes — the page's values region IS the Arrow data buffer, which
+        # is what makes the zero-copy page scan possible
+        dtype, _, count = self._cell_spec(field)
+        return pa.binary(count * dtype.itemsize)
 
 
 @register_codec

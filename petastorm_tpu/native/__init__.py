@@ -64,6 +64,11 @@ def _load_library():
         lib.pstpu_read_row_group.argtypes = [ctypes.c_void_p, ctypes.c_int,
                                              ctypes.POINTER(ctypes.c_int),
                                              ctypes.c_int, ctypes.c_void_p]
+        lib.pstpu_scan_plain_pages.restype = ctypes.c_longlong
+        lib.pstpu_scan_plain_pages.argtypes = [
+            ctypes.c_void_p, ctypes.c_ulonglong,
+            ctypes.POINTER(ctypes.c_ulonglong), ctypes.POINTER(ctypes.c_longlong),
+            ctypes.c_int]
         _lib = lib
         return _lib
 
@@ -122,6 +127,11 @@ class NativeParquetFile(object):
                 if dotted != top:
                     self._leaf_indices.setdefault(dotted, []).append(i)
         self.metadata = _MetadataShim(self)
+        # zero-copy page-scan state (lazy: first read_row_group with columns)
+        from petastorm_tpu.native.pagescan import _MmapPool
+        self._pq_meta = None        # pyarrow FileMetaData | False (unusable)
+        self._flat_index = {}
+        self._mmaps = _MmapPool()
 
     def row_group_num_rows(self, i):
         n = self._lib.pstpu_row_group_num_rows(self._handle, i)
@@ -129,14 +139,52 @@ class NativeParquetFile(object):
             raise IndexError(_last_error(self._lib))
         return n
 
+    def _zerocopy_columns(self, i, columns):
+        """``{name: ChunkedArray}`` for the columns servable as views over the
+        mmapped file (first-party page scan — see native/pagescan.py); lazily
+        parses the footer with pyarrow ONCE per file for the chunk metadata
+        the qualification check needs."""
+        if os.environ.get('PSTPU_DISABLE_PAGESCAN'):
+            return {}
+        if self._pq_meta is None:
+            import pyarrow.parquet as pq
+            try:
+                self._pq_meta = pq.read_metadata(self.path)
+            except Exception:  # noqa: BLE001 - odd footer: Arrow path serves it all
+                self._pq_meta = False
+            else:
+                # flat REQUIRED-eligible columns: leaf path == top-level name
+                self._flat_index = {
+                    self._pq_meta.schema.column(idx).path: idx
+                    for idx in range(self._pq_meta.num_columns)
+                    if '.' not in self._pq_meta.schema.column(idx).path}
+        if self._pq_meta is False:
+            return {}
+        from petastorm_tpu.native import pagescan
+        return pagescan.read_columns_zerocopy(
+            self.path, self._pq_meta, i, columns, self._flat_index,
+            self._mmaps, self._lib)
+
     def read_row_group(self, i, columns=None):
-        """Read one row group as a ``pyarrow.Table`` (decode on C++ threads,
-        zero-copy import through the Arrow C Data Interface)."""
+        """Read one row group as a ``pyarrow.Table``. Columns that qualify for
+        the first-party zero-copy page scan (UNCOMPRESSED PLAIN REQUIRED
+        fixed-width — RawTensorCodec training stores) become views over the
+        mmapped file; the rest decode on Arrow C++ threads and import
+        zero-copy through the Arrow C Data Interface. Mixed tables split per
+        column, preserving the requested column order."""
         import pyarrow as pa
 
-        if columns is not None:
+        fast = self._zerocopy_columns(i, columns) if columns else {}
+        rest = [c for c in columns if c not in fast] if columns is not None else None
+
+        # columns=[] must keep the 0-column N-row semantics of the Arrow path
+        # (partition-key-only reads take row counts from it), so the fast-only
+        # return requires a NON-empty request fully served
+        if columns and not rest:
+            return pa.table({c: fast[c] for c in columns})
+        if rest is not None:
             indices = []
-            for c in columns:
+            for c in rest:
                 try:
                     indices.extend(self._leaf_indices[c])
                 except KeyError:
@@ -156,12 +204,19 @@ class NativeParquetFile(object):
                 self.path, i, _last_error(self._lib)))
         reader = pa.RecordBatchReader._import_from_c(
             ctypes.addressof(stream_buf))
-        return reader.read_all()
+        table = reader.read_all()
+        if not fast:
+            return table
+        return pa.table({c: (fast[c] if c in fast else table.column(c))
+                         for c in columns})
 
     def close(self):
         if self._handle:
             self._lib.pstpu_close(self._handle)
             self._handle = None
+        # drops the pool's references only: arrays built over a mapping keep
+        # it alive through their buffers
+        self._mmaps.close()
 
     def __del__(self):
         try:

@@ -209,6 +209,193 @@ int pstpu_read_row_group(void* h, int row_group, const int* columns,
   return 0;
 }
 
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// First-party Parquet page scan — the zero-copy fast path.
+//
+// For UNCOMPRESSED, PLAIN-encoded, REQUIRED (max_def_level==0) fixed-width
+// columns — the layout RawTensorCodec stores produce — a page's values region
+// is byte-identical to the Arrow data buffer, so decode is a VIEW over the
+// mmapped file instead of Arrow's assemble-and-copy. The only parsing needed
+// is the page headers, which are thrift compact-protocol structs; the minimal
+// reader below parses exactly the PageHeader/DataPageHeader fields the scan
+// needs and generically skips everything else (statistics, crc, ...). No
+// Arrow involvement: a parse error or any unsupported feature returns -1 and
+// the caller falls back to the Arrow path above.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct TReader {
+  const uint8_t* p;
+  const uint8_t* end;
+  bool ok = true;
+
+  uint8_t byte() {
+    if (p >= end) { ok = false; return 0; }
+    return *p++;
+  }
+  uint64_t varint() {
+    uint64_t v = 0;
+    int shift = 0;
+    while (ok) {
+      const uint8_t b = byte();
+      v |= uint64_t(b & 0x7F) << shift;
+      if (!(b & 0x80)) break;
+      shift += 7;
+      if (shift > 63) { ok = false; break; }
+    }
+    return v;
+  }
+  int64_t zigzag() {
+    const uint64_t v = varint();
+    return int64_t(v >> 1) ^ -int64_t(v & 1);
+  }
+  void skip_bytes(uint64_t n) {
+    if (uint64_t(end - p) < n) { ok = false; return; }
+    p += n;
+  }
+  void skip_value(int type);  // forward (recursive for containers/structs)
+  void skip_struct() {
+    while (ok) {
+      const uint8_t head = byte();
+      if (head == 0) return;  // STOP
+      if ((head & 0x0F) == 0) { ok = false; return; }
+      if ((head >> 4) == 0) (void)zigzag();  // long-form field id
+      skip_value(head & 0x0F);
+    }
+  }
+};
+
+void TReader::skip_value(int type) {
+  switch (type) {
+    case 1: case 2: return;             // bool true/false: value in the nibble
+    case 3: skip_bytes(1); return;      // byte (raw, not varint)
+    case 4: case 5: case 6: (void)zigzag(); return;  // i16/i32/i64
+    case 7: skip_bytes(8); return;      // double
+    case 8: skip_bytes(varint()); return;  // binary/string
+    case 9: case 10: {                  // list/set
+      const uint8_t head = byte();
+      uint64_t n = head >> 4;
+      if (n == 0xF) n = varint();
+      const int elem = head & 0x0F;
+      for (uint64_t i = 0; i < n && ok; i++) {
+        if (elem == 1 || elem == 2) skip_bytes(1);  // bool element: one byte
+        else skip_value(elem);
+      }
+      return;
+    }
+    case 11: {                          // map
+      const uint64_t n = varint();
+      if (n == 0) return;
+      const uint8_t kv = byte();
+      for (uint64_t i = 0; i < n && ok; i++) {
+        skip_value(kv >> 4);
+        skip_value(kv & 0x0F);
+      }
+      return;
+    }
+    case 12: skip_struct(); return;     // struct
+    default: ok = false; return;
+  }
+}
+
+struct PageInfo {
+  int32_t page_type = -1;          // 0=DATA_PAGE, 2=DICTIONARY_PAGE, 3=DATA_PAGE_V2
+  int64_t uncompressed_size = -1;
+  int64_t compressed_size = -1;
+  int64_t num_values = -1;
+  int32_t encoding = -1;           // DataPageHeader.encoding; 0=PLAIN
+  uint64_t header_len = 0;
+};
+
+// Parse one compact-protocol PageHeader starting at r.p; fills `info`.
+bool parse_page_header(TReader& r, PageInfo* info) {
+  const uint8_t* start = r.p;
+  int16_t last_id = 0;
+  while (r.ok) {
+    const uint8_t head = r.byte();
+    if (head == 0) break;  // STOP
+    const int type = head & 0x0F;
+    int16_t id;
+    if ((head >> 4) == 0) {
+      id = int16_t(r.zigzag());
+    } else {
+      id = int16_t(last_id + (head >> 4));
+    }
+    last_id = id;
+    if (id == 1 && type == 5) {
+      info->page_type = int32_t(r.zigzag());
+    } else if (id == 2 && type == 5) {
+      info->uncompressed_size = r.zigzag();
+    } else if (id == 3 && type == 5) {
+      info->compressed_size = r.zigzag();
+    } else if (id == 5 && type == 12) {  // DataPageHeader
+      int16_t inner_last = 0;
+      while (r.ok) {
+        const uint8_t ih = r.byte();
+        if (ih == 0) break;
+        const int itype = ih & 0x0F;
+        int16_t iid = (ih >> 4) == 0 ? int16_t(r.zigzag())
+                                     : int16_t(inner_last + (ih >> 4));
+        inner_last = iid;
+        if (iid == 1 && itype == 5) info->num_values = r.zigzag();
+        else if (iid == 2 && itype == 5) info->encoding = int32_t(r.zigzag());
+        else r.skip_value(itype);
+      }
+    } else {
+      r.skip_value(type);
+    }
+  }
+  info->header_len = uint64_t(r.p - start);
+  return r.ok;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Scan an in-memory Parquet column chunk of UNCOMPRESSED PLAIN v1 data
+// pages. out_offsets[i] = byte offset of page i's values region within
+// `chunk`; out_counts[i] = its value count. Returns the page count, or -1
+// on any parse error or unsupported feature (dictionary page, v2 page,
+// compression, non-PLAIN encoding) — the caller then uses the Arrow path.
+long long pstpu_scan_plain_pages(const uint8_t* chunk, unsigned long long chunk_len,
+                                 unsigned long long* out_offsets,
+                                 long long* out_counts, int max_pages) {
+  uint64_t pos = 0;
+  int n = 0;
+  while (pos < chunk_len) {
+    TReader r{chunk + pos, chunk + chunk_len};
+    PageInfo info;
+    if (!parse_page_header(r, &info)) {
+      set_error("page header parse failed");
+      return -1;
+    }
+    if (info.page_type != 0 || info.encoding != 0 || info.num_values < 0 ||
+        info.compressed_size < 0 ||
+        info.compressed_size != info.uncompressed_size) {
+      set_error("unsupported page (type/encoding/compression)");
+      return -1;
+    }
+    const uint64_t data_off = pos + info.header_len;
+    if (data_off + uint64_t(info.compressed_size) > chunk_len) {
+      set_error("page overruns chunk");
+      return -1;
+    }
+    if (n >= max_pages) {
+      set_error("more pages than max_pages");
+      return -1;
+    }
+    out_offsets[n] = data_off;
+    out_counts[n] = info.num_values;
+    n++;
+    pos = data_off + uint64_t(info.compressed_size);
+  }
+  return n;
+}
+
 int pstpu_abi_version() { return 1; }
 
 }  // extern "C"

@@ -571,7 +571,7 @@ def _worker_bootstrap(worker_id, main_pid, setup_blob, vent_addr, result_addr, c
         if parts is not None:
             total = serializer.parts_size(parts)
             fits_ring = ring is not None and total + 17 <= ring.capacity  # 9B+8B framing
-            if fits_ring and (total < blob_threshold or not blob_live):
+            if fits_ring and (not blob_live or total < blob_threshold):
                 ring.writev([_ring_header(_DATA, current['seq'])] + parts,
                             stop_check=check_finished)
                 return
