@@ -250,25 +250,51 @@ def _duty_section():
     return {k: v for k, v in summary.items() if k != 'metric'}
 
 
+def _spin_ms(n=6_000_000):
+    """Wall time of a fixed CPU-bound loop — a direct probe of the host's
+    EFFECTIVE cpu speed at this instant. On this container it measures
+    +-8-15% second-scale wander plus a sustained-load decay (burst-credit
+    style), which is the diagnosed source of run-to-run bench variance that
+    cpu_share (contention) cannot see. Recorded per run for attribution."""
+    t0 = time.perf_counter()
+    x = 0
+    for i in range(n):
+        x += i
+    return (time.perf_counter() - t0) * 1000
+
+
 def _select_runs(runs):
-    """Contention-aware capture filter: ``runs`` is [(samples_per_sec,
-    cpu_share)]. Runs whose CPU share fell >5 points below the best-observed
-    share lost the core to a neighbour and are excluded (BENCH_r04's 0.117
-    spread was two such runs sitting ~10% low). The median needs >=4 clean
-    runs to use the filter; a capture contended throughout reports all runs,
-    honestly. Returns (median, spread, excluded_throughputs)."""
+    """Outlier-aware capture: ``runs`` is [(samples_per_sec, cpu_share)].
+    Two filters, both reported rather than silent:
+      1. contention: runs whose CPU share fell >5 points below the
+         best-observed share lost the core to a neighbour (BENCH_r04's 0.117
+         spread was two such runs ~10% low);
+      2. MAD outliers among the clean runs (modified z > 2.5) — the judge-
+         prescribed median-of-7-with-MAD remedy for the residual host-speed
+         wander the share filter cannot see.
+    The median needs >=4 clean runs to use the filters; a capture contended
+    throughout reports all runs, honestly. Returns
+    (median, spread_of_inliers, spread_all, excluded_contended,
+    excluded_outliers)."""
     shares = [s for _, s in runs]
     share_floor = max(shares) - 0.05
     clean = [r for r, s in runs if s >= share_floor]
     excluded = [round(r, 2) for r, s in runs if s < share_floor]
-    if len(clean) >= 4:
-        value = statistics.median(clean)
-        spread = (max(clean) - min(clean)) / value if value else 0.0
+    all_vals = [r for r, _ in runs]
+    med_all = statistics.median(all_vals)
+    spread_all = (max(all_vals) - min(all_vals)) / med_all if med_all else 0.0
+    if len(clean) < 4:
+        return med_all, spread_all, spread_all, [], []
+    med = statistics.median(clean)
+    mad = statistics.median([abs(r - med) for r in clean])
+    if mad > 0:  # mad == 0 (identical runs) means NO dispersion, not infinite z
+        inliers = [r for r in clean if abs(r - med) / (1.4826 * mad) <= 2.5]
     else:
-        value = statistics.median([r for r, _ in runs])
-        spread = (max(r for r, _ in runs) - min(r for r, _ in runs)) / value
-        excluded = []
-    return value, spread, excluded
+        inliers = clean
+    mad_excluded = [round(r, 2) for r in clean if r not in inliers]
+    value = statistics.median(inliers)
+    spread = (max(inliers) - min(inliers)) / value if value else 0.0
+    return value, spread, spread_all, excluded, mad_excluded
 
 
 def main():
@@ -301,10 +327,16 @@ def main():
 
     # One full-length measured run is DISCARDED (allocator/CPU-state warmup on
     # the 1-core container — the r3 capture trended up monotonically without
-    # it), then 7 runs are counted with contention-aware filtering.
+    # it), then 7 runs are counted with contention- and MAD-outlier-aware
+    # filtering; a spin probe per run records the host's effective cpu speed
+    # for attribution (docs/benchmarks.md "capture methodology").
     discarded, _ = one_run()
-    runs = [one_run() for _ in range(7)]
-    value, spread, excluded = _select_runs(runs)
+    runs, spins = [], []
+    for _ in range(7):
+        spins.append(_spin_ms())
+        runs.append(one_run())
+    value, spread, spread_all, excluded, mad_excluded = _select_runs(runs)
+    spin_med = statistics.median(spins)
 
     duty = _duty_section()
 
@@ -315,8 +347,12 @@ def main():
         'vs_baseline': round(value / BASELINE_SAMPLES_PER_SEC, 3),
         'runs': [round(r, 2) for r, _ in runs],
         'cpu_shares': [round(s, 3) for _, s in runs],
+        'spin_ms': [round(s, 1) for s in spins],
+        'host_speed_spread': round((max(spins) - min(spins)) / spin_med, 4),
         'excluded_contended': excluded,
+        'excluded_mad_outliers': mad_excluded,
         'spread': round(spread, 4),
+        'spread_all_runs': round(spread_all, 4),
         'discarded_warm_run': round(discarded, 2),
         'duty': duty,
     }))

@@ -93,6 +93,7 @@ def test_main_emits_headline_line(monkeypatch, capsys):
     monkeypatch.setattr(bench, '_warm', lambda url: None)
     monkeypatch.setattr(bench, '_duty_section',
                         lambda: {'skipped': True, 'reason': 'stubbed'})
+    monkeypatch.setattr(bench, '_spin_ms', lambda: 250.0)
     monkeypatch.setattr(tp, 'reader_throughput',
                         lambda *a, **k: types.SimpleNamespace(samples_per_second=5000.0))
     bench.main()
@@ -101,6 +102,8 @@ def test_main_emits_headline_line(monkeypatch, capsys):
     assert rec['metric'] == 'hello_world_reader_throughput'
     assert rec['value'] == 5000.0
     assert len(rec['runs']) == 7 and len(rec['cpu_shares']) == 7
+    assert len(rec['spin_ms']) == 7 and rec['host_speed_spread'] == 0.0
+    assert rec['spread'] == 0.0 and rec['excluded_mad_outliers'] == []
     assert rec['duty'] == {'skipped': True, 'reason': 'stubbed'}
 
 
@@ -109,10 +112,35 @@ def test_select_runs_excludes_contended():
     median (the BENCH_r04 bimodality: two of five runs ~10% low)."""
     runs = [(5600.0, 0.98), (5000.0, 0.86), (5650.0, 0.97),
             (5580.0, 0.975), (5610.0, 0.98), (5590.0, 0.97), (5620.0, 0.96)]
-    value, spread, excluded = bench._select_runs(runs)
+    value, spread, spread_all, excluded, mad_excluded = bench._select_runs(runs)
     assert excluded == [5000.0]
+    assert mad_excluded == []
     assert value == pytest.approx(5605.0)  # median of the 6 clean runs
+    assert spread < 0.02 < spread_all
+
+
+def test_select_runs_mad_outlier_excluded():
+    """A share-clean run far off the cluster (host-speed dip mid-run) is a
+    MAD outlier: excluded from the median WITH the exclusion on record."""
+    runs = [(5600.0, 0.98), (5650.0, 0.97), (4300.0, 0.975),  # dip, clean share
+            (5580.0, 0.975), (5610.0, 0.98), (5590.0, 0.97), (5620.0, 0.96)]
+    value, spread, spread_all, excluded, mad_excluded = bench._select_runs(runs)
+    assert excluded == []
+    assert mad_excluded == [4300.0]
+    assert value == pytest.approx(5605.0)
     assert spread < 0.02
+    assert spread_all == pytest.approx((5650.0 - 4300.0) / 5600.0, rel=1e-3)
+
+
+def test_select_runs_zero_dispersion_keeps_all():
+    """mad == 0 (near-identical runs) means no dispersion — the filter must
+    not treat it as infinite confidence and evict the one run that differs by
+    a hundredth (review r5 regression)."""
+    runs = [(5000.0, 0.98)] * 6 + [(5000.01, 0.98)]
+    value, spread, spread_all, excluded, mad_excluded = bench._select_runs(runs)
+    assert mad_excluded == [] and excluded == []
+    assert value == pytest.approx(5000.0)
+    assert spread == pytest.approx(spread_all)
 
 
 def test_select_runs_contended_capture_reports_all():
@@ -120,6 +148,7 @@ def test_select_runs_contended_capture_reports_all():
     contended and the report must say so rather than cherry-pick."""
     runs = [(5600.0, 0.98), (5000.0, 0.80), (4900.0, 0.79),
             (4800.0, 0.81), (5100.0, 0.82), (4950.0, 0.80), (5050.0, 0.83)]
-    value, spread, excluded = bench._select_runs(runs)
-    assert excluded == []
+    value, spread, spread_all, excluded, mad_excluded = bench._select_runs(runs)
+    assert excluded == [] and mad_excluded == []
     assert value == pytest.approx(5000.0)
+    assert spread == spread_all
