@@ -12,12 +12,15 @@ file — zero bytes copied; the OS page cache is the only storage layer.
 
 Qualification is strict and checked per column chunk from the Parquet
 metadata: local file, UNCOMPRESSED codec, PLAIN-only encodings (plus the
-level encodings), ``max_definition_level == 0`` (REQUIRED — no null/def-level
-parsing), flat non-nested path, physical type FIXED_LEN_BYTE_ARRAY / INT32 /
-INT64 / FLOAT / DOUBLE (BOOLEAN is bit-packed, INT96 is legacy — both
-excluded). Anything else returns None and the caller uses the Arrow path;
-mixed tables split per column, so one dictionary-encoded label column does
-not forfeit the zero-copy image column next to it.
+level encodings), flat non-nested path with ``max_definition_level == 0``
+(REQUIRED) — or ``== 1`` when the chunk statistics PROVE null_count == 0, in
+which case the page's RLE definition-levels block is skipped (the
+nullable-by-default layout ordinary writers produce) — and physical type
+FIXED_LEN_BYTE_ARRAY / INT32 / INT64 / FLOAT / DOUBLE (BOOLEAN is
+bit-packed, INT96 is legacy — both excluded). Anything else returns None and
+the caller uses the Arrow path; mixed tables split per column, so one
+dictionary-encoded label column does not forfeit the zero-copy image column
+next to it.
 
 Parity note: no reference counterpart — the reference reads everything
 through pyarrow (py_dict_reader_worker.py:254-258). This is the SURVEY §2.10
@@ -80,21 +83,34 @@ class _MmapPool(object):
         self._maps.clear()
 
 
-def _column_qualifies(meta_col, max_def_level):
-    if max_def_level != 0:
+def _column_qualifies(meta_col, max_def_level, max_rep_level):
+    """True/False, or the string 'def' for OPTIONAL columns the statistics
+    PROVE null-free — their pages lead with an RLE def-levels block the
+    scanner skips (nullable-by-default writers are the common real-world
+    case; an actual null would desynchronize the values region). Any
+    repetition (legacy top-level `repeated` primitives have a dot-free path
+    AND max_def_level 1, but their pages lead with a repetition-levels block
+    too) disqualifies."""
+    if max_rep_level != 0 or max_def_level > 1:
         return False
+    if max_def_level == 1:
+        stats = meta_col.statistics
+        if stats is None or stats.null_count is None or stats.null_count != 0:
+            return False
     if meta_col.compression != 'UNCOMPRESSED':
         return False
-    # PLAIN data pages only; RLE appears as the (unused) level encoding
+    # PLAIN data pages only; RLE appears as the level encoding
     if any(e not in ('PLAIN', 'RLE', 'BIT_PACKED') for e in meta_col.encodings):
         return False
     if meta_col.has_dictionary_page:
         return False
     pt = meta_col.physical_type
-    return pt == 'FIXED_LEN_BYTE_ARRAY' or pt in _PHYSICAL_FIXED
+    if pt != 'FIXED_LEN_BYTE_ARRAY' and pt not in _PHYSICAL_FIXED:
+        return False
+    return 'def' if max_def_level == 1 else True
 
 
-def _scan_chunk(lib, mm, meta_col):
+def _scan_chunk(lib, mm, meta_col, has_def_levels=False):
     """[(values_offset_in_file, num_values)] for one column chunk, or None."""
     start = meta_col.data_page_offset
     length = meta_col.total_compressed_size
@@ -103,7 +119,8 @@ def _scan_chunk(lib, mm, meta_col):
     chunk = mm[start:start + length]
     offs, counts = _scratch_arrays()
     n = lib.pstpu_scan_plain_pages(
-        chunk.ctypes.data_as(ctypes.c_void_p), length, offs, counts, _MAX_PAGES)
+        chunk.ctypes.data_as(ctypes.c_void_p), length, offs, counts, _MAX_PAGES,
+        1 if has_def_levels else 0)
     if n < 0:
         return None
     return [(start + offs[i], counts[i]) for i in range(n)]
@@ -154,11 +171,13 @@ def read_columns_zerocopy(path, pq_metadata, row_group, column_names,
         try:
             col = rg.column(idx)
             schema_col = pq_metadata.schema.column(idx)
-            if not _column_qualifies(col, schema_col.max_definition_level):
+            qual = _column_qualifies(col, schema_col.max_definition_level,
+                                     schema_col.max_repetition_level)
+            if not qual:
                 continue
             if mm is None:
                 mm = mmap_pool.get(path)
-            pages = _scan_chunk(lib, mm, col)
+            pages = _scan_chunk(lib, mm, col, has_def_levels=(qual == 'def'))
             if pages is None:
                 continue
             # the FLBA byte width lives on the schema column (``length``)

@@ -307,6 +307,7 @@ struct PageInfo {
   int64_t compressed_size = -1;
   int64_t num_values = -1;
   int32_t encoding = -1;           // DataPageHeader.encoding; 0=PLAIN
+  int32_t def_level_encoding = -1; // DataPageHeader field 3; 3=RLE
   uint64_t header_len = 0;
 };
 
@@ -342,6 +343,7 @@ bool parse_page_header(TReader& r, PageInfo* info) {
         inner_last = iid;
         if (iid == 1 && itype == 5) info->num_values = r.zigzag();
         else if (iid == 2 && itype == 5) info->encoding = int32_t(r.zigzag());
+        else if (iid == 3 && itype == 5) info->def_level_encoding = int32_t(r.zigzag());
         else r.skip_value(itype);
       }
     } else {
@@ -357,13 +359,19 @@ bool parse_page_header(TReader& r, PageInfo* info) {
 extern "C" {
 
 // Scan an in-memory Parquet column chunk of UNCOMPRESSED PLAIN v1 data
-// pages. out_offsets[i] = byte offset of page i's values region within
-// `chunk`; out_counts[i] = its value count. Returns the page count, or -1
-// on any parse error or unsupported feature (dictionary page, v2 page,
-// compression, non-PLAIN encoding) — the caller then uses the Arrow path.
+// pages. out_offsets[i] = byte offset of page i's VALUES region within
+// `chunk`; out_counts[i] = its value count. `has_def_levels` != 0 means the
+// column is OPTIONAL (max_def_level == 1): each page then leads with a
+// 4-byte-length-prefixed RLE definition-levels block which is skipped — the
+// caller is responsible for proving the chunk has ZERO nulls (statistics),
+// since a null would make value count < num_values. Returns the page count,
+// or -1 on any parse error or unsupported feature (dictionary page, v2
+// page, compression, non-PLAIN encoding, non-RLE def levels) — the caller
+// then uses the Arrow path.
 long long pstpu_scan_plain_pages(const uint8_t* chunk, unsigned long long chunk_len,
                                  unsigned long long* out_offsets,
-                                 long long* out_counts, int max_pages) {
+                                 long long* out_counts, int max_pages,
+                                 int has_def_levels) {
   uint64_t pos = 0;
   int n = 0;
   while (pos < chunk_len) {
@@ -379,10 +387,28 @@ long long pstpu_scan_plain_pages(const uint8_t* chunk, unsigned long long chunk_
       set_error("unsupported page (type/encoding/compression)");
       return -1;
     }
-    const uint64_t data_off = pos + info.header_len;
-    if (data_off + uint64_t(info.compressed_size) > chunk_len) {
+    uint64_t data_off = pos + info.header_len;
+    const uint64_t page_end = pos + info.header_len + uint64_t(info.compressed_size);
+    if (page_end > chunk_len) {
       set_error("page overruns chunk");
       return -1;
+    }
+    if (has_def_levels) {
+      if (info.def_level_encoding != 3) {  // RLE; BIT_PACKED legacy unsupported
+        set_error("unsupported definition-level encoding");
+        return -1;
+      }
+      if (data_off + 4 > page_end) {
+        set_error("def-levels length overruns page");
+        return -1;
+      }
+      uint32_t def_len;
+      std::memcpy(&def_len, chunk + data_off, 4);  // little-endian host
+      data_off += 4 + def_len;
+      if (data_off > page_end) {
+        set_error("def-levels block overruns page");
+        return -1;
+      }
     }
     if (n >= max_pages) {
       set_error("more pages than max_pages");
@@ -391,7 +417,7 @@ long long pstpu_scan_plain_pages(const uint8_t* chunk, unsigned long long chunk_
     out_offsets[n] = data_off;
     out_counts[n] = info.num_values;
     n++;
-    pos = data_off + uint64_t(info.compressed_size);
+    pos = page_end;
   }
   return n;
 }
