@@ -1,0 +1,8 @@
+"""``python -m petastorm_tpu.analysis`` entry point."""
+
+import sys
+
+from petastorm_tpu.analysis.cli import main
+
+if __name__ == '__main__':
+    sys.exit(main())

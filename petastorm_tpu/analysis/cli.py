@@ -1,0 +1,89 @@
+"""CLI for the first-party linter.
+
+::
+
+    python -m petastorm_tpu.analysis [paths ...] [options]
+    petastorm-tpu-lint [paths ...] [options]
+
+Default path is the installed ``petastorm_tpu`` package. Exit status: 0 when
+clean (after noqa + baseline), 1 when findings remain, 2 on usage errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _default_target():
+    import petastorm_tpu
+    return os.path.dirname(os.path.abspath(petastorm_tpu.__file__))
+
+
+def build_parser():
+    parser = argparse.ArgumentParser(
+        prog='petastorm-tpu-lint',
+        description='Repo-specific invariant linter: lock discipline (PT100), '
+                    'resource lifecycle (PT200), exception hygiene (PT300), JAX '
+                    'purity (PT400), native-buffer safety (PT500), hashability '
+                    '(PT600). See docs/analysis.md.')
+    parser.add_argument('paths', nargs='*',
+                        help='files/directories to scan (default: the installed '
+                             'petastorm_tpu package)')
+    parser.add_argument('--format', choices=('text', 'json'), default='text')
+    parser.add_argument('--baseline', metavar='FILE',
+                        help='analysis_baseline.json absorbing known findings '
+                             '(missing file = empty baseline)')
+    parser.add_argument('--write-baseline', metavar='FILE',
+                        help='write the current findings as a baseline and exit 0')
+    parser.add_argument('--select', metavar='CODES',
+                        help='comma-separated rule-id prefixes to report '
+                             '(e.g. PT1,PT500)')
+    parser.add_argument('--rules', action='store_true',
+                        help='list the rule families and exit')
+    return parser
+
+
+def main(argv=None):
+    from petastorm_tpu.analysis import ALL_CHECKERS, run_analysis
+    from petastorm_tpu.analysis.core import load_baseline, write_baseline
+
+    args = build_parser().parse_args(argv)
+
+    if args.rules:
+        for cls in ALL_CHECKERS:
+            print('{:<7} {:<22} {}'.format(cls.code, cls.name, cls.description))
+        return 0
+
+    paths = args.paths or [_default_target()]
+    for p in paths:
+        if not os.path.exists(p):
+            print('error: no such path: {}'.format(p), file=sys.stderr)
+            return 2
+
+    select = [c.strip().upper() for c in args.select.split(',')] if args.select else None
+    baseline = load_baseline(args.baseline) if args.baseline else None
+    findings = run_analysis(paths, baseline=baseline, select=select)
+
+    if args.write_baseline:
+        write_baseline(args.write_baseline, findings)
+        print('baseline with {} entr{} written to {}'.format(
+            len(findings), 'y' if len(findings) == 1 else 'ies', args.write_baseline))
+        return 0
+
+    if args.format == 'json':
+        print(json.dumps({'findings': [f.to_dict() for f in findings],
+                          'count': len(findings)}, indent=2))
+    else:
+        for f in findings:
+            print(f.format())
+            if f.snippet:
+                print('    {}'.format(f.snippet))
+        print('{} finding{}'.format(len(findings), '' if len(findings) == 1 else 's'))
+    return 1 if findings else 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -1,0 +1,79 @@
+"""PT300 — exception hygiene in the data plane.
+
+A worker/transport/decoder exception that is silently swallowed does not
+vanish: it resurfaces as a hung consumer (an item counted ventilated but never
+completed), a short epoch, or corrupt state. The pools therefore have an
+explicit error channel — thread pool workers forward through the results
+queue, process workers pickle the exception over the transport — and every
+broad handler in the data plane must either re-raise, forward, log, or carry a
+reviewed justification.
+
+Flagged: a bare ``except:`` or ``except Exception/BaseException`` handler that
+*swallows* — no ``raise``, the bound exception (if any) is never referenced,
+and the body performs no call at all (a call is evidence of handling:
+forwarding to the error channel, logging, cleanup, a fallback path). The
+existing ``# noqa: BLE001 - reason`` annotations are honored as suppressions
+(alias of PT300), so the tree's pre-reviewed handlers stay quiet.
+
+Scope: the data-plane modules — workers, reader/worker/serializer stack,
+native bindings, jax loader/infeed — not the ETL/CLI long tail, where a
+swallow costs a warning, not a training run.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from petastorm_tpu.analysis.core import Checker
+
+_BROAD = {'Exception', 'BaseException'}
+
+
+def _is_broad(handler):
+    t = handler.type
+    if t is None:
+        return True
+    if isinstance(t, ast.Name):
+        return t.id in _BROAD
+    if isinstance(t, ast.Tuple):
+        return any(isinstance(el, ast.Name) and el.id in _BROAD for el in t.elts)
+    return False
+
+
+def _body_swallows(handler):
+    """True when the handler neither raises, nor references the bound
+    exception, nor calls anything."""
+    bound = handler.name
+    for node in ast.walk(handler):
+        if node is handler.type:
+            continue
+        if isinstance(node, ast.Raise):
+            return False
+        if isinstance(node, ast.Call):
+            return False
+        if bound and isinstance(node, ast.Name) and node.id == bound:
+            return False
+    return True
+
+
+class ExceptionHygieneChecker(Checker):
+    code = 'PT300'
+    name = 'exception-hygiene'
+    description = ('broad except that swallows without forwarding to the error '
+                   'channel, logging, or re-raising (data-plane modules)')
+    scope = ('*workers/*.py', '*native/*.py', '*jax/*.py',
+             '*reader.py', '*row_worker.py', '*batch_worker.py', '*serializers.py',
+             '*shuffling_buffer.py', '*columnar.py', '*rebatch.py',
+             '*cache.py', '*local_disk_cache.py', '*retry.py')
+
+    def check(self, src):
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if _is_broad(node) and _body_swallows(node):
+                what = ('bare except' if node.type is None else
+                        'except {}'.format(ast.unparse(node.type)))
+                yield self.finding(
+                    src, node.lineno,
+                    '{} swallows silently — forward to the pool error channel, '
+                    'log, re-raise, or annotate why discarding is safe'.format(what))

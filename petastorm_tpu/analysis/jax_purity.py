@@ -1,0 +1,143 @@
+"""PT400 — purity of functions that JAX traces.
+
+``jax.jit``/``pjit``/``shard_map`` trace a function ONCE and replay the
+recorded computation: host-side effects inside it run at trace time only
+(``np.random``/``random``/``time.*`` values freeze into constants baked into
+the compiled executable), ``.item()``/``.tolist()`` force a blocking
+device->host sync (or a ConcretizationTypeError on abstract tracers), and
+in-place mutation of an argument or closed-over ndarray writes to a tracer or
+leaks a stale host buffer. Generic linters cannot know which functions JAX
+traces; this rule resolves the repo's jit idioms:
+
+* ``@jax.jit`` / ``@jit`` / ``@pjit`` decorators
+* ``@functools.partial(jax.jit, ...)`` / ``@partial(jit, ...)`` (also for
+  ``shard_map``)
+* ``jax.jit(fn)`` / ``jax.shard_map(fn, ...)`` calls whose argument names a
+  function defined in the same module
+
+and checks those functions plus their nested ``def``s (inner closures trace
+with the outer function).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from petastorm_tpu.analysis.core import Checker, attr_chain
+
+_TRACERS = {'jit', 'pjit', 'shard_map'}
+
+#: dotted-call prefixes that are host-side effects under trace
+_IMPURE_PREFIXES = ('np.random.', 'numpy.random.', 'random.', 'time.',
+                    'datetime.datetime.now', 'datetime.datetime.utcnow',
+                    'os.urandom', 'uuid.')
+
+#: method calls forcing device->host sync / concretization
+_SYNC_METHODS = {'item', 'tolist'}
+
+
+def _tracer_name(node):
+    """'jit'/'pjit'/'shard_map' when ``node`` references one, else None."""
+    chain = attr_chain(node)
+    if chain is None:
+        return None
+    last = chain.rsplit('.', 1)[-1]
+    return last if last in _TRACERS else None
+
+
+def _decorator_traces(dec):
+    """Does this decorator make the function traced?"""
+    if _tracer_name(dec):
+        return True
+    if isinstance(dec, ast.Call):
+        # @jax.jit(static_argnames=...) or @functools.partial(jax.jit, ...)
+        if _tracer_name(dec.func):
+            return True
+        chain = attr_chain(dec.func) or ''
+        if chain.rsplit('.', 1)[-1] == 'partial' and dec.args \
+                and _tracer_name(dec.args[0]):
+            return True
+    return False
+
+
+def _collect_traced_functions(tree):
+    """FunctionDef nodes that JAX traces, via decorators or jit(fn) calls."""
+    by_name = {}
+    traced = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            by_name.setdefault(node.name, node)
+            if any(_decorator_traces(d) for d in node.decorator_list):
+                traced.append(node)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _tracer_name(node.func) and node.args:
+            arg = node.args[0]
+            if isinstance(arg, ast.Name) and arg.id in by_name:
+                fn = by_name[arg.id]
+                if fn not in traced:
+                    traced.append(fn)
+    return traced
+
+
+class JaxPurityChecker(Checker):
+    code = 'PT400'
+    name = 'jax-purity'
+    description = ('host-side effects (np.random/time/.item()/argument mutation) '
+                   'inside functions traced by jit/pjit/shard_map')
+    scope = ('*jax/*.py', '*ops/*.py', '*parallel/*.py')
+
+    def check(self, src):
+        for fn in _collect_traced_functions(src.tree):
+            yield from self._check_traced(src, fn)
+
+    def _check_traced(self, src, fn):
+        params = {a.arg for a in (fn.args.args + fn.args.posonlyargs
+                                  + fn.args.kwonlyargs)}
+        if fn.args.vararg:
+            params.add(fn.args.vararg.arg)
+        if fn.args.kwarg:
+            params.add(fn.args.kwarg.arg)
+        # two passes: first collect every plain-Name binding in the function
+        # (any walk order), then judge subscript writes against that set — a
+        # name never bound locally is an argument or a closed-over array
+        local_names = set(params)
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+                for t in targets:
+                    for el in (t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t]):
+                        if isinstance(el, ast.Name):
+                            local_names.add(el.id)
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                for el in (node.target.elts if isinstance(node.target, (ast.Tuple, ast.List))
+                           else [node.target]):
+                    if isinstance(el, ast.Name):
+                        local_names.add(el.id)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                chain = attr_chain(node.func)
+                if chain is not None and chain.startswith(_IMPURE_PREFIXES):
+                    yield self.finding(
+                        src, node.lineno,
+                        "'{}()' inside traced function {}() runs at trace time "
+                        'only — its value freezes into the compiled executable; '
+                        'use jax.random / pass values as arguments'.format(
+                            chain, fn.name))
+                elif isinstance(node.func, ast.Attribute) \
+                        and node.func.attr in _SYNC_METHODS and not node.args:
+                    yield self.finding(
+                        src, node.lineno,
+                        ".{}() inside traced function {}() forces a device sync "
+                        'and fails on abstract tracers — keep values as jax '
+                        'arrays'.format(node.func.attr, fn.name))
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+                for t in targets:
+                    if isinstance(t, ast.Subscript) and isinstance(t.value, ast.Name):
+                        name = t.value.id
+                        if name in params or name not in local_names:
+                            yield self.finding(
+                                src, t.lineno,
+                                "in-place subscript write to '{}' inside traced "
+                                'function {}() mutates an argument or closure — '
+                                'use .at[...].set(...)'.format(name, fn.name))

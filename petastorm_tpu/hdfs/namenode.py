@@ -289,6 +289,11 @@ def as_pyarrow_filesystem(ha_client):
         def __ne__(self, other):
             return not self.__eq__(other)
 
+        def __hash__(self):
+            # __eq__ without __hash__ would make the handler (and the
+            # PyFileSystem over it) unhashable (PT600)
+            return hash((type(self), tuple(self.fs._list_of_namenodes or ())))
+
     return pafs.PyFileSystem(_HaHandler(ha_client))
 
 

@@ -1,0 +1,28 @@
+"""Version shims for the JAX API surface the framework uses.
+
+``shard_map`` was promoted from ``jax.experimental.shard_map`` to the
+top-level ``jax.shard_map`` (jax >= 0.6); the experimental module is slated
+for removal on the other end. Resolve whichever this environment provides so
+the sequence/pipeline ops run across the jax versions the fleet actually has.
+"""
+
+from __future__ import annotations
+
+import jax
+
+if hasattr(jax, 'shard_map'):
+    shard_map = jax.shard_map
+
+    def legacy_shard_map_kwargs():
+        return {}
+else:  # pre-promotion jax: the experimental home
+    from jax.experimental.shard_map import shard_map  # noqa: F401
+
+    def legacy_shard_map_kwargs():
+        """Extra shard_map kwargs only the pre-promotion API needs: its
+        replication checker false-positives on grad-of-scan carries (the
+        error text itself prescribes ``check_rep=False``); the promoted API
+        infers these correctly and no longer spells the kwarg this way."""
+        return {'check_rep': False}
+
+__all__ = ['legacy_shard_map_kwargs', 'shard_map']

@@ -68,6 +68,7 @@ def _load_library():
         lib.pstpu_scan_plain_pages.argtypes = [
             ctypes.c_void_p, ctypes.c_ulonglong,
             ctypes.POINTER(ctypes.c_ulonglong), ctypes.POINTER(ctypes.c_longlong),
+            ctypes.POINTER(ctypes.c_ulonglong),  # per-page values-region lengths
             ctypes.c_int, ctypes.c_int]
         _lib = lib
         return _lib

@@ -57,7 +57,10 @@ def _stamp():
 
 
 def _shm_stamp():
-    return _source_hash(SHM_SOURCE)
+    # 'rt1' is the build-recipe tag: bumping it invalidates .so files compiled
+    # with an older command line (e.g. before -lrt, which glibc < 2.34 needs
+    # for shm_open — without it the .so loads fail with an undefined symbol)
+    return 'rt1:' + _source_hash(SHM_SOURCE)
 
 
 def _cpu_fingerprint():
@@ -149,7 +152,10 @@ def build(force=False, quiet=False):
 def build_shm(force=False, quiet=False):
     """Compile the shared-memory ring transport (no external deps)."""
     def make_cmd(tmp_out):
-        return ['g++', '-O2', '-std=c++17', '-shared', '-fPIC', SHM_SOURCE, '-o', tmp_out]
+        # -lrt: shm_open/shm_unlink live in librt until glibc 2.34 (a no-op
+        # stub library after); without it the .so carries an undefined symbol
+        return ['g++', '-O2', '-std=c++17', '-shared', '-fPIC', SHM_SOURCE,
+                '-lrt', '-o', tmp_out]
 
     return _build_target(SHM_OUTPUT, _shm_stamp, make_cmd, 'shm ring', force, quiet)
 

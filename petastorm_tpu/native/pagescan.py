@@ -58,7 +58,8 @@ def _scratch_arrays():
     arrays = getattr(_scratch, 'arrays', None)
     if arrays is None:
         arrays = ((ctypes.c_ulonglong * _MAX_PAGES)(),
-                  (ctypes.c_longlong * _MAX_PAGES)())
+                  (ctypes.c_longlong * _MAX_PAGES)(),
+                  (ctypes.c_ulonglong * _MAX_PAGES)())
         _scratch.arrays = arrays
     return arrays
 
@@ -111,23 +112,34 @@ def _column_qualifies(meta_col, max_def_level, max_rep_level):
 
 
 def _scan_chunk(lib, mm, meta_col, has_def_levels=False):
-    """[(values_offset_in_file, num_values)] for one column chunk, or None."""
+    """[(values_offset_in_file, num_values, values_region_len)] for one column
+    chunk, or None. The region length is the scanner-verified byte span from
+    the values start to the page end — the per-page bound a view must fit."""
     start = meta_col.data_page_offset
     length = meta_col.total_compressed_size
     if start < 0 or length <= 0 or start + length > mm.size:
         return None
     chunk = mm[start:start + length]
-    offs, counts = _scratch_arrays()
+    offs, counts, vlens = _scratch_arrays()
     n = lib.pstpu_scan_plain_pages(
-        chunk.ctypes.data_as(ctypes.c_void_p), length, offs, counts, _MAX_PAGES,
-        1 if has_def_levels else 0)
+        chunk.ctypes.data_as(ctypes.c_void_p), length, offs, counts, vlens,
+        _MAX_PAGES, 1 if has_def_levels else 0)
     if n < 0:
         return None
-    return [(start + offs[i], counts[i]) for i in range(n)]
+    return [(start + offs[i], counts[i], vlens[i]) for i in range(n)]
 
 
-def _chunk_to_arrays(mm, meta_col, pages, expected_rows, flba_width):
-    """One Arrow array per page, each a view over the mmap."""
+def _chunk_to_arrays(mm, meta_col, pages, expected_rows, flba_width,
+                     require_exact=True):
+    """One Arrow array per page, each a view over the mmap.
+
+    Every view is bounds-checked against its PAGE's values region, not just
+    the file: a wrong null_count statistic (buggy third-party writer) or a
+    short page would otherwise silently serve the next page's header/level
+    bytes as tensor data. REQUIRED columns (``require_exact``) must fill the
+    region exactly; def-skipped OPTIONAL columns may leave a tail (the levels
+    block precedes the values, but be permissive about writer padding). Any
+    mismatch returns None — the Arrow path serves the column."""
     pt = meta_col.physical_type
     if pt == 'FIXED_LEN_BYTE_ARRAY':
         if not flba_width or flba_width <= 0:
@@ -139,8 +151,10 @@ def _chunk_to_arrays(mm, meta_col, pages, expected_rows, flba_width):
         arrow_type = factory()
     arrays = []
     total = 0
-    for off, count in pages:
+    for off, count, region_len in pages:
         nbytes = count * itemsize
+        if nbytes > region_len or (require_exact and nbytes != region_len):
+            return None
         if off + nbytes > mm.size:
             return None
         buf = pa.py_buffer(memoryview(mm)[off:off + nbytes])
@@ -182,7 +196,8 @@ def read_columns_zerocopy(path, pq_metadata, row_group, column_names,
                 continue
             # the FLBA byte width lives on the schema column (``length``)
             arrays = _chunk_to_arrays(mm, col, pages, expected_rows,
-                                      getattr(schema_col, 'length', 0))
+                                      getattr(schema_col, 'length', 0),
+                                      require_exact=(qual != 'def'))
             if arrays is None:
                 continue
             out[name] = pa.chunked_array(arrays)

@@ -15,10 +15,18 @@
 #include <arrow/api.h>
 #include <arrow/c/bridge.h>
 #include <arrow/io/file.h>
+#include <arrow/util/config.h>
 #include <parquet/arrow/reader.h>
 #include <parquet/file_reader.h>
 #include <parquet/metadata.h>
 #include <parquet/properties.h>
+
+// parquet::arrow::FileReader factory/read APIs: Status + out-param in the
+// long-stable wheels (<= 22), arrow::Result returns in the newer ones the
+// original kernel targeted. Support both; a mismatch merely disables the
+// kernel (build failure -> pure-pyarrow fallback), but matching here keeps
+// the native path alive across the pyarrow versions the fleet actually runs.
+#define PSTPU_ARROW_RESULT_APIS (ARROW_VERSION_MAJOR >= 23)
 
 #include <fcntl.h>
 
@@ -109,6 +117,7 @@ void* pstpu_open(const char* path, int use_threads, long long buffer_size) {
   handle->metadata = pq_reader->metadata();
   parquet::ArrowReaderProperties arrow_props;
   arrow_props.set_use_threads(use_threads != 0);
+#if PSTPU_ARROW_RESULT_APIS
   auto maybe_reader = parquet::arrow::FileReader::Make(
       arrow::default_memory_pool(), std::move(pq_reader), arrow_props);
   if (!maybe_reader.ok()) {
@@ -116,6 +125,15 @@ void* pstpu_open(const char* path, int use_threads, long long buffer_size) {
     return nullptr;
   }
   handle->reader = std::move(*maybe_reader);
+#else
+  auto st = parquet::arrow::FileReader::Make(
+      arrow::default_memory_pool(), std::move(pq_reader), arrow_props,
+      &handle->reader);
+  if (!st.ok()) {
+    set_error(st.ToString());
+    return nullptr;
+  }
+#endif
   return handle.release();
 }
 
@@ -172,6 +190,8 @@ int pstpu_read_row_group(void* h, int row_group, const int* columns,
     return -1;
   }
   advise_row_group(handle, row_group, columns, n_columns);
+  std::shared_ptr<arrow::Table> table;
+#if PSTPU_ARROW_RESULT_APIS
   arrow::Result<std::shared_ptr<arrow::Table>> maybe_table =
       (columns != nullptr && n_columns >= 0)
           ? handle->reader->ReadRowGroup(row_group,
@@ -181,7 +201,18 @@ int pstpu_read_row_group(void* h, int row_group, const int* columns,
     set_error(maybe_table.status().ToString());
     return -1;
   }
-  std::shared_ptr<arrow::Table> table = *maybe_table;
+  table = *maybe_table;
+#else
+  arrow::Status read_st =
+      (columns != nullptr && n_columns >= 0)
+          ? handle->reader->ReadRowGroup(
+                row_group, std::vector<int>(columns, columns + n_columns), &table)
+          : handle->reader->ReadRowGroup(row_group, &table);
+  if (!read_st.ok()) {
+    set_error(read_st.ToString());
+    return -1;
+  }
+#endif
   // hand ownership of the decoded batches to the stream
   arrow::TableBatchReader batch_reader(*table);
   std::vector<std::shared_ptr<arrow::RecordBatch>> batches;
@@ -227,6 +258,12 @@ int pstpu_read_row_group(void* h, int row_group, const int* columns,
 
 namespace {
 
+// Deepest nested container/struct chain the generic skipper will follow. Real
+// PageHeaders nest 2-3 levels; a crafted/corrupt header nesting deeper is
+// hostile input that must set ok=false (-> Arrow fallback), NOT recurse until
+// the C++ stack overflows and kills the process (PT502).
+constexpr int kMaxSkipDepth = 32;
+
 struct TReader {
   const uint8_t* p;
   const uint8_t* end;
@@ -256,19 +293,21 @@ struct TReader {
     if (uint64_t(end - p) < n) { ok = false; return; }
     p += n;
   }
-  void skip_value(int type);  // forward (recursive for containers/structs)
-  void skip_struct() {
+  void skip_value(int type, int depth);  // forward (recursive for containers)
+  void skip_struct(int depth) {
+    if (depth > kMaxSkipDepth) { ok = false; return; }
     while (ok) {
       const uint8_t head = byte();
       if (head == 0) return;  // STOP
       if ((head & 0x0F) == 0) { ok = false; return; }
       if ((head >> 4) == 0) (void)zigzag();  // long-form field id
-      skip_value(head & 0x0F);
+      skip_value(head & 0x0F, depth);
     }
   }
 };
 
-void TReader::skip_value(int type) {
+void TReader::skip_value(int type, int depth) {
+  if (depth > kMaxSkipDepth) { ok = false; return; }
   switch (type) {
     case 1: case 2: return;             // bool true/false: value in the nibble
     case 3: skip_bytes(1); return;      // byte (raw, not varint)
@@ -282,7 +321,7 @@ void TReader::skip_value(int type) {
       const int elem = head & 0x0F;
       for (uint64_t i = 0; i < n && ok; i++) {
         if (elem == 1 || elem == 2) skip_bytes(1);  // bool element: one byte
-        else skip_value(elem);
+        else skip_value(elem, depth + 1);
       }
       return;
     }
@@ -291,12 +330,12 @@ void TReader::skip_value(int type) {
       if (n == 0) return;
       const uint8_t kv = byte();
       for (uint64_t i = 0; i < n && ok; i++) {
-        skip_value(kv >> 4);
-        skip_value(kv & 0x0F);
+        skip_value(kv >> 4, depth + 1);
+        skip_value(kv & 0x0F, depth + 1);
       }
       return;
     }
-    case 12: skip_struct(); return;     // struct
+    case 12: skip_struct(depth + 1); return;  // struct
     default: ok = false; return;
   }
 }
@@ -344,10 +383,10 @@ bool parse_page_header(TReader& r, PageInfo* info) {
         if (iid == 1 && itype == 5) info->num_values = r.zigzag();
         else if (iid == 2 && itype == 5) info->encoding = int32_t(r.zigzag());
         else if (iid == 3 && itype == 5) info->def_level_encoding = int32_t(r.zigzag());
-        else r.skip_value(itype);
+        else r.skip_value(itype, 0);
       }
     } else {
-      r.skip_value(type);
+      r.skip_value(type, 0);
     }
   }
   info->header_len = uint64_t(r.p - start);
@@ -360,17 +399,22 @@ extern "C" {
 
 // Scan an in-memory Parquet column chunk of UNCOMPRESSED PLAIN v1 data
 // pages. out_offsets[i] = byte offset of page i's VALUES region within
-// `chunk`; out_counts[i] = its value count. `has_def_levels` != 0 means the
-// column is OPTIONAL (max_def_level == 1): each page then leads with a
-// 4-byte-length-prefixed RLE definition-levels block which is skipped — the
-// caller is responsible for proving the chunk has ZERO nulls (statistics),
-// since a null would make value count < num_values. Returns the page count,
-// or -1 on any parse error or unsupported feature (dictionary page, v2
-// page, compression, non-PLAIN encoding, non-RLE def levels) — the caller
-// then uses the Arrow path.
+// `chunk`; out_counts[i] = its value count; out_value_lens[i] = the byte
+// length of that values region (page end minus values start) — the PER-PAGE
+// bound the caller must check count*itemsize against before building a
+// zero-copy view (a wrong null_count statistic or a short page would
+// otherwise serve the next page's header bytes as tensor data).
+// `has_def_levels` != 0 means the column is OPTIONAL (max_def_level == 1):
+// each page then leads with a 4-byte-length-prefixed RLE definition-levels
+// block which is skipped — the caller is responsible for proving the chunk
+// has ZERO nulls (statistics), since a null would make value count <
+// num_values. Returns the page count, or -1 on any parse error or
+// unsupported feature (dictionary page, v2 page, compression, non-PLAIN
+// encoding, non-RLE def levels) — the caller then uses the Arrow path.
 long long pstpu_scan_plain_pages(const uint8_t* chunk, unsigned long long chunk_len,
                                  unsigned long long* out_offsets,
-                                 long long* out_counts, int max_pages,
+                                 long long* out_counts,
+                                 unsigned long long* out_value_lens, int max_pages,
                                  int has_def_levels) {
   uint64_t pos = 0;
   int n = 0;
@@ -416,12 +460,13 @@ long long pstpu_scan_plain_pages(const uint8_t* chunk, unsigned long long chunk_
     }
     out_offsets[n] = data_off;
     out_counts[n] = info.num_values;
+    out_value_lens[n] = page_end - data_off;
     n++;
     pos = page_end;
   }
   return n;
 }
 
-int pstpu_abi_version() { return 1; }
+int pstpu_abi_version() { return 2; }
 
 }  // extern "C"

@@ -199,7 +199,8 @@ class ShmRing(object):
         got = self._lib.pstpu_ring_read(self._handle, buf, n)
         if got < 0:
             return None  # raced/buffer mismatch: treat as empty, caller re-polls
-        return memoryview(buf)[:got]
+        # per-message ctypes buffer: always writable, owned by the view chain
+        return memoryview(buf)[:got]  # noqa: PT500 - fresh writable buffer per message
 
     def close(self):
         if self._handle:

@@ -26,6 +26,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from petastorm_tpu.jax.compat import shard_map
+
 _NEG_INF = -1e30
 
 
@@ -108,7 +110,7 @@ def make_sharded_ring_attention(mesh, seq_axis='seq', batch_axis=None, causal=Fa
     spec = P(batch_axis, None, seq_axis, None)
 
     @functools.partial(
-        jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+        shard_map, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     def _sharded(q, k, v):
         return ring_attention(q, k, v, seq_axis, causal=causal)
 

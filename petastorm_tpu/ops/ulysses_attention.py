@@ -38,6 +38,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from petastorm_tpu.jax.compat import shard_map
 from petastorm_tpu.ops.ring_attention import _NEG_INF, _block_update
 
 
@@ -122,7 +123,7 @@ def make_sharded_ulysses_attention(mesh, seq_axis='seq', batch_axis=None,
     spec = P(batch_axis, None, seq_axis, None)
 
     @functools.partial(
-        jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+        shard_map, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     def _sharded(q, k, v):
         return ulysses_attention(q, k, v, seq_axis, causal=causal, kv_chunk=kv_chunk)
 

@@ -39,6 +39,12 @@ class DelegatingHandler(pafs.FileSystemHandler):
         eq = self.__eq__(other)
         return eq if eq is NotImplemented else not eq
 
+    def __hash__(self):
+        # keep every handler (and the PyFileSystem wrapping it) hashable:
+        # __eq__ without __hash__ would set __hash__ = None (PT600). The
+        # delegate fs cannot participate — pyarrow FileSystems are unhashable
+        return hash(type(self))
+
     def get_type_name(self):
         return 'delegating+' + self.fs.type_name
 

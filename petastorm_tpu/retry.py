@@ -227,6 +227,13 @@ class RetryingHandler(DelegatingHandler):
             return self.fs == other.fs and self.policy == other.policy
         return NotImplemented
 
+    def __hash__(self):
+        # defining __eq__ alone sets __hash__ = None — the handler AND any
+        # pyarrow.fs.PyFileSystem wrapping it would become unhashable (PT600).
+        # self.fs stays out of the tuple: pyarrow FileSystems are themselves
+        # unhashable; same-policy handlers over different stores merely collide
+        return hash((type(self), self.policy))
+
     def _invoke(self, fn, *args, **kwargs):
         return self.policy.call(fn, *args, **kwargs)
 

@@ -121,7 +121,7 @@ class NumpyBlockSerializer(object):
         # tobytes() for both, b'' is free anyway
         if v.dtype.kind in 'Mm' or v.size == 0:
             return v.tobytes()
-        return memoryview(v).cast('B')
+        return memoryview(v).cast('B')  # noqa: PT500 - serialize-side source view, read only
 
     def serialize(self, obj):
         parts = self.serialize_parts(obj)
@@ -158,7 +158,7 @@ class NumpyBlockSerializer(object):
         """Write a :meth:`serialize_parts` result into ``target`` (e.g. an
         mmapped /dev/shm blob) — the single-copy channel for payloads already
         split once; bytes are identical to :meth:`serialize` output."""
-        buf = memoryview(target)
+        buf = memoryview(target)  # noqa: PT500 - target is a caller-provided writable buffer
         off = 0
         for p in parts:
             if isinstance(p, np.ndarray):
@@ -194,7 +194,13 @@ class NumpyBlockSerializer(object):
                     n = dt.itemsize
                     for dim in shp:
                         n *= dim
-                    col[i] = np.frombuffer(mv[off:off + n], dtype=dt).reshape(shp)
+                    cell = np.frombuffer(mv[off:off + n], dtype=dt).reshape(shp)
+                    # ragged cells must arrive WRITABLE regardless of transport:
+                    # over zmq the message is immutable bytes and the view is
+                    # read-only (in-place image ops / torch.from_numpy would
+                    # fail); the ring/blob channels hand out writable buffers,
+                    # where the view stays zero-copy
+                    col[i] = cell if cell.flags.writeable else cell.copy()
                     off += n
                 out[name] = col
         return out

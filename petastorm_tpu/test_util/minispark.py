@@ -39,6 +39,11 @@ class DataType(object):
     def __ne__(self, other):
         return not self.__eq__(other)
 
+    def __hash__(self):
+        # coarse but consistent with __eq__ (equal => same type => same hash);
+        # without it, __eq__ alone sets __hash__ = None (PT600)
+        return hash(type(self))
+
     def __repr__(self):
         return type(self).__name__ + '()'
 

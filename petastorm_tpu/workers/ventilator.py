@@ -167,11 +167,11 @@ class ConcurrentVentilator(VentilatorBase):
         if self._thread is not None:
             self._thread.join()
         self._replay_indices = None
-        self._iterations_remaining = self._requested_iterations
         self._completed = len(self._items_to_ventilate) == 0
         self._stop_requested = False
         self._thread = None
         with self._in_flight_cv:
+            self._iterations_remaining = self._requested_iterations
             self._in_flight = 0
             self._undelivered.clear()
             self._epoch_indices = []

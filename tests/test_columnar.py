@@ -544,7 +544,23 @@ def test_weighted_sampling_mixes_columnar_readers(synthetic_dataset):
 
 # -- property tests (hypothesis) ---------------------------------------------
 
-from hypothesis import given, settings, strategies as st  # noqa: E402
+try:
+    from hypothesis import given, settings, strategies as st  # noqa: E402
+except ImportError:  # only the property tests skip; the module must collect
+    class _HypothesisStub(object):
+        def __call__(self, *args, **kwargs):
+            return self
+
+        def __getattr__(self, name):
+            return self
+
+    st = _HypothesisStub()
+
+    def given(*_args, **_kwargs):
+        return pytest.mark.skip(reason='hypothesis not installed')
+
+    def settings(*_args, **_kwargs):
+        return lambda fn: fn
 
 
 @settings(max_examples=40, deadline=None)

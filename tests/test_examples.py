@@ -169,11 +169,15 @@ def test_hello_world_pyspark_read(hello_world_url):
     # process's sys.modules
     import subprocess
     import sys
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    # `python script.py` puts the SCRIPT's dir on sys.path, not the cwd: the
+    # repo root must ride PYTHONPATH for uninstalled (source-tree) runs
+    env['PYTHONPATH'] = root + os.pathsep + env.get('PYTHONPATH', '')
     out = subprocess.run(
         [sys.executable, 'examples/hello_world/petastorm_dataset/pyspark_hello_world.py',
          '--dataset-url', hello_world_url],
-        capture_output=True, text=True, timeout=300,
-        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        capture_output=True, text=True, timeout=300, cwd=root, env=env)
     assert out.returncode == 0, out.stderr[-800:]
     assert 'total rows: 10' in out.stdout
 

@@ -27,7 +27,8 @@ def test_console_scripts_declared_and_resolvable():
     proj = _pyproject()['project']
     scripts = proj['scripts']
     assert set(scripts) == {'pstpu-throughput', 'pstpu-copy-dataset',
-                            'pstpu-generate-metadata', 'pstpu-metadata-util'}
+                            'pstpu-generate-metadata', 'pstpu-metadata-util',
+                            'petastorm-tpu-lint'}
     import importlib
     for target in scripts.values():
         mod_name, func_name = target.split(':')

@@ -267,4 +267,59 @@ def test_scanner_rejects_garbage_chunk():
     junk = (ctypes.c_uint8 * 64)(*([0xFF] * 64))
     offs = (ctypes.c_ulonglong * 8)()
     counts = (ctypes.c_longlong * 8)()
-    assert lib.pstpu_scan_plain_pages(junk, 64, offs, counts, 8, 0) == -1
+    vlens = (ctypes.c_ulonglong * 8)()
+    assert lib.pstpu_scan_plain_pages(junk, 64, offs, counts, vlens, 8, 0) == -1
+
+
+def test_page_values_must_fit_page_region(tmp_path):
+    """A page's zero-copy view must be bounds-checked against the PAGE's
+    values region, not just the file: a value count inflated by a wrong
+    statistic or corrupt header would otherwise serve the NEXT page's header
+    bytes as tensor data (ADVICE r5 finding)."""
+    from petastorm_tpu.native import pagescan
+
+    _write_raw_store(tmp_path)
+    path = _parquet_path(tmp_path)
+    md = pq.read_metadata(path)
+    rg = md.row_group(0)
+    label_idx = [i for i in range(md.num_columns)
+                 if md.schema.column(i).path == 'label'][0]
+    col = rg.column(label_idx)
+    lib = native._load_library()
+    mm = np.memmap(path, dtype=np.uint8, mode='r')
+    pages = pagescan._scan_chunk(lib, mm, col)
+    assert pages
+    # the scanner-reported region length matches the real layout exactly
+    # (REQUIRED PLAIN int64: count * 8 bytes fills the page)
+    assert all(count * 8 == vlen for _off, count, vlen in pages)
+    good = pagescan._chunk_to_arrays(mm, col, pages, rg.num_rows, 0)
+    assert good is not None
+    # inflated count -> values overrun the page region -> Arrow fallback
+    over = [(off, count + 1, vlen) for off, count, vlen in pages]
+    assert pagescan._chunk_to_arrays(
+        mm, col, over, rg.num_rows + len(pages), 0) is None
+    # short values region on a REQUIRED column (require_exact) -> fallback
+    short = [(off, count - 1, vlen) for off, count, vlen in pages]
+    assert pagescan._chunk_to_arrays(
+        mm, col, short, rg.num_rows - len(pages), 0) is None
+    # a def-skipped OPTIONAL column may leave a region tail (require_exact off)
+    assert pagescan._chunk_to_arrays(
+        mm, col, short, rg.num_rows - len(pages), 0, require_exact=False) is not None
+
+
+def test_deeply_nested_page_header_fails_fast_not_stack_overflow():
+    """A corrupt/hostile thrift page header nesting structs thousands of
+    levels deep must hit the skipper's depth cap and return -1 (Arrow
+    fallback) — pre-fix, the unbounded recursion overflowed the C++ stack
+    and killed the process (ADVICE r5 finding)."""
+    lib = native._load_library()
+    import ctypes
+    # field id 6 / type struct opens the chain; each 0x1C byte nests one more
+    # struct field — 200k levels would need ~200k stack frames without the cap
+    payload = bytes([0x6C]) + b'\x1c' * 200000
+    buf = (ctypes.c_uint8 * len(payload)).from_buffer_copy(payload)
+    offs = (ctypes.c_ulonglong * 8)()
+    counts = (ctypes.c_longlong * 8)()
+    vlens = (ctypes.c_ulonglong * 8)()
+    assert lib.pstpu_scan_plain_pages(
+        buf, len(payload), offs, counts, vlens, 8, 0) == -1

@@ -412,3 +412,28 @@ def test_open_parquet_prebuffers_remote_reads(tmp_path, monkeypatch):
     open_parquet(path, filesystem=pafs_mod.LocalFileSystem())
     if seen_kwargs:  # native kernel absent -> pyarrow fallback took this path
         assert not seen_kwargs[-1].get('pre_buffer')
+
+
+def test_retrying_handler_is_hashable():
+    """RetryingHandler defines __eq__ (policy-aware filesystem dedup); without
+    a matching __hash__, Python sets __hash__ = None and the handler can never
+    live in a set/dict — the ADVICE r5 / PT600 known-positive. Equal handlers
+    must hash equal; distinct policies must not compare equal."""
+    import pyarrow.fs as pafs_mod
+
+    from petastorm_tpu.pafs_util import DelegatingHandler as Delegating
+    from petastorm_tpu.retry import RetryingHandler
+
+    a = RetryingHandler(pafs_mod.LocalFileSystem(), FAST)
+    b = RetryingHandler(pafs_mod.LocalFileSystem(), FAST)
+    assert a == b and hash(a) == hash(b)
+    assert len({a, b}) == 1
+    other = RetryingHandler(pafs_mod.LocalFileSystem(),
+                            RetryPolicy(max_attempts=9))
+    assert a != other
+    # the shared base handler stays hashable too (same defect class)
+    assert isinstance(hash(Delegating(pafs_mod.LocalFileSystem())), int)
+    # wrap_retrying still yields a working PyFileSystem (hashability of the
+    # PyFileSystem itself is a pyarrow property, not ours to grant)
+    fs = wrap_retrying(pafs_mod.LocalFileSystem(), FAST)
+    assert fs.get_file_info('/').type is not None

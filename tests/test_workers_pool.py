@@ -306,6 +306,29 @@ class TestNumpyBlockSerializer:
         out2 = s.deserialize(bytearray(s.serialize({'m': mixed, 'x': np.arange(2)})))
         np.testing.assert_array_equal(out2['m'][1], np.ones(2, np.int64))
 
+    def test_ragged_cells_writable_after_immutable_transport(self):
+        """Over zmq the message arrives as immutable bytes, so np.frombuffer
+        views over it are read-only; deserialize must hand out WRITABLE object
+        cells regardless of transport (in-place image ops, torch.from_numpy)
+        — the ADVICE r5 / PT500 known-positive. Writable transports (shm ring
+        / blob) must keep the zero-copy view."""
+        import numpy as np
+        from petastorm_tpu.serializers import NumpyBlockSerializer
+        s = NumpyBlockSerializer()
+        ragged = np.empty(2, dtype=object)
+        ragged[0] = np.arange(12, dtype=np.uint8).reshape(3, 4)
+        ragged[1] = np.arange(6, dtype=np.uint8).reshape(2, 3)
+        block = {'img': ragged, 'label': np.arange(2)}
+        out = s.deserialize(bytes(s.serialize(block)))  # zmq-style immutable
+        for i, cell in enumerate(out['img']):
+            assert cell.flags.writeable
+            cell += 1  # the consumer contract: in-place ops must not raise
+            np.testing.assert_array_equal(cell, ragged[i] + 1)
+        # writable message (ring/blob channel): cells stay zero-copy views
+        out2 = s.deserialize(bytearray(s.serialize(block)))
+        assert out2['img'][0].flags.writeable
+        assert out2['img'][0].base is not None
+
     def test_serialize_parts_matches_serialize_framing(self):
         """The gather-write channel's concatenated segments must be
         byte-identical to serialize() output (one deserializer serves both)."""
