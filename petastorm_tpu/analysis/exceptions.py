@@ -64,7 +64,8 @@ class ExceptionHygieneChecker(Checker):
     scope = ('*workers/*.py', '*native/*.py', '*jax/*.py',
              '*reader.py', '*row_worker.py', '*batch_worker.py', '*serializers.py',
              '*shuffling_buffer.py', '*columnar.py', '*rebatch.py',
-             '*cache.py', '*local_disk_cache.py', '*retry.py')
+             '*cache.py', '*local_disk_cache.py', '*retry.py',
+             '*chunkstore/*.py')
 
     def check(self, src):
         for node in ast.walk(src.tree):

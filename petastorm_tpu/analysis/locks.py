@@ -146,7 +146,8 @@ class LockDisciplineChecker(Checker):
     description = ('writes to lock-guarded shared state outside "with self._lock"; '
                    'lock-acquisition-order cycles (PT101)')
     scope = ('*workers/*.py', '*shuffling_buffer.py', '*cache.py', '*reader.py',
-             '*jax/*.py', '*native/*.py', '*local_disk_cache.py')
+             '*jax/*.py', '*native/*.py', '*local_disk_cache.py',
+             '*chunkstore/*.py')
 
     def check(self, src):
         for node in ast.walk(src.tree):

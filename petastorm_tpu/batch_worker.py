@@ -69,7 +69,8 @@ class ArrowBatchWorker(WorkerBase):
             if len(self._open_files) > 8:
                 _, old = self._open_files.popitem()
                 old.close()
-            self._open_files[path] = open_parquet(path, self._fs)
+            self._open_files[path] = open_parquet(
+                path, self._fs, chunk_cache=self.args.get('chunk_cache'))
         return self._open_files[path]
 
     def shutdown(self):

@@ -22,9 +22,10 @@ from petastorm_tpu.errors import PetastormTpuError
 class FilesystemResolver(object):
     """Resolves a dataset URL into a ``pyarrow.fs.FileSystem`` + in-filesystem path.
 
-    Supported schemes: ``file://``, ``hdfs://``, ``s3://``, ``gs://``/``gcs://``.
-    A picklable factory is exposed for worker processes
-    (reference fs_utils.py:174-180).
+    Supported schemes: ``file://``, ``hdfs://``, ``s3://``, ``gs://``/``gcs://``,
+    plus ``mock-remote://`` (local files treated as a remote store — tests and
+    benches of the remote paths). A picklable factory is exposed for worker
+    processes (reference fs_utils.py:174-180).
     """
 
     def __init__(self, dataset_url, retry_policy=None):
@@ -54,6 +55,16 @@ class FilesystemResolver(object):
         elif parsed.scheme == 's3':
             self._filesystem = _wrap_object_store(pafs.S3FileSystem(), retry_policy)
             self._path = parsed.netloc + parsed.path
+        elif parsed.scheme == 'mock-remote':
+            # test/bench-only scheme: the LOCAL filesystem behind the same
+            # retry wrapper the object stores get, so every remote-only code
+            # path (retrying streams, chunk store, pre_buffer reads) is
+            # exercised hermetically without a cloud credential
+            if parsed.netloc not in ('', 'localhost'):
+                raise PetastormTpuError(
+                    'mock-remote:// URL must not have a host: {}'.format(dataset_url))
+            self._filesystem = _wrap_object_store(pafs.LocalFileSystem(), retry_policy)
+            self._path = parsed.path
         elif parsed.scheme == 'hdfs':
             # HDFS elasticity is the HA namenode failover in hdfs/namenode.py,
             # the reference's model; no backoff wrapper on top
@@ -64,6 +75,18 @@ class FilesystemResolver(object):
     @property
     def url(self):
         return self._url
+
+    @property
+    def scheme(self):
+        return self._scheme
+
+    @property
+    def is_local(self):
+        """True when the dataset is plain local files (``file://``) — mmap-able
+        directly, so byte-mirroring caches (the chunk store) have nothing to
+        add. ``mock-remote://`` deliberately reports False: it exists to
+        exercise the remote paths."""
+        return self._scheme == 'file'
 
     def filesystem(self):
         return self._filesystem

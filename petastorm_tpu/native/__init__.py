@@ -232,14 +232,21 @@ class NativeParquetFile(object):
         self.close()
 
 
-def open_parquet(path, filesystem=None, use_threads=True, buffer_size=0):
+def open_parquet(path, filesystem=None, use_threads=True, buffer_size=0,
+                 chunk_cache=None):
     """Open ``path`` with the native kernel when possible (local file, kernel
     built), else fall back to ``pq.ParquetFile`` over the given filesystem.
 
     ``use_threads=True`` (Arrow-internal decode threads) measures faster under
     the worker pool even on constrained hosts: the decode offload overlaps
     Arrow C++ work with the workers' GIL-bound Python (codec loop, row
-    assembly), which a single-threaded read serializes."""
+    assembly), which a single-threaded read serializes.
+
+    ``chunk_cache`` (a ``ChunkCacheConfig``) routes REMOTE files through the
+    chunk store: qualifying column chunks are mirrored locally once and served
+    zero-copy by the page scanner — the path local files already ride. Ignored
+    for local filesystems and when the native kernel is unavailable (the scan
+    is what the mirror exists to feed)."""
     import pyarrow.fs as pafs
     import pyarrow.parquet as pq
 
@@ -250,6 +257,13 @@ def open_parquet(path, filesystem=None, use_threads=True, buffer_size=0):
                                      buffer_size=buffer_size)
         except IOError as e:
             logger.warning('native open failed for %s (%s); pyarrow fallback', path, e)
+    if not local and chunk_cache is not None and is_available():
+        from petastorm_tpu.chunkstore.reader import ChunkCachedParquetFile
+        try:
+            return ChunkCachedParquetFile(path, filesystem, chunk_cache)
+        except Exception as e:  # noqa: BLE001 - cache dir/remote stat trouble: plain remote path
+            logger.warning('chunk-cached open failed for %s (%s); plain remote read',
+                           path, e)
     if filesystem is None:
         return pq.ParquetFile(path)
     # remote stores (s3/gs/hdfs, incl. the retry-wrapped PyFileSystems) get

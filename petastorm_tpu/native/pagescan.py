@@ -111,6 +111,56 @@ def _column_qualifies(meta_col, max_def_level, max_rep_level):
     return 'def' if max_def_level == 1 else True
 
 
+#: public alias: the chunk store qualifies remote chunks with the exact same
+#: strict check the local path uses (chunkstore/reader.py)
+def column_qualifies(meta_col, max_def_level, max_rep_level):
+    return _column_qualifies(meta_col, max_def_level, max_rep_level)
+
+
+def scan_mirrored_chunk(lib, mm, meta_col, has_def_levels=False):
+    """Page plan ``[(offset_in_mirror, num_values, values_region_len)]`` for a
+    byte-exact LOCAL MIRROR of a column chunk (the chunk bytes alone, at
+    offset 0), or ``None``. The mirror must be exactly
+    ``total_compressed_size`` bytes — a truncated or over-long mirror means
+    the cache entry does not match the footer metadata, so it is unusable.
+
+    The plan depends only on the mirror's bytes, which are content-addressed
+    and immutable in the chunk store — callers cache it per chunk key and
+    skip the re-scan on every warm read."""
+    length = int(mm.size)
+    if length <= 0 or length != meta_col.total_compressed_size:
+        return None
+    offs, counts, vlens = _scratch_arrays()
+    n = lib.pstpu_scan_plain_pages(
+        mm.ctypes.data_as(ctypes.c_void_p), length, offs, counts, vlens,
+        _MAX_PAGES, 1 if has_def_levels else 0)
+    if n < 0:
+        return None
+    return [(offs[i], counts[i], vlens[i]) for i in range(n)]
+
+
+def read_mirrored_chunk(lib, mm, meta_col, expected_rows, flba_width,
+                        has_def_levels=False, require_exact=True, pages=None):
+    """Arrow arrays (one per page) for a column chunk served from a byte-exact
+    LOCAL MIRROR ``mm`` — the chunk bytes alone, at offset 0 — rather than the
+    whole mmapped file. This is how a REMOTE chunk, cached once by the chunk
+    store (``petastorm_tpu.chunkstore``), rides the identical zero-copy path
+    as a local file: same page scan, same per-page bounds checks
+    (``_chunk_to_arrays``), same Arrow-path fallback on any mismatch.
+
+    ``pages`` is an optional precomputed :func:`scan_mirrored_chunk` plan
+    (valid for any mirror of the same content-addressed chunk); omitted, the
+    mirror is scanned here. Returns ``None`` when the chunk cannot be served.
+    """
+    if pages is None:
+        pages = scan_mirrored_chunk(lib, mm, meta_col,
+                                    has_def_levels=has_def_levels)
+    if pages is None:
+        return None
+    return _chunk_to_arrays(mm, meta_col, pages, expected_rows, flba_width,
+                            require_exact=require_exact)
+
+
 def _scan_chunk(lib, mm, meta_col, has_def_levels=False):
     """[(values_offset_in_file, num_values, values_region_len)] for one column
     chunk, or None. The region length is the scanner-verified byte span from

@@ -111,7 +111,8 @@ def make_reader(dataset_url,
                 ngram=None,
                 output='rows', batch_size=None, drop_last=False,
                 resume_state=None,
-                storage_retry_policy=None):
+                storage_retry_policy=None,
+                chunk_cache=None, chunk_cache_size_limit=None):
     """Reader for datasets written by :func:`materialize_dataset` — rows decoded
     through the stored Unischema's codecs (reference reader.py:50-174).
 
@@ -132,6 +133,17 @@ def make_reader(dataset_url,
     :param storage_retry_policy: :class:`petastorm_tpu.retry.RetryPolicy` for
         transient object-store (s3/gs) IO errors; ``None`` = sensible defaults,
         ``False`` = disable retry wrapping. Carried into worker processes.
+    :param chunk_cache: REMOTE stores only — ``'auto'`` (per-dataset dir under
+        the system temp dir), a cache directory path, or a
+        :class:`petastorm_tpu.chunkstore.ChunkCacheConfig`. Mirrors qualifying
+        raw column chunks to local disk so the zero-copy page scanner serves
+        them exactly as it does local files; epoch 2+ reads at local speed.
+        Counters surface as ``chunk_cache_*`` keys in :attr:`Reader.diagnostics`.
+        Ignored (with no effect) for local ``file://`` datasets. ``None``
+        disables. See ``docs/cache.md``.
+    :param chunk_cache_size_limit: on-disk byte bound of the chunk cache
+        (default 10 GiB); LRU eviction keeps usage under it without ever
+        invalidating chunks a live batch still references.
     :param output: 'rows' (default) yields one schema namedtuple per row —
         reference ``make_reader`` parity; 'columnar' yields one namedtuple of
         decoded column arrays per row group (``batched_output=True``) — the TPU
@@ -187,7 +199,9 @@ def make_reader(dataset_url,
                   cache=cache, transform_spec=transform_spec, ngram=ngram,
                   columnar_ngram=columnar_ngram,
                   resume_state=resume_state,
-                  storage_retry_policy=storage_retry_policy)
+                  storage_retry_policy=storage_retry_policy,
+                  chunk_cache=chunk_cache,
+                  chunk_cache_size_limit=chunk_cache_size_limit)
 
 
 def make_batch_reader(dataset_url,
@@ -203,7 +217,8 @@ def make_batch_reader(dataset_url,
                       transform_spec=None,
                       batch_size=None, drop_last=False,
                       resume_state=None,
-                      storage_retry_policy=None):
+                      storage_retry_policy=None,
+                      chunk_cache=None, chunk_cache_size_limit=None):
     """Columnar reader for ANY Parquet store (reference reader.py:177-289):
     yields one namedtuple of numpy column arrays per row group
     (``batched_output=True``). Schema is inferred from the Arrow schema unless
@@ -214,6 +229,9 @@ def make_batch_reader(dataset_url,
     caches warm (the reference built this re-chunking but never wired it in:
     pyarrow_helpers/batching_table_queue.py:20-79, SURVEY.md §2.6). The final
     short batch is emitted unless ``drop_last``.
+
+    ``chunk_cache``/``chunk_cache_size_limit``: local chunk mirror for remote
+    stores — identical semantics to :func:`make_reader`.
     """
     schema = dataset_metadata.infer_or_load_unischema(dataset_url,
                                                       retry_policy=storage_retry_policy)
@@ -231,7 +249,9 @@ def make_batch_reader(dataset_url,
                   num_epochs=num_epochs, cur_shard=cur_shard, shard_count=shard_count,
                   cache=cache, transform_spec=transform_spec, ngram=None,
                   resume_state=resume_state,
-                  storage_retry_policy=storage_retry_policy)
+                  storage_retry_policy=storage_retry_policy,
+                  chunk_cache=chunk_cache,
+                  chunk_cache_size_limit=chunk_cache_size_limit)
 
 
 class Reader(object):
@@ -243,7 +263,7 @@ class Reader(object):
                  shuffle_row_drop_partitions=1, predicate=None, rowgroup_selector=None,
                  num_epochs=1, cur_shard=None, shard_count=None, cache=None,
                  transform_spec=None, ngram=None, columnar_ngram=False, resume_state=None,
-                 storage_retry_policy=None):
+                 storage_retry_policy=None, chunk_cache=None, chunk_cache_size_limit=None):
         if (cur_shard is None) != (shard_count is None):
             raise ValueError('cur_shard and shard_count must be specified together')
         if cur_shard is not None and not 0 <= cur_shard < shard_count:
@@ -256,6 +276,10 @@ class Reader(object):
         self.schema = schema  # full stored/inferred schema
         resolver = FilesystemResolver(dataset_url, retry_policy=storage_retry_policy)
         self._dataset_path = resolver.get_dataset_path()
+        from petastorm_tpu.chunkstore import resolve_chunk_cache
+        self._chunk_cache_config = resolve_chunk_cache(
+            chunk_cache, dataset_url, resolver.is_local,
+            size_limit_bytes=chunk_cache_size_limit)
 
         # (2-3) schema view + ngram resolution + transform schema
         if ngram is not None:
@@ -323,8 +347,19 @@ class Reader(object):
             'ngram': ngram,
             'columnar_ngram': columnar_ngram,
             'cache': cache or NullCache(),
+            'chunk_cache': self._chunk_cache_config,
         }
         self._pool = pool
+        # async chunk prefetcher: walks the ventilator's exact upcoming order
+        # and mirrors remote chunks before workers demand them
+        self._chunk_prefetcher = None
+        if self._chunk_cache_config is not None:
+            from petastorm_tpu.chunkstore.prefetch import ChunkPrefetcher
+            prefetch_cols = [n for n in output_schema.fields]
+            self._chunk_prefetcher = ChunkPrefetcher(
+                self._ventilator, pieces, prefetch_cols,
+                resolver.filesystem_factory(), self._chunk_cache_config)
+            self._chunk_prefetcher.start()
         self._results_queue_reader = results_queue_reader_factory(self.transformed_schema)
         # checkpoint wiring (before pool.start — items may flow immediately):
         # the results-queue reader marks items delivered as their last row is
@@ -443,15 +478,23 @@ class Reader(object):
         self.last_row_consumed = False
 
     def stop(self):
+        if self._chunk_prefetcher is not None:
+            self._chunk_prefetcher.stop()
         self._pool.stop()
         self._stopped = True
 
     def join(self):
+        if self._chunk_prefetcher is not None:
+            self._chunk_prefetcher.join()
         self._pool.join()
 
     @property
     def diagnostics(self):
-        return self._pool.diagnostics
+        diag = dict(self._pool.diagnostics)
+        if self._chunk_cache_config is not None:
+            from petastorm_tpu.chunkstore import cache_diagnostics
+            diag.update(cache_diagnostics(self._chunk_cache_config))
+        return diag
 
     def __enter__(self):
         return self

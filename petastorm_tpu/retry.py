@@ -54,6 +54,9 @@ _TRANSIENT_MARKERS = (
     'service unavailable', 'internal server error',
     'bad gateway', 'gateway timeout', 'eof occurred',
     'curl error', 'throttl',
+    # a ranged GET whose body came back truncated (fetch_range raises this
+    # text): the transfer broke mid-flight — retry on a fresh stream
+    'short read',
 )
 
 #: retryable HTTP status codes, matched only in status context — a bare
@@ -271,3 +274,33 @@ def wrap_retrying(fs, policy=None):
     """Wrap a pyarrow filesystem so transient IO errors are retried with
     bounded exponential backoff. Returns a genuine ``pyarrow.fs.PyFileSystem``."""
     return pafs.PyFileSystem(RetryingHandler(fs, policy))
+
+
+def fetch_range(fs, path, offset, length, policy=None):
+    """Read exactly ``[offset, offset + length)`` of ``path`` as ONE retried
+    unit: each attempt opens a FRESH stream (a positional read that failed
+    leaves an object-store stream in an unknown state), reads the range, and
+    closes it. A short body raises and is classified transient, so a truncated
+    transfer retries instead of caching garbage.
+
+    This is the chunk store's fetch primitive. ``fs`` may be raw or already
+    retry-wrapped — in the wrapped case the inner ops retry individually too,
+    which only tightens the elasticity."""
+    policy = policy or RetryPolicy()
+
+    def _attempt():
+        f = fs.open_input_file(path)
+        try:
+            if hasattr(f, 'read_at'):
+                data = f.read_at(length, offset)
+            else:
+                f.seek(offset)
+                data = f.read(length)
+        finally:
+            f.close()
+        if len(data) != length:
+            raise IOError('short read: got {} of {} bytes at offset {} from {}'.format(
+                len(data), length, offset, path))
+        return bytes(data)
+
+    return policy.call(_attempt)

@@ -85,7 +85,8 @@ class RowGroupDecoderWorker(WorkerBase):
             if len(self._open_files) > 8:  # bound per-worker open handles
                 _, old = self._open_files.popitem()
                 old.close()
-            self._open_files[path] = open_parquet(path, self._fs)
+            self._open_files[path] = open_parquet(
+                path, self._fs, chunk_cache=self.args.get('chunk_cache'))
         return self._open_files[path]
 
     def shutdown(self):

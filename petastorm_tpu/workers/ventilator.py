@@ -154,6 +154,18 @@ class ConcurrentVentilator(VentilatorBase):
                 'rng_state': self._rng.bit_generator.state,
             }
 
+    def upcoming_items(self, max_items):
+        """Read-only peek at the next (up to ``max_items``) work items this
+        ventilator will emit — the unventilated head of the current epoch, in
+        its exact post-shuffle order. Used by the chunk prefetcher
+        (``petastorm_tpu.chunkstore.prefetch``) to fetch remote column chunks
+        ahead of the workers. Items already ventilated (possibly still being
+        processed) are not included; between epochs the list is empty until
+        the feeding thread lays out the next epoch's order."""
+        with self._in_flight_cv:
+            indices = self._epoch_indices[self._epoch_pos:self._epoch_pos + max_items]
+            return [self._items_to_ventilate[i] for i in indices]
+
     def completed(self):
         """True when no more items will ever be ventilated."""
         return self._completed

@@ -88,11 +88,12 @@ def test_main_emits_headline_line(monkeypatch, capsys):
 
     import petastorm_tpu.tools.throughput as tp
 
+    monkeypatch.setattr(bench, '_probe_tpu', lambda *a, **k: ('none', 0))
     monkeypatch.setattr(bench, '_prebuild_native', lambda: None)
     monkeypatch.setattr(bench, '_ensure_dataset', lambda url: None)
     monkeypatch.setattr(bench, '_warm', lambda url: None)
     monkeypatch.setattr(bench, '_duty_section',
-                        lambda: {'skipped': True, 'reason': 'stubbed'})
+                        lambda **kw: {'skipped': True, 'reason': 'stubbed'})
     monkeypatch.setattr(bench, '_spin_ms', lambda: 250.0)
     monkeypatch.setattr(tp, 'reader_throughput',
                         lambda *a, **k: types.SimpleNamespace(samples_per_second=5000.0))
@@ -101,6 +102,8 @@ def test_main_emits_headline_line(monkeypatch, capsys):
     rec = json.loads(lines[-1])
     assert rec['metric'] == 'hello_world_reader_throughput'
     assert rec['value'] == 5000.0
+    # identical runs on an identical-speed host: normalized == raw
+    assert rec['value_spin_normalized'] == 5000.0
     assert len(rec['runs']) == 7 and len(rec['cpu_shares']) == 7
     assert len(rec['spin_ms']) == 7 and rec['host_speed_spread'] == 0.0
     assert rec['spread'] == 0.0 and rec['excluded_mad_outliers'] == []
@@ -152,3 +155,110 @@ def test_select_runs_contended_capture_reports_all():
     assert excluded == [] and mad_excluded == []
     assert value == pytest.approx(5000.0)
     assert spread == spread_all
+
+
+# ---------------------------------------------------------------------------
+# Spin-normalized headline (the CPU-wander remedy)
+# ---------------------------------------------------------------------------
+
+def test_spin_normalization_cancels_host_speed_wander():
+    """A run that is 20% slow ONLY because the host was 20% slow (spin probe
+    20% higher) normalizes back to the cluster: rate × spin / median(spin)."""
+    rates = [5000.0, 5000.0, 5000.0 / 1.2, 5000.0, 5000.0]
+    spins = [250.0, 250.0, 250.0 * 1.2, 250.0, 250.0]
+    norm = bench._spin_normalized(rates, spins)
+    assert norm == pytest.approx(5000.0)
+    # raw median is also 5000 here, but the slow run's NORMALIZED value is
+    # exactly restored — verify the per-run formula directly
+    per_run = [r * s / 250.0 for r, s in zip(rates, spins)]
+    assert per_run[2] == pytest.approx(5000.0)
+
+
+def test_spin_normalization_uniform_host_is_identity():
+    rates = [4000.0, 4100.0, 4200.0]
+    spins = [300.0, 300.0, 300.0]
+    assert bench._spin_normalized(rates, spins) == pytest.approx(4100.0)
+
+
+def test_spin_normalization_degenerate_inputs():
+    assert bench._spin_normalized([], []) is None
+    assert bench._spin_normalized([1.0], [1.0, 2.0]) is None
+    # zero spins (clock glitch): fall back to the raw median, not a crash
+    assert bench._spin_normalized([10.0, 20.0, 30.0], [0.0, 0.0, 0.0]) == 20.0
+
+
+# ---------------------------------------------------------------------------
+# Persistent on-chip ledger (BENCH_ONCHIP.json)
+# ---------------------------------------------------------------------------
+
+def _use_tmp_ledger(monkeypatch, tmp_path):
+    path = str(tmp_path / 'BENCH_ONCHIP.json')
+    monkeypatch.setattr(bench, 'ONCHIP_PATH', path)
+    return path
+
+
+def test_onchip_record_and_latest_roundtrip(monkeypatch, tmp_path):
+    _use_tmp_ledger(monkeypatch, tmp_path)
+    assert bench._latest_onchip() is None
+    bench._record_onchip({'model': 'resnet152', 'step_ms': 210.0,
+                          'input_stall_fraction': 0.031, 'duty_cycle': 0.969,
+                          'examples_per_sec': 301.0, 'device': 'tpu'})
+    last = bench._latest_onchip()
+    assert last['model'] == 'resnet152'
+    assert last['recorded_utc'].endswith('Z')
+    assert last['age_days'] is not None and last['age_days'] < 1.0
+
+
+def test_onchip_ledger_bounded_and_ordered(monkeypatch, tmp_path):
+    _use_tmp_ledger(monkeypatch, tmp_path)
+    for i in range(25):
+        bench._record_onchip({'model': 'm{}'.format(i), 'examples_per_sec': float(i)})
+    doc = bench._load_onchip()
+    assert len(doc['entries']) == 20  # bounded history
+    assert bench._latest_onchip()['model'] == 'm24'  # newest last
+
+
+def test_onchip_corrupt_ledger_recovers(monkeypatch, tmp_path):
+    path = _use_tmp_ledger(monkeypatch, tmp_path)
+    with open(path, 'w') as f:
+        f.write('not json{')
+    assert bench._load_onchip() == {'entries': []}
+    bench._record_onchip({'model': 'm'})
+    assert bench._latest_onchip()['model'] == 'm'
+
+
+def test_duty_skip_line_embeds_age_stamped_onchip(monkeypatch, tmp_path, capsys):
+    """A TPU-less capture must still carry the newest committed on-chip
+    number, age-stamped, in its skip line."""
+    _use_tmp_ledger(monkeypatch, tmp_path)
+    bench._record_onchip({'model': 'resnet101', 'input_stall_fraction': 0.042,
+                          'examples_per_sec': 412.5, 'device': 'tpu'})
+    monkeypatch.setattr(bench, '_probe_tpu', lambda *a, **k: ('cpu', 1))
+    duty = bench._duty_section()
+    out = [json.loads(ln) for ln in capsys.readouterr().out.strip().splitlines()]
+    skip = [r for r in out if r.get('metric') == 'duty_sweep_skipped'][0]
+    assert skip['last_onchip']['model'] == 'resnet101'
+    assert skip['last_onchip']['age_days'] is not None
+    assert duty['skipped'] is True
+    assert duty['last_onchip']['examples_per_sec'] == 412.5
+
+
+def test_duty_section_sweeps_when_tpu_seen_early(monkeypatch, tmp_path, capsys):
+    """A TPU seen by the START-of-capture probe must trigger the sweep even
+    if the end-of-capture probe misses (opportunistic probing), and a
+    successful sweep must persist to the ledger."""
+    _use_tmp_ledger(monkeypatch, tmp_path)
+    monkeypatch.setattr(bench, '_probe_tpu', lambda *a, **k: ('none', 0))
+    point = {'metric': 'duty_sweep', 'model': 'resnet50', 'step_ms': 80.0,
+             'input_stall_fraction': 0.02, 'duty_cycle': 0.98,
+             'examples_per_sec': 800.0}
+    monkeypatch.setattr(bench, '_stream_duty_sweep',
+                        lambda *a, **k: ([point], None))
+    duty = bench._duty_section(tpu_seen_early=True)
+    assert duty['model'] == 'resnet50' and duty['meets_bar'] is True
+    last = bench._latest_onchip()
+    assert last['model'] == 'resnet50' and last['age_days'] is not None
+    # and WITHOUT the early sighting, the same probes skip
+    duty2 = bench._duty_section(tpu_seen_early=False)
+    assert duty2['skipped'] is True
+    assert duty2['last_onchip']['model'] == 'resnet50'

@@ -1,8 +1,14 @@
 """CLI tools tests (modeled on reference tests/test_copy_dataset.py,
 tests/test_generate_metadata.py, benchmark smoke)."""
 
+import json
+import os
+import sys
+
 import numpy as np
 import pytest
+
+sys.path.insert(0, __file__.rsplit('/tests/', 1)[0])  # repo-root bench modules
 
 from petastorm_tpu import make_reader
 from petastorm_tpu.etl.dataset_metadata import get_schema, load_row_groups
@@ -197,6 +203,47 @@ def test_dataset_as_rdd_rejects_non_spark_session(synthetic_dataset):
     from petastorm_tpu.spark_utils import dataset_as_rdd
     with pytest.raises(TypeError, match='SparkSession'):
         dataset_as_rdd(synthetic_dataset.url, object())
+
+
+def _scaling_records(capsys):
+    return [json.loads(ln) for ln in capsys.readouterr().out.strip().splitlines()
+            if ln.startswith('{')]
+
+
+def test_bench_scaling_smoke(tmp_path, capsys):
+    """2-point smoke of the measurement path (1 worker, tiny raw store): the
+    scaling curve script must run end to end and report a positive rate —
+    this was 0-coverage code (VERDICT r5 Next #8)."""
+    import bench_scaling
+    bench_scaling.main(['--workers', '1', '--pools', 'thread', '--store', 'raw',
+                        '--rows', '64', '--measure-rows', '64',
+                        '--warmup-rows', '32', '--reps', '1',
+                        '--keep-dir', str(tmp_path)])
+    recs = _scaling_records(capsys)
+    assert len(recs) == 1
+    rec = recs[0]
+    assert rec['metric'] == 'scaling' and rec['store'] == 'raw'
+    assert rec['workers'] == 1 and rec['pool'] == 'thread'
+    assert rec['remote_mock'] is False
+    assert rec['samples_per_sec'] > 0
+
+
+def test_bench_scaling_remote_mock_exercises_chunk_store(tmp_path, capsys):
+    """--store raw --remote-mock measures the chunk-cached remote path: the
+    run must complete with a positive warm-cache rate AND have actually
+    populated the chunk store (mirrored chunk files on disk)."""
+    import bench_scaling
+    bench_scaling.main(['--workers', '1', '--pools', 'thread', '--store', 'raw',
+                        '--rows', '64', '--measure-rows', '64',
+                        '--warmup-rows', '32', '--reps', '1', '--remote-mock',
+                        '--keep-dir', str(tmp_path)])
+    recs = _scaling_records(capsys)
+    assert len(recs) == 1 and recs[0]['remote_mock'] is True
+    assert recs[0]['samples_per_sec'] > 0
+    cache_dir = tmp_path / 'chunk_cache'
+    chunks = [f for _root, _dirs, files in os.walk(cache_dir) for f in files
+              if f.endswith('.chunk')]
+    assert chunks, 'the remote-mock run must mirror chunks into the store'
 
 
 def test_throughput_fresh_process_respawn(synthetic_dataset):
