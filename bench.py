@@ -388,7 +388,26 @@ def _select_runs(runs):
     return value, spread, spread_all, excluded, mad_excluded
 
 
-def main():
+def main(argv=None):
+    import argparse
+    parser = argparse.ArgumentParser(description='headline benchmark capture')
+    parser.add_argument('--telemetry', choices=('off', 'counters', 'spans'),
+                        default=None,
+                        help='pipeline telemetry level for the measured runs '
+                             '(default: the process default, counters)')
+    parser.add_argument('--trace-out', default=None,
+                        help='write a Perfetto-loadable Chrome trace of the capture '
+                             'here (implies --telemetry spans)')
+    # parse_known_args: the capture entry point is also invoked as a plain
+    # function from tests (bench.main()) where sys.argv belongs to pytest
+    args, _unknown = parser.parse_known_args(argv)
+    telemetry = args.telemetry
+    if args.trace_out and telemetry in (None, 'off', 'counters'):
+        telemetry = 'spans'
+    if telemetry is not None:
+        from petastorm_tpu import observability as obs
+        obs.configure(telemetry)
+
     url = 'file://' + CACHE_DIR
     # opportunistic probe AT CAPTURE START: a TPU reachable now but gone by
     # the end of the ~10-minute CPU capture still gets its duty sweep
@@ -435,6 +454,12 @@ def main():
     value_norm = _spin_normalized([r for r, _ in runs], spins)
 
     duty = _duty_section(tpu_seen_early=tpu_seen_early)
+
+    if args.trace_out:
+        from petastorm_tpu import observability as obs
+        n_events = obs.export_chrome_trace(args.trace_out)
+        print(json.dumps({'metric': 'trace_exported', 'path': args.trace_out,
+                          'events': n_events}), flush=True)
 
     print(json.dumps({
         'metric': 'hello_world_reader_throughput',

@@ -49,11 +49,12 @@ def build_store(url, rows, store='png', image_size=160, num_classes=1000):
 
 
 def measure(url, pool, workers, measure_rows=2000, warmup_rows=200,
-            chunk_cache=None):
+            chunk_cache=None, telemetry=None):
     from petastorm_tpu import make_reader
     with make_reader(url, reader_pool_type=pool, workers_count=workers,
                      output='columnar', shuffle_row_groups=True, seed=0,
-                     num_epochs=None, chunk_cache=chunk_cache) as reader:
+                     num_epochs=None, chunk_cache=chunk_cache,
+                     telemetry=telemetry) as reader:
         it = iter(reader)
         seen = 0
         while seen < warmup_rows:
@@ -83,7 +84,15 @@ def main(argv=None):
                              'warmup pass fills the cache, so the measured '
                              'region is the epoch-2+ (warm-cache) rate')
     parser.add_argument('--keep-dir', default=None)
+    parser.add_argument('--telemetry', choices=('off', 'counters', 'spans'), default=None,
+                        help='pipeline telemetry level (default: counters; '
+                             '--trace-out implies spans)')
+    parser.add_argument('--trace-out', default=None,
+                        help='write a Perfetto-loadable Chrome trace of the sweep here')
     args = parser.parse_args(argv)
+    telemetry = args.telemetry
+    if args.trace_out and telemetry in (None, 'off', 'counters'):
+        telemetry = 'spans'
 
     tmpdir = args.keep_dir or tempfile.mkdtemp(prefix='bench_scaling_')
     # stamp the kept store with its flavor+layout+row count so changed args or
@@ -106,7 +115,8 @@ def main(argv=None):
     for pool in args.pools.split(','):
         for w in (int(x) for x in args.workers.split(',')):
             runs = [measure(url, pool.strip(), w, measure_rows=args.measure_rows,
-                            warmup_rows=args.warmup_rows, chunk_cache=chunk_cache)
+                            warmup_rows=args.warmup_rows, chunk_cache=chunk_cache,
+                            telemetry=telemetry)
                     for _ in range(args.reps)]
             print(json.dumps({'metric': 'scaling', 'pool': pool.strip(), 'workers': w,
                               'store': args.store,
@@ -114,6 +124,12 @@ def main(argv=None):
                               'samples_per_sec': round(statistics.median(runs), 1),
                               'runs': [round(r, 1) for r in runs],
                               'host_cores': os.cpu_count()}), flush=True)
+
+    if args.trace_out:
+        from petastorm_tpu import observability as obs
+        n_events = obs.export_chrome_trace(args.trace_out)
+        print(json.dumps({'metric': 'trace_exported', 'path': args.trace_out,
+                          'events': n_events}), flush=True)
 
 
 if __name__ == '__main__':

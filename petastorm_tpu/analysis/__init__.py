@@ -25,6 +25,9 @@ Rule families (see ``docs/analysis.md`` for bad/good examples):
   zero-copy page views built without a per-page bound check; unbounded
   recursion in the native C++ sources.
 * **PT600** hashability — ``__eq__`` without ``__hash__``.
+* **PT700** telemetry span hygiene — spans/stage timers opened in
+  instrumented code must close on all paths (``with`` or try/finally), or
+  the trace loses stages and stall attribution under-counts them.
 
 Suppress a single finding with ``# noqa: PT###`` (reason encouraged) on its
 line; absorb pre-existing findings with an ``analysis_baseline.json`` (see
@@ -42,6 +45,7 @@ from petastorm_tpu.analysis.hashability import HashabilityChecker
 from petastorm_tpu.analysis.jax_purity import JaxPurityChecker
 from petastorm_tpu.analysis.lifecycle import ResourceLifecycleChecker
 from petastorm_tpu.analysis.locks import LockDisciplineChecker
+from petastorm_tpu.analysis.telemetry import TelemetrySpanChecker
 
 #: the full first-party rule set, in rule-id order
 ALL_CHECKERS = (
@@ -51,6 +55,7 @@ ALL_CHECKERS = (
     JaxPurityChecker,
     NativeBufferChecker,
     HashabilityChecker,
+    TelemetrySpanChecker,
 )
 
 
@@ -75,5 +80,6 @@ __all__ = [
     'ALL_CHECKERS', 'Baseline', 'Checker', 'ExceptionHygieneChecker', 'Finding',
     'HashabilityChecker', 'JaxPurityChecker', 'LockDisciplineChecker',
     'NativeBufferChecker', 'ResourceLifecycleChecker', 'SourceFile',
-    'collect_sources', 'load_baseline', 'run_analysis', 'run_checkers',
+    'TelemetrySpanChecker', 'collect_sources', 'load_baseline', 'run_analysis',
+    'run_checkers',
 ]

@@ -17,6 +17,7 @@ from __future__ import annotations
 import numpy as np
 import pyarrow as pa
 
+from petastorm_tpu import observability as obs
 from petastorm_tpu.columnar import BlockResultsReaderBase
 from petastorm_tpu.row_worker import _cache_key, select_row_drop_indices
 from petastorm_tpu.native import open_parquet
@@ -111,10 +112,12 @@ class ArrowBatchWorker(WorkerBase):
         transform = args['transform_spec']
         if transform is not None:
             if transform.func is not None:
-                batch = transform.func(batch)
+                with obs.stage('transform', cat='worker'):
+                    batch = transform.func(batch)
             final_fields = set(args['transformed_schema'].fields)
             batch = {k: v for k, v in batch.items() if k in final_fields}
 
+        obs.count('worker_rows_decoded_total', len(next(iter(batch.values()))) if batch else 0)
         self.publish(batch)
 
     def _load_batch(self, piece, column_names, shuffle_row_drop_partition):
@@ -122,11 +125,14 @@ class ArrowBatchWorker(WorkerBase):
         physical = [c for c in column_names
                     if c not in piece.partition_keys and c in schema.fields]
         pf = self._parquet_file(piece.path)
-        table = pf.read_row_group(piece.row_group, columns=physical)
-        if shuffle_row_drop_partition is not None:
-            indices = select_row_drop_indices(table.num_rows, shuffle_row_drop_partition)
-            table = table.take(indices)
-        batch = {name: _column_to_numpy(table.column(name), name) for name in physical}
+        with obs.stage('read', cat='worker', piece=piece.path,
+                       row_group=piece.row_group):
+            table = pf.read_row_group(piece.row_group, columns=physical)
+            if shuffle_row_drop_partition is not None:
+                indices = select_row_drop_indices(table.num_rows, shuffle_row_drop_partition)
+                table = table.take(indices)
+        with obs.stage('decode', cat='worker', rows=table.num_rows):
+            batch = {name: _column_to_numpy(table.column(name), name) for name in physical}
         for key, value in piece.partition_keys.items():
             if key in column_names:
                 batch[key] = np.full(table.num_rows, value)

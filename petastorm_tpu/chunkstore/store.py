@@ -56,6 +56,8 @@ from collections import OrderedDict
 
 import numpy as np
 
+from petastorm_tpu import observability as obs
+
 logger = logging.getLogger(__name__)
 
 DEFAULT_SIZE_LIMIT = 10 * 2 ** 30  # 10 GiB, matching LocalDiskCache
@@ -277,8 +279,14 @@ class ChunkStore(object):
             if not for_prefetch:
                 self._maybe_bump(digest, path)
                 self._count({'hits': 1})
+                obs.instant('chunk_hit', cat='chunkstore', bytes=length)
             return path, st.st_mtime_ns, False
-        data = fetch_fn()
+        # separate stage names: demand fetches happen INSIDE the worker read
+        # stage (the stall report subtracts them from read IO), prefetches on
+        # the prefetcher's own thread (they must not skew that subtraction)
+        with obs.stage('chunk_prefetch' if for_prefetch else 'chunk_fetch',
+                       cat='chunkstore', bytes=length):
+            data = fetch_fn()
         if len(data) != length:
             raise IOError('chunk fetch for {!r} returned {} bytes, expected {}'.format(
                 key, len(data), length))

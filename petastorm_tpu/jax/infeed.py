@@ -14,6 +14,7 @@ from collections import deque
 
 import numpy as np
 
+from petastorm_tpu import observability as obs
 
 #: numpy dtype kinds that can live on device; everything else (strings, objects,
 #: datetimes) stays host-side numpy
@@ -39,7 +40,11 @@ def stage_batch(batch, target):
             return jax.device_put(x, target)
         return x
 
-    return put(batch)
+    # per-batch stage timer: device_put is async, so this measures the HOST
+    # cost of staging (buffer donation + transfer enqueue), the part that can
+    # stall the input pipeline
+    with obs.stage('infeed', cat='infeed'):
+        return put(batch)
 
 
 def prefetch_to_device(iterator, target=None, size=2, background=True):

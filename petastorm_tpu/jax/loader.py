@@ -17,10 +17,12 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from decimal import Decimal
 
 import numpy as np
 
+from petastorm_tpu import observability as obs
 from petastorm_tpu.errors import PetastormTpuError
 from petastorm_tpu.jax.infeed import stage_batch
 from petastorm_tpu.shuffling_buffer import make_shuffling_buffer_factory
@@ -192,6 +194,13 @@ class JaxDataLoader(object):
                 batched_reader=reader.batched_output)
         self._buffer = None
         self._pending = []
+        # diagnostics state exists from construction: the full key set is
+        # emitted (as zeros) even before iteration starts, so consumers never
+        # need .get guards (pre-fix, rows_emitted/reader_wait_* were absent
+        # until the first __iter__)
+        self._iter_start = None
+        self._reader_wait_s = 0.0
+        self._rows_out = 0
         if resume_state is not None:
             if not isinstance(resume_state, dict) or resume_state.get('version') != 1:
                 raise ValueError('Unrecognized resume_state (expected a dict produced by '
@@ -246,7 +255,6 @@ class JaxDataLoader(object):
         # batches under the lock and yielding them lazily would park them in a
         # generator-local limbo that state_dict() cannot see — a checkpoint
         # taken then would silently lose those rows.
-        import time
         self._iter_start = time.perf_counter()
         self._reader_wait_s = 0.0
         self._rows_out = 0
@@ -258,11 +266,11 @@ class JaxDataLoader(object):
                 batch = None
                 if not exhausted:
                     if buffer.can_emit(bs):
-                        batch = self._emit_columnar(buffer.emit(bs))
+                        batch = self._emit_columnar(self._buffer_emit(buffer, bs))
                 elif buffer.size >= bs:
-                    batch = self._emit_columnar(buffer.emit(bs))
+                    batch = self._emit_columnar(self._buffer_emit(buffer, bs))
                 elif buffer.size and not self._drop_last:
-                    batch = self._emit_columnar(buffer.emit(buffer.size))
+                    batch = self._emit_columnar(self._buffer_emit(buffer, buffer.size))
                 else:
                     # drop_last leftovers are intentionally dropped — clear so
                     # an exhausted loader can be iterated again (multi-epoch)
@@ -282,16 +290,31 @@ class JaxDataLoader(object):
                 continue
             self._reader_wait_s += time.perf_counter() - w0
             with self._state_lock:
-                if self._columnar_ngram:
-                    buffer.add_block(_flatten_ngram_block(item))
-                else:
-                    buffer.add_block(dict(item._asdict()))
+                # block granularity (one row group), never per row: the
+                # counters-level overhead contract of the hot loop
+                with obs.span('shuffle.add_block', cat='loader',
+                              occupancy=buffer.size):
+                    if self._columnar_ngram:
+                        buffer.add_block(_flatten_ngram_block(item))
+                    else:
+                        buffer.add_block(dict(item._asdict()))
+                obs.gauge_set('shuffle_buffer_occupancy', buffer.size)
+
+    def _buffer_emit(self, buffer, count):
+        """One shuffle-buffer batch extraction, traced with its pre-emit
+        occupancy (spans level; block granularity)."""
+        with obs.span('shuffle.emit', cat='loader', occupancy=buffer.size,
+                      rows=count):
+            return buffer.emit(count)
 
     def _emit_columnar(self, batch):
-        self._rows_out += len(next(iter(batch.values()))) if batch else 0
-        batch = _sanitize_batch_columns(batch)
-        if self._columnar_ngram:
-            batch = _unflatten_ngram_batch(batch)
+        n = len(next(iter(batch.values()))) if batch else 0
+        self._rows_out += n
+        with obs.stage('collate', cat='loader', rows=n):
+            batch = _sanitize_batch_columns(batch)
+            if self._columnar_ngram:
+                batch = _unflatten_ngram_batch(batch)
+        obs.count('loader_batches_total')
         if self._to_device is not None:
             batch = self._stage(batch)
         return batch
@@ -301,7 +324,6 @@ class JaxDataLoader(object):
         # checkpoint-correctness reason) as _iterate_columnar. The collate
         # happens under the lock BEFORE the yield: a state_dict() taken while
         # the consumer holds a batch must not count its rows as pending.
-        import time
         self._iter_start = time.perf_counter()
         self._reader_wait_s = 0.0
         self._rows_out = 0
@@ -340,7 +362,12 @@ class JaxDataLoader(object):
             self._reader_wait_s += time.perf_counter() - w0
             with self._state_lock:  # mutation only — never across the reader wait
                 if self.reader.batched_output:
+                    # occupancy at block granularity only: row-oriented readers
+                    # land here once per ROW, and the hot-loop contract is no
+                    # per-row telemetry work even at the counters level (the
+                    # row path's gauge rides the per-batch emit instead)
                     buffer.add_many(_rows_from_columnar_batch(item))
+                    obs.gauge_set('shuffle_buffer_occupancy', buffer.size)
                 else:
                     buffer.add_many([item])
 
@@ -380,10 +407,14 @@ class JaxDataLoader(object):
 
     def _emit(self, rows):
         self._rows_out += len(rows)
-        if self._ngram is not None:
-            batch = self._collate_ngram(rows)
-        else:
-            batch = collate_rows(rows)
+        with obs.stage('collate', cat='loader', rows=len(rows)):
+            if self._ngram is not None:
+                batch = self._collate_ngram(rows)
+            else:
+                batch = collate_rows(rows)
+        obs.count('loader_batches_total')
+        if self._buffer is not None:
+            obs.gauge_set('shuffle_buffer_occupancy', self._buffer.size)
         if self._to_device is not None:
             batch = self._stage(batch)
         return batch
@@ -394,17 +425,23 @@ class JaxDataLoader(object):
         exposes queue depths; the BASELINE metric is input-stall, so the loader
         tracks it): rows emitted, seconds blocked waiting on the reader, the
         wait fraction of wall time since iteration started, plus the underlying
-        pool's diagnostics."""
-        import time
+        reader's diagnostics (unified pool schema + telemetry registry view).
+
+        The loader key set is ALWAYS present — before iteration starts the
+        values are zero, never absent, so consumers need no ``.get`` guards.
+        Feed this dict to :func:`petastorm_tpu.observability.stall_report` to
+        decompose ``reader_wait_s`` into per-stage contributions."""
         out = dict(self.reader.diagnostics)
-        start = getattr(self, '_iter_start', None)
-        if start is not None:
-            elapsed = max(time.perf_counter() - start, 1e-9)
-            out.update({
-                'rows_emitted': self._rows_out,
-                'reader_wait_s': round(self._reader_wait_s, 4),
-                'reader_wait_fraction': round(self._reader_wait_s / elapsed, 4),
-            })
+        if self._iter_start is not None:
+            elapsed = max(time.perf_counter() - self._iter_start, 1e-9)
+            wait_fraction = round(self._reader_wait_s / elapsed, 4)
+        else:
+            wait_fraction = 0.0
+        out.update({
+            'rows_emitted': self._rows_out,
+            'reader_wait_s': round(self._reader_wait_s, 4),
+            'reader_wait_fraction': wait_fraction,
+        })
         return out
 
     def _collate_ngram(self, windows):

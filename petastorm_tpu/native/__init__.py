@@ -23,6 +23,8 @@ import logging
 import os
 import threading
 
+from petastorm_tpu import observability as obs
+
 logger = logging.getLogger(__name__)
 
 _lib = None
@@ -175,8 +177,20 @@ class NativeParquetFile(object):
         column, preserving the requested column order."""
         import pyarrow as pa
 
-        fast = self._zerocopy_columns(i, columns) if columns else {}
+        if columns:
+            with obs.stage('pagescan', cat='native'):
+                fast = self._zerocopy_columns(i, columns)
+        else:
+            fast = {}
         rest = [c for c in columns if c not in fast] if columns is not None else None
+
+        # which decode path served each column is the telemetry answer to
+        # "why is this store slow": page-scan columns are zero-copy views,
+        # arrow-fallback columns pay a full decode
+        if fast:
+            obs.count('pagescan_columns_total', len(fast))
+        if rest:
+            obs.count('arrow_fallback_columns_total', len(rest))
 
         # columns=[] must keep the 0-column N-row semantics of the Arrow path
         # (partition-key-only reads take row counts from it), so the fast-only
@@ -197,15 +211,16 @@ class NativeParquetFile(object):
             arr, n = None, -1
 
         # ArrowArrayStream is 4 pointers + private fields; 256 bytes is ample
-        stream_buf = ctypes.create_string_buffer(256)
-        rc = self._lib.pstpu_read_row_group(self._handle, i, arr, n,
-                                            ctypes.byref(stream_buf))
-        if rc != 0:
-            raise IOError('pstpu_read_row_group({}, rg={}): {}'.format(
-                self.path, i, _last_error(self._lib)))
-        reader = pa.RecordBatchReader._import_from_c(
-            ctypes.addressof(stream_buf))
-        table = reader.read_all()
+        with obs.stage('arrow_decode', cat='native'):
+            stream_buf = ctypes.create_string_buffer(256)
+            rc = self._lib.pstpu_read_row_group(self._handle, i, arr, n,
+                                                ctypes.byref(stream_buf))
+            if rc != 0:
+                raise IOError('pstpu_read_row_group({}, rg={}): {}'.format(
+                    self.path, i, _last_error(self._lib)))
+            reader = pa.RecordBatchReader._import_from_c(
+                ctypes.addressof(stream_buf))
+            table = reader.read_all()
         if not fast:
             return table
         return pa.table({c: (fast[c] if c in fast else table.column(c))

@@ -1,0 +1,95 @@
+"""``petastorm-tpu-diagnose``: one-shot pipeline health check for a dataset.
+
+Runs a short measured read through the full loader pipeline with telemetry on
+and prints the input-stall attribution report, the key pipeline counters, and
+(optionally) a Chrome trace / Prometheus exposition dump::
+
+    petastorm-tpu-diagnose file:///data/train --batches 50 \\
+        --trace-out /tmp/pipeline.json --prom-out /tmp/metrics.prom
+
+Open the trace in https://ui.perfetto.dev (or chrome://tracing). See
+``docs/observability.md`` for how to read the output and
+``docs/troubleshooting.md`` ("reading a stall report") for the remedies.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from petastorm_tpu import observability as obs
+
+
+def diagnose(dataset_url, batch_size=64, batches=50, pool_type='thread',
+             workers_count=3, telemetry='spans', use_batch_reader=False,
+             reader_kwargs=None):
+    """Read ``batches`` batches and return ``(stall_report_dict, diagnostics)``."""
+    from petastorm_tpu.jax.loader import JaxDataLoader
+
+    obs.configure(telemetry)
+    if use_batch_reader:
+        from petastorm_tpu.reader import make_batch_reader as factory
+        extra = {}
+    else:
+        from petastorm_tpu.reader import make_reader as factory
+        extra = {'output': 'columnar'}
+    reader = factory(dataset_url, reader_pool_type=pool_type,
+                     workers_count=workers_count, num_epochs=None,
+                     telemetry=telemetry, **dict(extra, **(reader_kwargs or {})))
+    # the loader context owns the reader: its exit stops and joins it
+    with JaxDataLoader(reader, batch_size=batch_size, drop_last=False) as loader:
+        it = iter(loader)
+        for _ in range(batches):
+            next(it)
+        diag = loader.diagnostics
+        return obs.stall_report(diag), diag
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog='petastorm-tpu-diagnose',
+        description='Measure a short read of the dataset and attribute input '
+                    'stalls to pipeline stages.')
+    parser.add_argument('dataset_url')
+    parser.add_argument('--batch-size', type=int, default=64)
+    parser.add_argument('--batches', type=int, default=50)
+    parser.add_argument('-p', '--pool-type', choices=('thread', 'process', 'dummy'),
+                        default='thread')
+    parser.add_argument('-w', '--workers-count', type=int, default=3)
+    parser.add_argument('--batch-reader', action='store_true',
+                        help='use make_batch_reader (plain Parquet stores)')
+    parser.add_argument('--telemetry', choices=('counters', 'spans'), default='spans')
+    parser.add_argument('--trace-out', default=None,
+                        help='write a Perfetto-loadable Chrome trace JSON here')
+    parser.add_argument('--prom-out', default=None,
+                        help='write a Prometheus text exposition snapshot here')
+    parser.add_argument('--json', action='store_true', dest='as_json',
+                        help='print the report as JSON instead of text')
+    args = parser.parse_args(argv)
+
+    telemetry = 'spans' if args.trace_out else args.telemetry
+    report, diag = diagnose(args.dataset_url, batch_size=args.batch_size,
+                            batches=args.batches, pool_type=args.pool_type,
+                            workers_count=args.workers_count, telemetry=telemetry,
+                            use_batch_reader=args.batch_reader)
+    if args.as_json:
+        print(json.dumps({'stall_report': report,
+                          'diagnostics': {k: v for k, v in sorted(diag.items())}}))
+    else:
+        print(obs.format_stall_report(report))
+        print('diagnostics:')
+        for key in sorted(diag):
+            print('  {} = {}'.format(key, diag[key]))
+    if args.trace_out:
+        n = obs.export_chrome_trace(args.trace_out)
+        print('wrote {} trace events to {} (open in https://ui.perfetto.dev)'.format(
+            n, args.trace_out))
+    if args.prom_out:
+        obs.write_prometheus(args.prom_out)
+        print('wrote Prometheus exposition to {}'.format(args.prom_out))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

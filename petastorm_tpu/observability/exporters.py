@@ -1,0 +1,106 @@
+"""Metric exporters: Prometheus text exposition and periodic JSONL flush.
+
+Both consume :meth:`MetricsRegistry.snapshot` dicts, so they work equally on
+the live process registry and on cross-process merges
+(:func:`petastorm_tpu.observability.metrics.merge_snapshots`).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import time
+
+from petastorm_tpu.observability import metrics as _metrics
+
+_NAME_SANITIZE = re.compile(r'[^a-zA-Z0-9_:]')
+
+
+def _prom_name(name, prefix):
+    return prefix + _NAME_SANITIZE.sub('_', name)
+
+
+def to_prometheus_text(snapshot=None, prefix='pstpu_'):
+    """Render a snapshot in the Prometheus text exposition format (0.0.4).
+
+    Counters keep their name (``pstpu_rows_decoded_total``), gauges likewise;
+    histograms expand to cumulative ``_bucket{le=...}`` series plus ``_sum``
+    and ``_count``, per the exposition contract.
+    """
+    if snapshot is None:
+        snapshot = _metrics.get_registry().snapshot()
+    lines = []
+    for name in sorted(snapshot.get('counters', {})):
+        metric = _prom_name(name, prefix)
+        lines.append('# TYPE {} counter'.format(metric))
+        lines.append('{} {}'.format(metric, snapshot['counters'][name]))
+    for name in sorted(snapshot.get('gauges', {})):
+        metric = _prom_name(name, prefix)
+        lines.append('# TYPE {} gauge'.format(metric))
+        lines.append('{} {}'.format(metric, snapshot['gauges'][name]))
+    for name in sorted(snapshot.get('histograms', {})):
+        h = snapshot['histograms'][name]
+        metric = _prom_name(name, prefix)
+        lines.append('# TYPE {} histogram'.format(metric))
+        cumulative = 0
+        for bound, count in zip(h['bounds'], h['counts']):
+            cumulative += count
+            lines.append('{}_bucket{{le="{}"}} {}'.format(metric, bound, cumulative))
+        lines.append('{}_bucket{{le="+Inf"}} {}'.format(metric, h['count']))
+        lines.append('{}_sum {}'.format(metric, h['sum']))
+        lines.append('{}_count {}'.format(metric, h['count']))
+    return '\n'.join(lines) + '\n'
+
+
+def write_prometheus(path, snapshot=None, prefix='pstpu_'):
+    """One-shot exposition dump (node-exporter textfile-collector style)."""
+    with open(path, 'w') as f:
+        f.write(to_prometheus_text(snapshot, prefix=prefix))
+
+
+class JsonlExporter(object):
+    """Background thread appending one JSON line per interval to ``path``:
+    ``{"ts": <epoch s>, "metrics": {<flat name: value>}}``. Deterministic
+    release via :meth:`stop` (or the context manager); the final flush runs on
+    stop so short-lived runs still record their last state."""
+
+    def __init__(self, path, interval_s=5.0, snapshot_fn=None):
+        if interval_s <= 0:
+            raise ValueError('interval_s must be > 0')
+        self._path = path
+        self._interval_s = interval_s
+        self._snapshot_fn = snapshot_fn or (lambda: _metrics.get_registry().snapshot())
+        self._stop_event = threading.Event()
+        self._thread = None
+
+    def start(self):
+        if self._thread is not None:
+            raise RuntimeError('JsonlExporter already started')
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name='pstpu-metrics-jsonl')
+        self._thread.start()
+        return self
+
+    def _flush(self):
+        line = json.dumps({'ts': round(time.time(), 3),
+                           'metrics': _metrics.flatten_snapshot(self._snapshot_fn())})
+        with open(self._path, 'a') as f:
+            f.write(line + '\n')
+
+    def _loop(self):
+        while not self._stop_event.wait(self._interval_s):
+            self._flush()
+
+    def stop(self):
+        self._stop_event.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        self._flush()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, exc_type, exc_value, tb):
+        self.stop()

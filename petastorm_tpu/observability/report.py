@@ -1,0 +1,117 @@
+"""Input-stall attribution: decompose the loader's ``reader_wait_s`` into
+per-stage contributions and name the bottleneck.
+
+The loader's ``reader_wait_s`` (time the consumer sat blocked in
+``next(reader)``) is the online form of the BASELINE input-stall metric — but
+a single number cannot say *why* the pipeline stalled. This module splits it
+using the stage timers the telemetry layer accumulates:
+
+* ``stage_pool_wait_s`` — measured **inside** ``pool.get_results`` (itself
+  inside the reader-wait window): the share of the wait spent blocked on the
+  worker pool's results transport.
+* the remainder (``reader_wait_s - pool_wait``) is consumer-side assembly:
+  row slicing / rebatching / ngram windowing in the results-queue reader.
+* the pool-wait share is then attributed to the **worker** stages
+  proportionally to their measured busy seconds (read IO, chunk fetch,
+  decode, transform) — with the nested chunk-fetch seconds subtracted from
+  the read timer so no second is counted twice. For thread/dummy pools these
+  timers live in the same process's registry; for the process pool they
+  arrive merged from the workers' own registries.
+
+The result attributes ~100% of the measured wait to *named* stages (the
+acceptance bar is >=90%), so "is it IO, decode, shuffle starvation, or device
+staging?" has a mechanical answer. See ``docs/observability.md`` and the
+"reading a stall report" section in ``docs/troubleshooting.md``.
+"""
+
+from __future__ import annotations
+
+#: worker-side stage timers split proportionally under the pool wait, in
+#: display order. 'read_io' is derived: stage_read_s minus the nested
+#: stage_chunk_fetch_s.
+_WORKER_STAGES = ('read_io', 'chunk_fetch', 'decode', 'transform')
+
+#: stage -> one-line remedy, surfaced next to the named bottleneck
+_HINTS = {
+    'worker.read_io': 'storage-bound: enable chunk_cache for remote stores, or add IO parallelism (workers_count)',
+    'worker.chunk_fetch': 'cold chunk mirror: warm the cache (epoch 2+ reads locally) or raise prefetch_budget',
+    'worker.decode': 'decode-bound: more workers/cores, batched TransformSpec, image_decode_hints, or a RawTensorCodec store',
+    'worker.transform': 'transform-bound: vectorize with TransformSpec(batched=True)',
+    'consumer.assembly': 'consumer-side slicing/rebatch: prefer output=columnar and larger batches',
+    'pool.unattributed': 'workers idle or untimed: check ventilator starvation (items_in_flight) and results_queue_depth',
+}
+
+
+def stall_report(diagnostics):
+    """Build the attribution dict from a diagnostics mapping (either
+    ``JaxDataLoader.diagnostics`` or ``Reader.diagnostics`` merged with loader
+    counters). Returns::
+
+        {'reader_wait_s': ..., 'reader_wait_fraction': ...,
+         'stages': {stage: seconds attributed},   # sums to ~reader_wait_s
+         'attributed_s': ..., 'coverage': 0..1,
+         'bottleneck': stage name or None, 'hint': str or None,
+         'worker_busy_s': {stage: raw busy seconds}}
+    """
+    wait = float(diagnostics.get('reader_wait_s', 0.0) or 0.0)
+    pool_wait = float(diagnostics.get('stage_pool_wait_s', 0.0) or 0.0)
+    pool_wait = min(pool_wait, wait)
+    assembly = max(wait - pool_wait, 0.0)
+
+    read = float(diagnostics.get('stage_read_s', 0.0) or 0.0)
+    chunk_fetch = float(diagnostics.get('stage_chunk_fetch_s', 0.0) or 0.0)
+    busy = {
+        'read_io': max(read - chunk_fetch, 0.0),
+        'chunk_fetch': chunk_fetch,
+        'decode': float(diagnostics.get('stage_decode_s', 0.0) or 0.0),
+        'transform': float(diagnostics.get('stage_transform_s', 0.0) or 0.0),
+    }
+    total_busy = sum(busy.values())
+
+    stages = {}
+    if assembly > 0:
+        stages['consumer.assembly'] = assembly
+    if pool_wait > 0:
+        if total_busy > 0:
+            for name in _WORKER_STAGES:
+                share = pool_wait * busy[name] / total_busy
+                if share > 0:
+                    stages['worker.' + name] = share
+        else:
+            # nothing timed on the worker side (telemetry off in workers, or
+            # workers starved): name it rather than hide it
+            stages['pool.unattributed'] = pool_wait
+
+    attributed = sum(stages.values())
+    coverage = (attributed / wait) if wait > 0 else 1.0
+    bottleneck = max(stages, key=stages.get) if stages else None
+    return {
+        'reader_wait_s': round(wait, 4),
+        'reader_wait_fraction': diagnostics.get('reader_wait_fraction'),
+        'stages': {k: round(v, 4) for k, v in sorted(
+            stages.items(), key=lambda kv: -kv[1])},
+        'attributed_s': round(attributed, 4),
+        'coverage': round(coverage, 4),
+        'bottleneck': bottleneck,
+        'hint': _HINTS.get(bottleneck),
+        'worker_busy_s': {k: round(v, 4) for k, v in busy.items()},
+    }
+
+
+def format_stall_report(report):
+    """Human-readable rendering of :func:`stall_report`'s dict."""
+    lines = ['stall report: reader_wait={:.3f}s'.format(report['reader_wait_s'])]
+    frac = report.get('reader_wait_fraction')
+    if frac is not None:
+        lines[0] += ' ({:.1%} of loader wall time)'.format(frac)
+    wait = report['reader_wait_s']
+    for stage, seconds in report['stages'].items():
+        pct = (seconds / wait * 100.0) if wait else 0.0
+        lines.append('  {:<22s} {:>8.3f}s  {:5.1f}%'.format(stage, seconds, pct))
+    lines.append('  attributed {:.1%} of the wait to named stages'.format(
+        report['coverage']))
+    if report['bottleneck'] is not None:
+        lines.append('  bottleneck: {}'.format(report['bottleneck']))
+        if report.get('hint'):
+            lines.append('    hint: {}'.format(report['hint']))
+    return '\n'.join(lines)

@@ -1,0 +1,176 @@
+"""Span tracing: a bounded ring buffer of Chrome-trace events.
+
+Every instrumented pipeline stage (ventilator dispatch, chunk fetch, worker
+read/decode, shuffle add/emit, loader collate, device staging) records one
+*complete* event (``ph='X'``) when the process-wide level is ``'spans'``. The
+ring is bounded (``deque(maxlen=...)``): a long run rotates oldest-first
+instead of growing without bound, so tracing is safe to leave on.
+
+Events are stored directly in the Chrome trace-event format (the dict Perfetto
+and ``chrome://tracing`` load), so export is a ``json.dump`` — no conversion
+pass over a large buffer:
+
+    {"name": ..., "cat": ..., "ph": "X", "ts": <epoch µs>, "dur": <µs>,
+     "pid": ..., "tid": ..., "args": {...}}
+
+``ts`` is wall-clock epoch microseconds (``time.time()``) so spans recorded in
+worker *processes* land on the same timeline as the main process; ``dur`` is
+measured with ``perf_counter`` for precision. Worker-process events travel to
+the main process piggybacked on the pool's results channel (drained
+incrementally with :meth:`TraceRing.drain`), keyed by their own ``pid`` so
+Perfetto renders one track per process.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+
+from petastorm_tpu.observability import metrics as _metrics
+
+DEFAULT_TRACE_CAPACITY = 65536
+
+
+class TraceRing(object):
+    """Thread-safe bounded event buffer. ``add`` is O(1); when full the oldest
+    event is rotated out (``deque(maxlen)`` semantics)."""
+
+    def __init__(self, capacity=DEFAULT_TRACE_CAPACITY):
+        self._lock = threading.Lock()
+        self._events = deque(maxlen=capacity)
+        self._dropped = 0
+
+    @property
+    def capacity(self):
+        return self._events.maxlen
+
+    def set_capacity(self, capacity):
+        with self._lock:
+            if capacity != self._events.maxlen:
+                self._events = deque(self._events, maxlen=capacity)
+
+    def __len__(self):
+        return len(self._events)
+
+    @property
+    def dropped(self):
+        """Events rotated out since creation (ring-full overwrites)."""
+        return self._dropped
+
+    def add(self, event):
+        with self._lock:
+            if len(self._events) == self._events.maxlen:
+                self._dropped += 1
+            self._events.append(event)
+
+    def extend(self, events):
+        with self._lock:
+            overflow = len(self._events) + len(events) - self._events.maxlen
+            if overflow > 0:
+                self._dropped += min(overflow, self._events.maxlen)
+            self._events.extend(events)
+
+    def snapshot(self):
+        with self._lock:
+            return list(self._events)
+
+    def drain(self):
+        """Return and clear the buffered events (incremental shipping from
+        worker processes to the main-process ring)."""
+        with self._lock:
+            events, self._events = list(self._events), deque(maxlen=self._events.maxlen)
+            return events
+
+    def clear(self):
+        with self._lock:
+            self._events.clear()
+
+
+#: the per-process default ring
+_ring = TraceRing()
+
+
+def get_ring():
+    return _ring
+
+
+def record_span(name, cat, ts_epoch_s, dur_s, args=None):
+    """Append one complete event to the process ring (caller has already
+    checked the level)."""
+    event = {'name': name, 'cat': cat, 'ph': 'X',
+             'ts': int(ts_epoch_s * 1e6), 'dur': int(dur_s * 1e6),
+             'pid': os.getpid(), 'tid': threading.get_ident()}
+    if args:
+        event['args'] = args
+    _ring.add(event)
+
+
+class _Span(object):
+    """Context manager recording one complete event on exit. Use only via
+    :func:`span`/:func:`petastorm_tpu.observability.stage` so the off-level
+    fast path stays a single int check."""
+
+    __slots__ = ('name', 'cat', 'args', '_t0', '_wall0')
+
+    def __init__(self, name, cat, args):
+        self.name = name
+        self.cat = cat
+        self.args = args
+
+    def __enter__(self):
+        self._wall0 = time.time()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc_value, tb):
+        record_span(self.name, self.cat, self._wall0,
+                    time.perf_counter() - self._t0, self.args)
+        return False
+
+
+class _NoopSpan(object):
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc_value, tb):
+        return False
+
+
+_NOOP_SPAN = _NoopSpan()
+
+
+def span(name, cat='pipeline', **args):
+    """Trace-only span: records a Chrome-trace event at level ``'spans'``,
+    no-op below. Must be used as a context manager (lint rule PT700)."""
+    if not _metrics.spans_on():
+        return _NOOP_SPAN
+    return _Span(name, cat, args or None)
+
+
+def instant(name, cat='pipeline', **args):
+    """Zero-duration event (cache hit, rotation, …) at level ``'spans'``."""
+    if not _metrics.spans_on():
+        return
+    record_span(name, cat, time.time(), 0.0, args or None)
+
+
+def chrome_trace(events=None):
+    """The Chrome trace-event JSON document (dict) for ``events`` (default:
+    the process ring's current contents)."""
+    if events is None:
+        events = _ring.snapshot()
+    return {'traceEvents': events, 'displayTimeUnit': 'ms'}
+
+
+def export_chrome_trace(path, events=None):
+    """Write a Perfetto/chrome://tracing-loadable JSON file; returns the
+    number of events written."""
+    doc = chrome_trace(events)
+    with open(path, 'w') as f:
+        json.dump(doc, f)
+    return len(doc['traceEvents'])

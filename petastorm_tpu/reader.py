@@ -22,6 +22,7 @@ from __future__ import annotations
 import logging
 import warnings
 
+from petastorm_tpu import observability as obs
 from petastorm_tpu.batch_worker import ArrowBatchWorker, BatchResultsQueueReader
 from petastorm_tpu.cache import NullCache
 from petastorm_tpu.errors import NoDataAvailableError, PetastormTpuError
@@ -112,7 +113,8 @@ def make_reader(dataset_url,
                 output='rows', batch_size=None, drop_last=False,
                 resume_state=None,
                 storage_retry_policy=None,
-                chunk_cache=None, chunk_cache_size_limit=None):
+                chunk_cache=None, chunk_cache_size_limit=None,
+                telemetry=None):
     """Reader for datasets written by :func:`materialize_dataset` — rows decoded
     through the stored Unischema's codecs (reference reader.py:50-174).
 
@@ -158,6 +160,14 @@ def make_reader(dataset_url,
     :param drop_last: (columnar + batch_size only) drop the ragged final batch
     :param resume_state: dict from :meth:`Reader.state_dict` — continue reading
         from a checkpointed position (construct with otherwise-identical args)
+    :param telemetry: pipeline telemetry level — ``'off'`` (near-zero
+        overhead), ``'counters'`` (the process default: per-stage timers and
+        counters, :attr:`Reader.diagnostics` becomes a view over the metrics
+        registry), ``'spans'`` (adds Chrome-trace span recording, exportable
+        via ``petastorm_tpu.observability.export_chrome_trace``), or a
+        :class:`petastorm_tpu.observability.TelemetryConfig`. ``None`` keeps
+        the process's current configuration. Applied process-wide and carried
+        into worker processes. See ``docs/observability.md``.
     """
     try:
         schema = dataset_metadata.get_schema(dataset_url, retry_policy=storage_retry_policy)
@@ -201,7 +211,8 @@ def make_reader(dataset_url,
                   resume_state=resume_state,
                   storage_retry_policy=storage_retry_policy,
                   chunk_cache=chunk_cache,
-                  chunk_cache_size_limit=chunk_cache_size_limit)
+                  chunk_cache_size_limit=chunk_cache_size_limit,
+                  telemetry=telemetry)
 
 
 def make_batch_reader(dataset_url,
@@ -218,7 +229,8 @@ def make_batch_reader(dataset_url,
                       batch_size=None, drop_last=False,
                       resume_state=None,
                       storage_retry_policy=None,
-                      chunk_cache=None, chunk_cache_size_limit=None):
+                      chunk_cache=None, chunk_cache_size_limit=None,
+                      telemetry=None):
     """Columnar reader for ANY Parquet store (reference reader.py:177-289):
     yields one namedtuple of numpy column arrays per row group
     (``batched_output=True``). Schema is inferred from the Arrow schema unless
@@ -232,6 +244,9 @@ def make_batch_reader(dataset_url,
 
     ``chunk_cache``/``chunk_cache_size_limit``: local chunk mirror for remote
     stores — identical semantics to :func:`make_reader`.
+
+    ``telemetry``: pipeline telemetry level ('off' | 'counters' | 'spans' |
+    TelemetryConfig) — identical semantics to :func:`make_reader`.
     """
     schema = dataset_metadata.infer_or_load_unischema(dataset_url,
                                                       retry_policy=storage_retry_policy)
@@ -251,7 +266,8 @@ def make_batch_reader(dataset_url,
                   resume_state=resume_state,
                   storage_retry_policy=storage_retry_policy,
                   chunk_cache=chunk_cache,
-                  chunk_cache_size_limit=chunk_cache_size_limit)
+                  chunk_cache_size_limit=chunk_cache_size_limit,
+                  telemetry=telemetry)
 
 
 class Reader(object):
@@ -263,7 +279,8 @@ class Reader(object):
                  shuffle_row_drop_partitions=1, predicate=None, rowgroup_selector=None,
                  num_epochs=1, cur_shard=None, shard_count=None, cache=None,
                  transform_spec=None, ngram=None, columnar_ngram=False, resume_state=None,
-                 storage_retry_policy=None, chunk_cache=None, chunk_cache_size_limit=None):
+                 storage_retry_policy=None, chunk_cache=None, chunk_cache_size_limit=None,
+                 telemetry=None):
         if (cur_shard is None) != (shard_count is None):
             raise ValueError('cur_shard and shard_count must be specified together')
         if cur_shard is not None and not 0 <= cur_shard < shard_count:
@@ -271,6 +288,11 @@ class Reader(object):
                 cur_shard, shard_count))
         if shuffle_row_drop_partitions < 1:
             raise ValueError('shuffle_row_drop_partitions must be >= 1')
+
+        # telemetry: apply the requested level process-wide (None keeps the
+        # current configuration) and remember the effective config so worker
+        # processes inherit it through worker_args
+        self._telemetry_config = obs.configure(telemetry)
 
         self._dataset_url = dataset_url
         self.schema = schema  # full stored/inferred schema
@@ -348,6 +370,7 @@ class Reader(object):
             'columnar_ngram': columnar_ngram,
             'cache': cache or NullCache(),
             'chunk_cache': self._chunk_cache_config,
+            'telemetry': self._telemetry_config,
         }
         self._pool = pool
         # async chunk prefetcher: walks the ventilator's exact upcoming order
@@ -490,7 +513,20 @@ class Reader(object):
 
     @property
     def diagnostics(self):
-        diag = dict(self._pool.diagnostics)
+        """Pipeline health view: the unified pool schema (``workers_count``,
+        ``items_ventilated``/``items_completed``/``items_in_flight``,
+        ``results_queue_depth`` — identical keys and units for every pool
+        type), the telemetry registry's counters/gauges (this process's
+        registry merged with the pool workers' shipped snapshots — per-stage
+        ``stage_*_s`` timers, page-scan vs Arrow column counts, …), and the
+        ``chunk_cache_*`` counters when the chunk store is engaged. See
+        ``docs/observability.md`` for the full catalog."""
+        snapshots = [obs.snapshot()]
+        tele = getattr(self._pool, 'telemetry_snapshots', None)
+        if tele is not None:  # custom/mock pools may predate the telemetry API
+            snapshots.extend(tele())
+        diag = obs.flatten_snapshot(obs.merge_snapshots(snapshots))
+        diag.update(self._pool.diagnostics)
         if self._chunk_cache_config is not None:
             from petastorm_tpu.chunkstore import cache_diagnostics
             diag.update(cache_diagnostics(self._chunk_cache_config))

@@ -24,6 +24,7 @@ from collections import deque
 
 import numpy as np
 
+from petastorm_tpu import observability as obs
 from petastorm_tpu.columnar import (BlockResultsReaderBase, block_num_rows, block_to_rows,
                                     column_cells, rows_to_block, stack_cells, take_block)
 from petastorm_tpu.native import open_parquet
@@ -143,6 +144,7 @@ class RowGroupDecoderWorker(WorkerBase):
                 self.publish(windows)
             return
 
+        obs.count('worker_rows_decoded_total', block_num_rows(block))
         self.publish(block)
 
     def _apply_transform(self, block, transform):
@@ -152,12 +154,13 @@ class RowGroupDecoderWorker(WorkerBase):
         final_fields = set(self.args['transformed_schema'].fields)
         if transform.func is None:
             return {k: v for k, v in block.items() if k in final_fields}
-        if getattr(transform, 'batched', False):
-            out = transform.func(dict(block))
-            return {k: v for k, v in out.items() if k in final_fields}
-        rows = block_to_rows(block)
-        rows = [transform.func(r) for r in rows]
-        rows = [{k: v for k, v in r.items() if k in final_fields} for r in rows]
+        with obs.stage('transform', cat='worker'):
+            if getattr(transform, 'batched', False):
+                out = transform.func(dict(block))
+                return {k: v for k, v in out.items() if k in final_fields}
+            rows = block_to_rows(block)
+            rows = [transform.func(r) for r in rows]
+            rows = [{k: v for k, v in r.items() if k in final_fields} for r in rows]
         if not rows:
             return None
         return rows_to_block(rows)
@@ -171,10 +174,12 @@ class RowGroupDecoderWorker(WorkerBase):
         physical = [c for c in column_names if c not in piece.partition_keys
                     and c in schema.fields]
         pf = self._parquet_file(piece.path)
-        table = pf.read_row_group(piece.row_group, columns=physical)
-        num_rows = table.num_rows
-        if row_indices is not None:
-            table = table.take(row_indices)
+        with obs.stage('read', cat='worker', piece=piece.path,
+                       row_group=piece.row_group):
+            table = pf.read_row_group(piece.row_group, columns=physical)
+            num_rows = table.num_rows
+            if row_indices is not None:
+                table = table.take(row_indices)
         return table, num_rows
 
     def _decode_table(self, table, column_names, piece):
@@ -187,6 +192,13 @@ class RowGroupDecoderWorker(WorkerBase):
         resize_hints = getattr(transform, 'image_resize', None) or {}
         n = table.num_rows
         block = {}
+        with obs.stage('decode', cat='worker', rows=n):
+            self._decode_columns(table, column_names, piece, block,
+                                 schema, decode_hints, resize_hints, transform, n)
+        return block
+
+    def _decode_columns(self, table, column_names, piece, block, schema,
+                        decode_hints, resize_hints, transform, n):
         for name in column_names:
             if name in piece.partition_keys:
                 field = schema.fields.get(name)

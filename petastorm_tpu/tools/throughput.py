@@ -53,13 +53,18 @@ def _process_stats():
 
 def reader_throughput(dataset_url, field_regex=None, warmup_cycles=200, measure_cycles=1000,
                       pool_type='thread', workers_count=3, shuffle_row_groups=True,
-                      read_method='python', batch_size=64, make_reader_fn=None):
+                      read_method='python', batch_size=64, make_reader_fn=None,
+                      telemetry=None):
     """Measure read throughput in samples/sec.
 
     :param read_method: 'python' — iterate raw reader rows (reference parity);
         'columnar' — JaxDataLoader batches on the host block path, no device
         staging (the per-core host rate the ``cores_needed`` budget formula
         uses); 'jax' — JaxDataLoader + device staging with stall accounting.
+    :param telemetry: pipeline telemetry level forwarded to ``make_reader``
+        ('off'/'counters'/'spans'/None). With the loader-based read methods the
+        result's ``extra['stall_report']`` carries the per-stage attribution of
+        the measured reader wait (``petastorm_tpu.observability.stall_report``).
     """
     from petastorm_tpu import make_reader
 
@@ -67,6 +72,8 @@ def reader_throughput(dataset_url, field_regex=None, warmup_cycles=200, measure_
     if read_method in ('jax', 'columnar') and make_reader_fn is None:
         # device-feed benchmarks ride the columnar hot path: blocks, not rows
         extra['output'] = 'columnar'
+    if telemetry is not None:
+        extra['telemetry'] = telemetry
     make_reader_fn = make_reader_fn or make_reader
     reader = make_reader_fn(dataset_url,
                             schema_fields=field_regex,
@@ -74,6 +81,7 @@ def reader_throughput(dataset_url, field_regex=None, warmup_cycles=200, measure_
                             workers_count=workers_count,
                             shuffle_row_groups=shuffle_row_groups,
                             num_epochs=None, **extra)
+    result_extra = {}
     try:
         _process_stats()  # prime the CPU%% counter (shared Process instance)
         if read_method == 'python':
@@ -100,11 +108,12 @@ def reader_throughput(dataset_url, field_regex=None, warmup_cycles=200, measure_
             duration = time.perf_counter() - t0
             samples = measure_batches * batch_size
             stall = None
+            result_extra['stall_report'] = _loader_stall_report(loader)
         elif read_method == 'jax':
             import jax
             from petastorm_tpu.jax import JaxDataLoader, prefetch_to_device
-            loader = prefetch_to_device(JaxDataLoader(reader, batch_size=batch_size),
-                                        jax.devices()[0], size=2)
+            jax_loader = JaxDataLoader(reader, batch_size=batch_size)
+            loader = prefetch_to_device(jax_loader, jax.devices()[0], size=2)
             warmup_batches = max(1, warmup_cycles // batch_size)
             measure_batches = max(1, measure_cycles // batch_size)
             it = iter(loader)
@@ -120,15 +129,25 @@ def reader_throughput(dataset_url, field_regex=None, warmup_cycles=200, measure_
             duration = time.perf_counter() - t0
             samples = measure_batches * batch_size
             stall = wait_time / duration if duration > 0 else 0.0
+            result_extra['stall_report'] = _loader_stall_report(jax_loader)
         else:
             raise ValueError('Unknown read_method {!r}'.format(read_method))
         rss_mb, cpu = _process_stats()
         return BenchmarkResult(samples_per_second=samples / duration, duration_s=duration,
                                samples=samples, memory_rss_mb=rss_mb, cpu_percent=cpu,
-                               input_stall_fraction=stall)
+                               input_stall_fraction=stall, extra=result_extra)
     finally:
         reader.stop()
         reader.join()
+
+
+def _loader_stall_report(loader):
+    """Per-stage attribution of the loader's measured reader wait (None when
+    telemetry is off — there are no stage timers to attribute against)."""
+    from petastorm_tpu import observability as obs
+    if not obs.counters_on():
+        return None
+    return obs.stall_report(loader.diagnostics)
 
 
 def pipeline_duty_cycle(dataset_url, step_fn, batch_to_args, batch_size=64, steps=50,
@@ -187,6 +206,12 @@ def main(argv=None):
                         default='python')
     parser.add_argument('--batch-size', type=int, default=64)
     parser.add_argument('--no-shuffle', action='store_true')
+    parser.add_argument('--telemetry', choices=('off', 'counters', 'spans'), default=None,
+                        help='pipeline telemetry level (default: counters; '
+                             '--trace-out implies spans)')
+    parser.add_argument('--trace-out', default=None,
+                        help='write a Perfetto-loadable Chrome trace of the run here '
+                             '(implies --telemetry spans)')
     parser.add_argument('--fresh-process', action='store_true',
                         help='re-run the measurement in a newly spawned interpreter so the '
                              'reported RSS reflects only this benchmark (reference '
@@ -202,12 +227,26 @@ def main(argv=None):
             [sys.executable, '-m', 'petastorm_tpu.tools.throughput'] + child_argv,
             env=env).returncode
 
+    telemetry = args.telemetry
+    if args.trace_out and telemetry in (None, 'off', 'counters'):
+        telemetry = 'spans'
     result = reader_throughput(
         args.dataset_url, field_regex=args.field_regex, warmup_cycles=args.warmup_cycles,
         measure_cycles=args.measure_cycles, pool_type=args.pool_type,
         workers_count=args.workers_count, shuffle_row_groups=not args.no_shuffle,
-        read_method=args.read_method, batch_size=args.batch_size)
+        read_method=args.read_method, batch_size=args.batch_size, telemetry=telemetry)
     print(result)
+    report = result.extra.get('stall_report')
+    if report is not None:
+        # the input-stall fraction says HOW MUCH the consumer waited; this
+        # says WHY — which stage the wait decomposes into
+        from petastorm_tpu.observability import format_stall_report
+        print(format_stall_report(report))
+    if args.trace_out:
+        from petastorm_tpu.observability import export_chrome_trace
+        n = export_chrome_trace(args.trace_out)
+        print('wrote {} trace events to {} (open in https://ui.perfetto.dev)'.format(
+            n, args.trace_out))
     return 0
 
 

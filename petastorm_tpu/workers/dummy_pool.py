@@ -15,6 +15,7 @@ import threading
 import time
 from collections import deque
 
+from petastorm_tpu import observability as obs
 from petastorm_tpu.workers.worker_base import EmptyResultError
 
 
@@ -30,6 +31,8 @@ class DummyPool(object):
         self._ventilator = None
         self._worker_error = None
         self._current_seq = None
+        self._ventilated_items = 0
+        self._completed_items = 0
         self.workers_count = workers_count
         # checkpoint plumbing (see thread_pool.py)
         self.last_result_seq = None
@@ -48,6 +51,7 @@ class DummyPool(object):
     def ventilate(self, *args, **kwargs):
         with self._pending_lock:
             self._pending.append((args, kwargs))
+            self._ventilated_items += 1
 
     def _process_one(self):
         """Run one pending task on THIS thread. Returns False when none were
@@ -66,6 +70,8 @@ class DummyPool(object):
             if self._ventilator is not None:
                 self._ventilator.stop()
         finally:
+            with self._pending_lock:
+                self._completed_items += 1
             if self._ventilator is not None:
                 self._ventilator.processed_item()
         return True
@@ -83,6 +89,14 @@ class DummyPool(object):
         return None
 
     def get_results(self):
+        # NOTE on attribution: the dummy pool runs worker.process on THIS
+        # thread inside get_results, so the pool-wait timer here CONTAINS the
+        # worker stage timers — which is exactly what the stall report's
+        # proportional split over worker busy time expects.
+        with obs.stage('pool_wait', cat='pool'):
+            return self._get_results()
+
+    def _get_results(self):
         while True:
             payload = self._pop_ready()
             if payload is not None:
@@ -125,7 +139,19 @@ class DummyPool(object):
 
     @property
     def diagnostics(self):
-        return {'output_queue_size': len(self._results)}
+        """The unified pool diagnostics schema (docs/observability.md)."""
+        with self._pending_lock:
+            ventilated = self._ventilated_items
+            completed = self._completed_items
+        return {'workers_count': self.workers_count,
+                'items_ventilated': ventilated,
+                'items_completed': completed,
+                'items_in_flight': ventilated - completed,
+                'results_queue_depth': len(self._results)}
+
+    def telemetry_snapshots(self):
+        """Worker metrics already live in this process's registry."""
+        return []
 
     @property
     def results_qsize(self):

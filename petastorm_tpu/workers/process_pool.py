@@ -35,6 +35,7 @@ import uuid
 
 import zmq
 
+from petastorm_tpu import observability as obs
 from petastorm_tpu.serializers import PickleSerializer
 from petastorm_tpu.workers.worker_base import EmptyResultError, TimeoutWaitingForResultError
 
@@ -42,6 +43,11 @@ logger = logging.getLogger(__name__)
 
 _CONTROL_FINISHED = b'FINISHED'
 _STARTED, _DATA, _DONE, _ERROR, _BLOB = b'S', b'D', b'F', b'E', b'B'
+#: telemetry piggyback on the results channel: a worker ships its cumulative
+#: metrics snapshot (and, at spans level, its drained trace events) after each
+#: completed item — the same route the payloads travel, so ordering guarantees
+#: the final snapshot arrives before the consumer sees the pool as drained
+_METRICS = b'M'
 
 _WORKER_STARTUP_TIMEOUT_S = 30
 _DEFAULT_RESULTS_HWM = 50
@@ -178,6 +184,9 @@ class ProcessPool(object):
         # checkpoint plumbing (see thread_pool.py): messages carry the item seq
         self.last_result_seq = None
         self.done_callback = None
+        # pid -> latest cumulative metrics snapshot from that worker process
+        # (consumer thread only; merged by Reader.diagnostics)
+        self._telemetry_by_pid = {}
 
     @property
     def transport(self):
@@ -329,6 +338,10 @@ class ProcessPool(object):
         self._ventilator_send.send_pyobj((args, kwargs))
 
     def get_results(self, timeout_s=None):
+        with obs.stage('pool_wait', cat='pool'):
+            return self._get_results(timeout_s)
+
+    def _get_results(self, timeout_s=None):
         timeout_s = timeout_s if timeout_s is not None else self._results_timeout_s
         deadline = (time.monotonic() + timeout_s) if timeout_s is not None else None
         while True:
@@ -354,9 +367,29 @@ class ProcessPool(object):
                     self._ventilator.processed_item()
                 if seq is not None and self.done_callback is not None:
                     self.done_callback(seq)
+            elif kind == _METRICS:
+                self._absorb_telemetry(payload)
             elif kind == _ERROR:
                 raise pickle.loads(payload)
             # late _STARTED messages are ignored
+
+    def _absorb_telemetry(self, payload):
+        """Record a worker's cumulative metrics snapshot and merge its trace
+        events into this process's span ring."""
+        try:
+            rec = pickle.loads(bytes(payload))
+        except Exception as e:  # noqa: BLE001 - malformed telemetry must never kill the read loop
+            logger.debug('dropping malformed worker telemetry message: %s', e)
+            return
+        if not isinstance(rec, dict):
+            return
+        self._telemetry_by_pid[rec.get('pid')] = rec.get('metrics') or {}
+        obs.absorb_trace_events(rec.get('events'))
+
+    def telemetry_snapshots(self):
+        """Latest cumulative metrics snapshot of every worker process (for
+        :func:`petastorm_tpu.observability.merge_snapshots`)."""
+        return list(self._telemetry_by_pid.values())
 
     def _all_done(self):
         if self._ventilated_items > self._completed_items:
@@ -414,9 +447,14 @@ class ProcessPool(object):
 
     @property
     def diagnostics(self):
-        return {'items_consumed': self._completed_items,
+        """The unified pool diagnostics schema (docs/observability.md).
+        ``results_queue_depth`` is 0 here: buffered results live in zmq/ring
+        transport buffers this process cannot observe."""
+        return {'workers_count': self._workers_count,
                 'items_ventilated': self._ventilated_items,
-                'items_inprocess': self._ventilated_items - self._completed_items}
+                'items_completed': self._completed_items,
+                'items_in_flight': self._ventilated_items - self._completed_items,
+                'results_queue_depth': 0}
 
     @property
     def results_qsize(self):
@@ -442,6 +480,11 @@ def _worker_bootstrap(worker_id, main_pid, setup_blob, vent_addr, result_addr, c
             max(1, (os.cpu_count() or 1) // max(1, workers_count)))
 
     worker_class, worker_setup_args, serializer = pickle.loads(setup_blob)
+
+    # telemetry rides the worker setup args: configure THIS process's level
+    # and ring to match the reader's before any instrumented code runs
+    if isinstance(worker_setup_args, dict) and worker_setup_args.get('telemetry') is not None:
+        obs.configure(worker_setup_args['telemetry'])
 
     _start_orphan_monitor(main_pid)
 
@@ -581,6 +624,23 @@ def _worker_bootstrap(worker_id, main_pid, setup_blob, vent_addr, result_addr, c
             return
         send(_DATA, current['seq'], serializer.serialize(data))
 
+    def flush_telemetry():
+        """Ship this process's cumulative metrics snapshot (and drained trace
+        events) to the main process over the results channel. Sent after each
+        completed item: row groups are coarse, so the extra ~1KB message is
+        noise next to the payloads, and cumulative snapshots make delivery
+        loss-tolerant (the latest one supersedes all prior)."""
+        if not obs.counters_on():
+            return
+        try:
+            rec = {'pid': os.getpid(), 'metrics': obs.snapshot()}
+            if obs.spans_on():
+                rec['events'] = obs.drain_trace_events()
+            send(_METRICS, None, pickle.dumps(rec, protocol=pickle.HIGHEST_PROTOCOL))
+        except Exception as e:  # noqa: BLE001 - telemetry is best-effort: a shutdown
+            # race here must not resend _DONE/_ERROR and corrupt item accounting
+            logger.debug('telemetry flush failed: %s', e)
+
     worker = worker_class(worker_id, publish, worker_setup_args)
     send(_STARTED, None)
 
@@ -600,6 +660,7 @@ def _worker_bootstrap(worker_id, main_pid, setup_blob, vent_addr, result_addr, c
                 try:
                     worker.process(*args, **kwargs)
                     send(_DONE, current['seq'])
+                    flush_telemetry()
                 except Exception:  # noqa: BLE001 - forwarded to the main process
                     exc = sys.exc_info()[1]
                     logger.exception('Worker %d failed', worker_id)
@@ -611,6 +672,7 @@ def _worker_bootstrap(worker_id, main_pid, setup_blob, vent_addr, result_addr, c
                     # seq-less sentinel: the failed item stays undelivered so a
                     # checkpoint re-reads it (see thread_pool.py)
                     send(_DONE, None)
+                    flush_telemetry()
     finally:
         worker.shutdown()
         if ring is not None:

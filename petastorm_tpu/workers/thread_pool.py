@@ -17,6 +17,7 @@ import queue
 import sys
 import threading
 
+from petastorm_tpu import observability as obs
 from petastorm_tpu.workers.worker_base import (EmptyResultError, WorkerTerminationRequested)
 
 logger = logging.getLogger(__name__)
@@ -70,6 +71,12 @@ class ThreadPool(object):
     def get_results(self):
         """Block until a result is available; raise :class:`EmptyResultError` when
         all ventilated items are processed and no more will be ventilated."""
+        # the pool-wait stage timer is what the stall report decomposes the
+        # loader's reader_wait_s against (docs/observability.md)
+        with obs.stage('pool_wait', cat='pool'):
+            return self._get_results()
+
+    def _get_results(self):
         while True:
             try:
                 kind, seq, payload = self._results_queue.get(block=False)
@@ -129,7 +136,20 @@ class ThreadPool(object):
 
     @property
     def diagnostics(self):
-        return {'output_queue_size': self._results_queue.qsize()}
+        """The unified pool diagnostics schema (docs/observability.md): every
+        pool type reports the same keys and units."""
+        with self._counter_lock:
+            ventilated = self._ventilated_items
+            completed = self._completed_items
+        return {'workers_count': self._workers_count,
+                'items_ventilated': ventilated,
+                'items_completed': completed,
+                'items_in_flight': ventilated - completed,
+                'results_queue_depth': self._results_queue.qsize()}
+
+    def telemetry_snapshots(self):
+        """Worker metrics already live in this process's registry."""
+        return []
 
     @property
     def results_qsize(self):

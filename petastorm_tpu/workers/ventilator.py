@@ -24,6 +24,8 @@ from collections import OrderedDict
 
 import numpy as np
 
+from petastorm_tpu import observability as obs
+
 logger = logging.getLogger(__name__)
 
 
@@ -237,10 +239,13 @@ class ConcurrentVentilator(VentilatorBase):
                         self._seq += 1
                         self._undelivered[seq] = index
                 item = self._items_to_ventilate[index]
-                if self._tag_items:
-                    self._ventilate_fn(**dict(item, _seq=seq))
-                else:
-                    self._ventilate_fn(**item)
+                # stage_ventilate_* counters + (at spans level) one event per
+                # dispatched work item, on the ventilator thread's track
+                with obs.stage('ventilate', cat='ventilator'):
+                    if self._tag_items:
+                        self._ventilate_fn(**dict(item, _seq=seq))
+                    else:
+                        self._ventilate_fn(**item)
 
             with self._in_flight_cv:
                 if counted and self._iterations_remaining is not None:

@@ -27,6 +27,7 @@ from petastorm_tpu.analysis.hashability import HashabilityChecker
 from petastorm_tpu.analysis.jax_purity import JaxPurityChecker
 from petastorm_tpu.analysis.lifecycle import ResourceLifecycleChecker
 from petastorm_tpu.analysis.locks import LockDisciplineChecker
+from petastorm_tpu.analysis.telemetry import TelemetrySpanChecker
 
 import petastorm_tpu
 
@@ -686,6 +687,91 @@ def test_noqa_inside_string_is_ignored():
                 return "# noqa: PT600"
     '''
     assert _codes(HashabilityChecker(), code, relpath='x.py') == ['PT600']
+
+
+# ---------------------------------------------------------------------------
+# PT700 telemetry span hygiene
+# ---------------------------------------------------------------------------
+
+def test_pt700_flags_discarded_span():
+    code = '''
+        from petastorm_tpu import observability as obs
+
+        def process():
+            obs.stage('decode')
+            do_work()
+    '''
+    findings = _findings(TelemetrySpanChecker(), code, relpath='x.py')
+    assert [f.code for f in findings] == ['PT700']
+    assert 'stage' in findings[0].message
+
+
+def test_pt700_flags_unclosed_assigned_span():
+    code = '''
+        def process():
+            t = start_span('decode')
+            do_work()
+    '''
+    assert _codes(TelemetrySpanChecker(), code, relpath='x.py') == ['PT700']
+
+
+def test_pt700_with_block_passes():
+    code = '''
+        from petastorm_tpu import observability as obs
+
+        def process():
+            with obs.stage('decode', cat='worker'):
+                do_work()
+            with obs.span('emit'):
+                emit()
+    '''
+    assert _codes(TelemetrySpanChecker(), code, relpath='x.py') == []
+
+
+def test_pt700_try_finally_close_passes():
+    code = '''
+        def process():
+            t = start_span('decode')
+            try:
+                do_work()
+            finally:
+                t.finish()
+    '''
+    assert _codes(TelemetrySpanChecker(), code, relpath='x.py') == []
+
+
+def test_pt700_escaping_span_passes():
+    # ownership moves: returned, or handed to another call
+    code = '''
+        from petastorm_tpu import observability as obs
+
+        def make_timer():
+            return obs.stage('decode')
+
+        def wrapped():
+            run_with(obs.span('x'))
+    '''
+    assert _codes(TelemetrySpanChecker(), code, relpath='x.py') == []
+
+
+def test_pt700_ignores_non_telemetry_receivers():
+    # re.Match.span() and friends must not match
+    code = '''
+        import re
+
+        def bounds(m):
+            start, end = m.span()
+            return m.span(1)
+    '''
+    assert _codes(TelemetrySpanChecker(), code, relpath='x.py') == []
+
+
+def test_pt700_runs_clean_over_the_observability_subsystem():
+    """The checklist acceptance: the new subsystem itself lints clean under
+    its own rule (every span/timer it opens is context-managed)."""
+    obs_dir = os.path.join(PKG_DIR, 'observability')
+    findings = run_analysis([obs_dir], select=['PT700'])
+    assert findings == [], '\n'.join(f.format() for f in findings)
 
 
 def test_baseline_absorbs_with_multiplicity(tmp_path):

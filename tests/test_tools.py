@@ -115,6 +115,27 @@ class TestThroughput:
                                 '-p', 'dummy', '-w', '1']) == 0
         assert 'samples/sec' in capsys.readouterr().out
 
+    def test_cli_trace_out_and_stall_breakdown(self, synthetic_dataset, tmp_path, capsys):
+        """--trace-out writes a Perfetto-loadable Chrome trace and the stall
+        attribution prints next to the input-stall fraction (the acceptance
+        configuration: --read-method jax --trace-out)."""
+        trace = tmp_path / 'trace.json'
+        assert throughput_main([synthetic_dataset.url, '-f', 'id', 'matrix',
+                                '-m', '8', '-n', '32', '-w', '2',
+                                '-d', 'jax', '--batch-size', '8',
+                                '--trace-out', str(trace)]) == 0
+        out = capsys.readouterr().out
+        assert 'input stall' in out
+        assert 'stall report' in out and 'bottleneck' in out
+        assert 'attributed' in out
+        doc = json.loads(trace.read_text())
+        events = doc['traceEvents']
+        assert events, 'trace must contain span events'
+        for event in events:
+            assert {'ph', 'ts', 'dur', 'pid', 'tid', 'name'} <= set(event)
+        from petastorm_tpu import observability as obs
+        obs.configure('counters')  # restore the process default for later tests
+
 
 class TestMetadataUtil:
     def test_print_schema_and_pieces(self, synthetic_dataset, capsys):
@@ -257,10 +278,16 @@ def test_throughput_fresh_process_respawn(synthetic_dataset):
 
 def test_reader_throughput_jax_method_columnar(synthetic_dataset):
     """read_method='jax' measures the device-feed pipeline (columnar default)
-    and reports a stall fraction."""
+    and reports a stall fraction, plus a stall report attributing >=90% of
+    the measured reader wait to named stages (the acceptance bar)."""
     from petastorm_tpu.tools.throughput import reader_throughput
     res = reader_throughput(synthetic_dataset.url, field_regex=['id', 'matrix'],
                             warmup_cycles=10, measure_cycles=40, workers_count=2,
                             read_method='jax', batch_size=10)
     assert res.samples_per_second > 0
     assert 0.0 <= res.input_stall_fraction <= 1.0
+    report = res.extra['stall_report']
+    assert report['coverage'] >= 0.9
+    assert set(report['stages']) <= {'worker.read_io', 'worker.chunk_fetch',
+                                     'worker.decode', 'worker.transform',
+                                     'consumer.assembly', 'pool.unattributed'}

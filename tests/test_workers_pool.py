@@ -107,9 +107,14 @@ def test_stop_mid_work_does_not_hang():
 
 
 def test_diagnostics():
+    # the unified pool diagnostics schema (docs/observability.md): identical
+    # key set and units for every pool type
     pool = ThreadPool(2)
     pool.start(IdentityWorker)
-    assert 'output_queue_size' in pool.diagnostics
+    diag = pool.diagnostics
+    assert {'workers_count', 'items_ventilated', 'items_completed',
+            'items_in_flight', 'results_queue_depth'} <= set(diag)
+    assert diag['workers_count'] == 2
     pool.stop(); pool.join()
 
 
