@@ -398,6 +398,11 @@ def main(argv=None):
     parser.add_argument('--trace-out', default=None,
                         help='write a Perfetto-loadable Chrome trace of the capture '
                              'here (implies --telemetry spans)')
+    parser.add_argument('--chaos', action='store_true',
+                        help='inject one deterministic transient worker error per '
+                             'measured run (docs/robustness.md): the headline rate '
+                             'then includes recovery overhead, and the output '
+                             'carries the recovery counters')
     # parse_known_args: the capture entry point is also invoked as a plain
     # function from tests (bench.main()) where sys.argv belongs to pytest
     args, _unknown = parser.parse_known_args(argv)
@@ -428,15 +433,31 @@ def main(argv=None):
         wall seconds. On the 1-core bench host an uncontended run sits near
         1.0; a neighbour stealing the core shows directly as a lower share.
         seed=0 pins the shuffle order so every run decodes the IDENTICAL row
-        sequence — row-group order must not be a variance source."""
-        wall0, cpu0 = time.perf_counter(), time.process_time()
-        r = reader_throughput(url, warmup_cycles=200, measure_cycles=8000,
-                              pool_type='thread', workers_count=3,
-                              shuffle_row_groups=True,
-                              read_method='python',
-                              make_reader_fn=functools.partial(make_reader, seed=0)
-                              ).samples_per_second
-        wall = time.perf_counter() - wall0
+        sequence — row-group order must not be a variance source. Under
+        --chaos each run additionally recovers from one injected transient
+        worker error (fresh one-shot state dir per run)."""
+        reader_kwargs = {'seed': 0}
+        if args.chaos:
+            import tempfile
+            from petastorm_tpu import faults
+            faults.install(faults.FaultPlan(
+                error_items=(0,), error_times=1,
+                state_dir=tempfile.mkdtemp(prefix='bench_chaos_')))
+            reader_kwargs.update(on_error='skip', max_item_retries=1)
+        try:
+            wall0, cpu0 = time.perf_counter(), time.process_time()
+            r = reader_throughput(url, warmup_cycles=200, measure_cycles=8000,
+                                  pool_type='thread', workers_count=3,
+                                  shuffle_row_groups=True,
+                                  read_method='python',
+                                  make_reader_fn=functools.partial(make_reader,
+                                                                   **reader_kwargs)
+                                  ).samples_per_second
+            wall = time.perf_counter() - wall0
+        finally:
+            if args.chaos:
+                from petastorm_tpu import faults
+                faults.uninstall()
         return r, (time.process_time() - cpu0) / wall if wall else 0.0
 
     # One full-length measured run is DISCARDED (allocator/CPU-state warmup on
@@ -477,7 +498,17 @@ def main(argv=None):
         'spread_all_runs': round(spread_all, 4),
         'discarded_warm_run': round(discarded, 2),
         'duty': duty,
+        'chaos': _chaos_section() if args.chaos else None,
     }))
+
+
+def _chaos_section():
+    """Recovery counters accumulated across the chaos runs (the pools count
+    into the process-wide telemetry registry)."""
+    from petastorm_tpu import observability as obs
+    counters = obs.snapshot().get('counters', {})
+    return {k: int(counters.get(k, 0)) for k in
+            ('items_requeued', 'items_quarantined', 'worker_restarts')}
 
 
 if __name__ == '__main__':

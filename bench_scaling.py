@@ -49,22 +49,43 @@ def build_store(url, rows, store='png', image_size=160, num_classes=1000):
 
 
 def measure(url, pool, workers, measure_rows=2000, warmup_rows=200,
-            chunk_cache=None, telemetry=None):
-    from petastorm_tpu import make_reader
-    with make_reader(url, reader_pool_type=pool, workers_count=workers,
-                     output='columnar', shuffle_row_groups=True, seed=0,
-                     num_epochs=None, chunk_cache=chunk_cache,
-                     telemetry=telemetry) as reader:
-        it = iter(reader)
-        seen = 0
-        while seen < warmup_rows:
-            seen += len(next(it)[0])
-        seen = 0
-        t0 = time.perf_counter()
-        while seen < measure_rows:
-            seen += len(next(it)[0])
-        dt = time.perf_counter() - t0
-    return seen / dt
+            chunk_cache=None, telemetry=None, chaos=False):
+    from petastorm_tpu import faults, make_reader
+    recovery = None
+    if chaos:
+        # deterministic faults on the real code paths (docs/robustness.md):
+        # process pools take a SIGKILL mid-item (supervised respawn + requeue);
+        # in-process pools take one injected transient error (requeue). Each
+        # run gets a fresh one-shot state dir so every rep recovers once.
+        state_dir = tempfile.mkdtemp(prefix='bench_chaos_')
+        if pool == 'process':
+            plan = faults.FaultPlan(kill_items=(0,), kill_once=True, state_dir=state_dir)
+        else:
+            plan = faults.FaultPlan(error_items=(0,), error_times=1, state_dir=state_dir)
+        faults.install(plan)
+    try:
+        with make_reader(url, reader_pool_type=pool, workers_count=workers,
+                         output='columnar', shuffle_row_groups=True, seed=0,
+                         num_epochs=None, chunk_cache=chunk_cache,
+                         telemetry=telemetry,
+                         on_error='skip' if chaos else 'raise') as reader:
+            it = iter(reader)
+            seen = 0
+            while seen < warmup_rows:
+                seen += len(next(it)[0])
+            seen = 0
+            t0 = time.perf_counter()
+            while seen < measure_rows:
+                seen += len(next(it)[0])
+            dt = time.perf_counter() - t0
+            if chaos:
+                diag = reader.diagnostics
+                recovery = {k: diag.get(k, 0) for k in
+                            ('worker_restarts', 'items_requeued', 'items_quarantined')}
+    finally:
+        if chaos:
+            faults.uninstall()
+    return seen / dt, recovery
 
 
 def main(argv=None):
@@ -89,6 +110,12 @@ def main(argv=None):
                              '--trace-out implies spans)')
     parser.add_argument('--trace-out', default=None,
                         help='write a Perfetto-loadable Chrome trace of the sweep here')
+    parser.add_argument('--chaos', action='store_true',
+                        help='seeded fault injection per run (process pools: one '
+                             'SIGKILLed worker mid-item; thread/dummy: one injected '
+                             'transient error) — the measured rate then INCLUDES '
+                             'recovery overhead, and each point reports the '
+                             'recovery counters (docs/robustness.md)')
     args = parser.parse_args(argv)
     telemetry = args.telemetry
     if args.trace_out and telemetry in (None, 'off', 'counters'):
@@ -114,16 +141,23 @@ def main(argv=None):
 
     for pool in args.pools.split(','):
         for w in (int(x) for x in args.workers.split(',')):
-            runs = [measure(url, pool.strip(), w, measure_rows=args.measure_rows,
-                            warmup_rows=args.warmup_rows, chunk_cache=chunk_cache,
-                            telemetry=telemetry)
-                    for _ in range(args.reps)]
-            print(json.dumps({'metric': 'scaling', 'pool': pool.strip(), 'workers': w,
-                              'store': args.store,
-                              'remote_mock': bool(args.remote_mock),
-                              'samples_per_sec': round(statistics.median(runs), 1),
-                              'runs': [round(r, 1) for r in runs],
-                              'host_cores': os.cpu_count()}), flush=True)
+            results = [measure(url, pool.strip(), w, measure_rows=args.measure_rows,
+                               warmup_rows=args.warmup_rows, chunk_cache=chunk_cache,
+                               telemetry=telemetry, chaos=args.chaos)
+                       for _ in range(args.reps)]
+            runs = [r for r, _ in results]
+            point = {'metric': 'scaling', 'pool': pool.strip(), 'workers': w,
+                     'store': args.store,
+                     'remote_mock': bool(args.remote_mock),
+                     'samples_per_sec': round(statistics.median(runs), 1),
+                     'runs': [round(r, 1) for r in runs],
+                     'host_cores': os.cpu_count()}
+            if args.chaos:
+                recoveries = [rec for _, rec in results if rec]
+                point['chaos'] = {
+                    k: sum(rec.get(k, 0) for rec in recoveries)
+                    for k in ('worker_restarts', 'items_requeued', 'items_quarantined')}
+            print(json.dumps(point), flush=True)
 
     if args.trace_out:
         from petastorm_tpu import observability as obs

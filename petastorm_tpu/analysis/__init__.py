@@ -28,6 +28,10 @@ Rule families (see ``docs/analysis.md`` for bad/good examples):
 * **PT700** telemetry span hygiene — spans/stage timers opened in
   instrumented code must close on all paths (``with`` or try/finally), or
   the trace loses stages and stall attribution under-counts them.
+* **PT701** BaseException containment — worker loops must not swallow
+  ``BaseException``/``KeyboardInterrupt`` without re-raising, forwarding the
+  exception object, or exiting the process: eaten cancellation wedges the
+  pool in ways supervision cannot detect.
 
 Suppress a single finding with ``# noqa: PT###`` (reason encouraged) on its
 line; absorb pre-existing findings with an ``analysis_baseline.json`` (see
@@ -40,7 +44,8 @@ from __future__ import annotations
 from petastorm_tpu.analysis.buffers import NativeBufferChecker
 from petastorm_tpu.analysis.core import (Baseline, Checker, Finding, SourceFile,
                                          collect_sources, load_baseline, run_checkers)
-from petastorm_tpu.analysis.exceptions import ExceptionHygieneChecker
+from petastorm_tpu.analysis.exceptions import (BaseExceptionContainmentChecker,
+                                               ExceptionHygieneChecker)
 from petastorm_tpu.analysis.hashability import HashabilityChecker
 from petastorm_tpu.analysis.jax_purity import JaxPurityChecker
 from petastorm_tpu.analysis.lifecycle import ResourceLifecycleChecker
@@ -56,6 +61,7 @@ ALL_CHECKERS = (
     NativeBufferChecker,
     HashabilityChecker,
     TelemetrySpanChecker,
+    BaseExceptionContainmentChecker,
 )
 
 
@@ -77,7 +83,8 @@ def run_analysis(paths, baseline=None, select=None):
 
 
 __all__ = [
-    'ALL_CHECKERS', 'Baseline', 'Checker', 'ExceptionHygieneChecker', 'Finding',
+    'ALL_CHECKERS', 'Baseline', 'BaseExceptionContainmentChecker', 'Checker',
+    'ExceptionHygieneChecker', 'Finding',
     'HashabilityChecker', 'JaxPurityChecker', 'LockDisciplineChecker',
     'NativeBufferChecker', 'ResourceLifecycleChecker', 'SourceFile',
     'TelemetrySpanChecker', 'collect_sources', 'load_baseline', 'run_analysis',

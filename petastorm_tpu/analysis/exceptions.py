@@ -78,3 +78,79 @@ class ExceptionHygieneChecker(Checker):
                     src, node.lineno,
                     '{} swallows silently — forward to the pool error channel, '
                     'log, re-raise, or annotate why discarding is safe'.format(what))
+
+
+# ---------------------------------------------------------------------------
+# PT701 — BaseException containment in worker loops
+# ---------------------------------------------------------------------------
+
+_UNCATCHABLE = {'BaseException', 'KeyboardInterrupt', 'SystemExit', 'GeneratorExit'}
+
+
+def _catches_uncatchable(handler):
+    """Names from :data:`_UNCATCHABLE` this handler's type clause catches
+    EXPLICITLY (a bare ``except:`` is PT300's concern)."""
+    t = handler.type
+    names = []
+    if isinstance(t, ast.Name) and t.id in _UNCATCHABLE:
+        names.append(t.id)
+    elif isinstance(t, ast.Tuple):
+        names.extend(el.id for el in t.elts
+                     if isinstance(el, ast.Name) and el.id in _UNCATCHABLE)
+    return names
+
+
+def _contains_or_forwards(handler):
+    """True when the handler re-raises (any ``raise``), forwards the bound
+    exception (references its name — e.g. handing it to the pool's error
+    channel for the consumer to re-raise), or terminates the process
+    (``os._exit``/``sys.exit`` — a worker's deliberate suicide)."""
+    bound = handler.name
+    for node in ast.walk(handler):
+        if node is handler.type:
+            continue
+        if isinstance(node, ast.Raise):
+            return True
+        if bound and isinstance(node, ast.Name) and node.id == bound:
+            return True
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in ('_exit', 'exit') \
+                and isinstance(node.func.value, ast.Name) \
+                and node.func.value.id in ('os', 'sys'):
+            return True
+    return False
+
+
+class BaseExceptionContainmentChecker(Checker):
+    """PT701 — worker/consumer loops must not swallow ``BaseException`` /
+    ``KeyboardInterrupt``.
+
+    The supervision layer (docs/robustness.md) is built on failures
+    PROPAGATING: a worker loop that catches ``BaseException`` and carries on
+    converts Ctrl-C into a hung pool (the consumer waits forever for a result
+    the interrupted worker will never send) and converts ``SystemExit`` into a
+    zombie worker the supervisor cannot distinguish from a healthy one.
+    Catching these is only legitimate to clean up and re-raise, to forward the
+    exception object to the error channel, or to deliberately kill the
+    process — anything else is flagged. Stricter than PT300: logging alone
+    does NOT absolve a ``BaseException`` handler."""
+
+    code = 'PT701'
+    name = 'baseexception-containment'
+    description = ('except BaseException/KeyboardInterrupt that neither re-raises, '
+                   'forwards the exception, nor exits the process (worker loops '
+                   'must let cancellation through)')
+    scope = ExceptionHygieneChecker.scope
+
+    def check(self, src):
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            caught = _catches_uncatchable(node)
+            if caught and not _contains_or_forwards(node):
+                yield self.finding(
+                    src, node.lineno,
+                    'except {} swallowed without re-raising — a worker loop that '
+                    'eats cancellation/interpreter-shutdown wedges the pool; '
+                    're-raise, forward the exception object, or os._exit'.format(
+                        '/'.join(caught)))

@@ -1,6 +1,12 @@
 """Framework-level exceptions.
 
 Parity: /root/reference/petastorm/errors.py:16 (``NoDataAvailableError``).
+
+The worker-plane exceptions (``EmptyResultError``,
+``TimeoutWaitingForResultError``, ``WorkerTerminationRequested``) historically
+lived in ``workers/worker_base.py``; they are defined here so the whole
+taxonomy roots at :class:`PetastormTpuError` and a consumer can catch one base
+class. ``workers.worker_base`` keeps import aliases for compatibility.
 """
 
 
@@ -18,3 +24,31 @@ class NoDataAvailableError(PetastormTpuError):
 
 class SchemaError(PetastormTpuError):
     """Raised for schema definition / encoding / decoding violations."""
+
+
+class EmptyResultError(PetastormTpuError):
+    """Raised by ``pool.get_results()`` when all ventilated work has been
+    processed and no further results will arrive."""
+
+
+class TimeoutWaitingForResultError(PetastormTpuError):
+    """Raised when a pool timed out waiting for worker results. The message
+    carries a per-worker liveness snapshot (alive/exitcode, heartbeat age,
+    item ownership) when the pool tracks one."""
+
+
+class WorkerTerminationRequested(PetastormTpuError):
+    """Raised inside a worker's ``process`` by ``publish`` when the pool is
+    stopping, to unwind the worker promptly."""
+
+
+class PoisonItemError(PetastormTpuError):
+    """A single work item failed (errored, or killed its worker process)
+    ``max_item_retries + 1`` consecutive times. Raised under
+    ``on_error='raise'``/``'retry'``; under ``on_error='skip'`` the item is
+    quarantined instead (see ``docs/robustness.md``)."""
+
+
+class WorkerPoolDepletedError(PetastormTpuError):
+    """Worker respawn kept failing and the pool degraded to zero live
+    workers — nothing is left to process ventilated items."""

@@ -1,0 +1,191 @@
+"""Deterministic, seeded fault injection for the worker and storage planes.
+
+The test-suite and ``bench.py``/``bench_scaling.py --chaos`` companion to the
+supervision layer (``docs/robustness.md``): faults fire at well-defined hook
+points in the real code paths — never in test doubles — so what recovers in a
+chaos run is exactly what recovers in production.
+
+Hook points:
+
+* **item hooks** — every pool's worker loop calls :func:`on_item` with the
+  ventilated kwargs immediately before ``worker.process``. A
+  :class:`FaultPlan` keyed on ``piece_index`` can kill the worker process
+  (``SIGKILL`` mid-item, process pools only) or raise
+  :class:`FaultInjectedError` inside decode.
+* **storage hook** — :class:`petastorm_tpu.retry.RetryPolicy` consults
+  :data:`petastorm_tpu.retry.FAULT_POINT` before every attempt; installing a
+  plan with ``storage_fail_first > 0`` makes the first N retried storage
+  operations per process raise a transient ``OSError`` — exercising the
+  backoff path end to end.
+
+Determinism: one-shot faults (``kill_once``, ``error_times``) coordinate
+across worker respawns and spawned processes through sentinel files in
+``state_dir`` (``O_CREAT|O_EXCL``: exactly one attempt wins each shot), so a
+seeded run replays the identical failure schedule every time. Plans are
+picklable and ride the pool's ``worker_setup_args`` into spawned workers.
+
+Usage::
+
+    from petastorm_tpu import faults
+    plan = faults.FaultPlan(kill_items=(3,), state_dir=tmpdir)
+    faults.install(plan)
+    try:
+        ...  # build readers / run benches; workers inherit the plan
+    finally:
+        faults.uninstall()
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+
+from petastorm_tpu.errors import PetastormTpuError
+
+logger = logging.getLogger(__name__)
+
+
+class FaultInjectedError(PetastormTpuError):
+    """The deterministic error :func:`on_item` raises for ``error_items`` (and
+    for ``kill_items`` outside a spawned worker process, where SIGKILL would
+    take down the caller's whole process)."""
+
+
+class FaultPlan(object):
+    """Picklable fault schedule.
+
+    :param kill_items: piece indices whose processing SIGKILLs the worker
+        process mid-item (process pools; degrades to
+        :class:`FaultInjectedError` in thread/dummy pools).
+    :param kill_once: each ``kill_items`` entry fires on its first attempt
+        only — the requeued attempt succeeds (the exactly-once recovery
+        scenario). Requires ``state_dir``.
+    :param error_items: piece indices that raise :class:`FaultInjectedError`
+        inside the worker.
+    :param error_times: fire each ``error_items`` entry only on its first N
+        attempts (requires ``state_dir``); ``None`` = every attempt — a
+        *poison* item.
+    :param storage_fail_first: the first N storage operations per process
+        routed through :meth:`petastorm_tpu.retry.RetryPolicy.call` raise a
+        transient ``OSError(ECONNRESET)``.
+    :param state_dir: directory for cross-process one-shot coordination files.
+    """
+
+    def __init__(self, kill_items=(), kill_once=True, error_items=(),
+                 error_times=None, storage_fail_first=0, state_dir=None):
+        self.kill_items = tuple(kill_items)
+        self.kill_once = bool(kill_once)
+        self.error_items = tuple(error_items)
+        self.error_times = error_times
+        self.storage_fail_first = int(storage_fail_first)
+        self.state_dir = state_dir
+        if (self.kill_items and self.kill_once) or \
+                (self.error_items and self.error_times is not None):
+            if not state_dir:
+                raise ValueError('one-shot faults (kill_once / error_times) need a '
+                                 'state_dir for cross-process coordination')
+
+    def __repr__(self):
+        return ('FaultPlan(kill_items={}, kill_once={}, error_items={}, '
+                'error_times={}, storage_fail_first={})'.format(
+                    self.kill_items, self.kill_once, self.error_items,
+                    self.error_times, self.storage_fail_first))
+
+
+#: the process-wide installed plan (None = fault injection disabled, the
+#: production state: on_item is one attribute load + None compare per ITEM)
+_PLAN = None
+_IN_SPAWNED_WORKER = False
+_storage_faults_fired = 0
+
+
+def install(plan):
+    """Install ``plan`` process-wide and arm the storage hook. Returns the
+    plan. ``install(None)`` is equivalent to :func:`uninstall`."""
+    global _PLAN, _storage_faults_fired
+    from petastorm_tpu import retry
+    _PLAN = plan
+    _storage_faults_fired = 0
+    retry.FAULT_POINT = _storage_fault_point if (
+        plan is not None and plan.storage_fail_first > 0) else None
+    return plan
+
+
+def uninstall():
+    """Remove the installed plan and disarm every hook."""
+    install(None)
+
+
+def get_plan():
+    return _PLAN
+
+
+def mark_in_spawned_worker():
+    """Called by the process pool's worker bootstrap: SIGKILL faults are only
+    honored in a spawned worker process (anywhere else they would kill the
+    consumer — thread/dummy pools degrade kills to raised errors)."""
+    global _IN_SPAWNED_WORKER
+    _IN_SPAWNED_WORKER = True
+
+
+def _claim_one_shot(state_dir, token):
+    """True exactly once per token across all processes sharing state_dir."""
+    try:
+        fd = os.open(os.path.join(state_dir, token), os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+    except FileExistsError:
+        return False
+    except OSError as e:
+        # unusable state dir: fail open (no fault) rather than nondeterminism
+        logger.warning('fault state_dir unusable (%s); skipping one-shot fault', e)
+        return False
+    os.close(fd)
+    return True
+
+
+def on_item(kwargs):
+    """Item-level fault hook, called by every pool's worker loop with the
+    ventilated kwargs right before ``worker.process``. No-op without an
+    installed plan."""
+    plan = _PLAN
+    if plan is None:
+        return
+    piece_index = kwargs.get('piece_index')
+    if piece_index is None:
+        return
+    if piece_index in plan.kill_items:
+        fire = (not plan.kill_once or
+                _claim_one_shot(plan.state_dir, 'kill_{}'.format(piece_index)))
+        if fire:
+            if _IN_SPAWNED_WORKER:
+                logger.warning('fault injection: SIGKILL on piece_index=%s (pid %s)',
+                               piece_index, os.getpid())
+                os.kill(os.getpid(), signal.SIGKILL)
+            raise FaultInjectedError(
+                'injected kill on piece_index={} (degraded to an error: not a '
+                'spawned worker process)'.format(piece_index))
+    if piece_index in plan.error_items:
+        if plan.error_times is None:
+            raise FaultInjectedError('injected poison on piece_index={}'.format(piece_index))
+        for shot in range(plan.error_times):
+            if _claim_one_shot(plan.state_dir, 'err_{}_{}'.format(piece_index, shot)):
+                raise FaultInjectedError(
+                    'injected transient error {}/{} on piece_index={}'.format(
+                        shot + 1, plan.error_times, piece_index))
+
+
+def _storage_fault_point():
+    """The hook :meth:`RetryPolicy.call` invokes before each attempt."""
+    global _storage_faults_fired
+    plan = _PLAN
+    if plan is None or _storage_faults_fired >= plan.storage_fail_first:
+        return
+    _storage_faults_fired += 1
+    import errno
+    raise OSError(errno.ECONNRESET,
+                  'injected transient storage fault {}/{}'.format(
+                      _storage_faults_fired, plan.storage_fail_first))
+
+
+__all__ = ['FaultInjectedError', 'FaultPlan', 'get_plan', 'install',
+           'mark_in_spawned_worker', 'on_item', 'uninstall']

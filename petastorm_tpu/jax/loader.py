@@ -444,6 +444,15 @@ class JaxDataLoader(object):
         })
         return out
 
+    @property
+    def quarantined_items(self):
+        """Structured records of row groups quarantined under
+        ``on_error='skip'`` — passthrough of
+        :attr:`petastorm_tpu.reader.Reader.quarantined_items`, surfaced here
+        so training loops can log data-quality incidents next to their step
+        metrics (docs/robustness.md)."""
+        return getattr(self.reader, 'quarantined_items', [])
+
     def _collate_ngram(self, windows):
         """windows: list of dicts offset -> namedtuple. Returns
         offset -> field -> [B, ...]."""

@@ -183,6 +183,16 @@ class ShmRing(object):
                 return False
             time.sleep(poll_s)
 
+    def has_message(self):
+        """True when a committed message is waiting. NON-consuming probe
+        (``pstpu_ring_next_len`` only reports the next message's length) —
+        the supervisor uses it to tell when a dead worker's ring has drained
+        without stealing the message from the consumer loop. A closed ring
+        reports empty (callers may hold a reference past close)."""
+        if not self._handle:
+            return False
+        return self._lib.pstpu_ring_next_len(self._handle) >= 0
+
     def try_read(self):
         """One message as bytes, or None when the ring is empty."""
         mv = self.try_read_view()

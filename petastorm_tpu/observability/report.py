@@ -85,6 +85,11 @@ def stall_report(diagnostics):
     attributed = sum(stages.values())
     coverage = (attributed / wait) if wait > 0 else 1.0
     bottleneck = max(stages, key=stages.get) if stages else None
+    # supervision/recovery events (docs/robustness.md): restarts and requeues
+    # cost wall time that shows up as pool wait, so a stall report that hides
+    # them would misattribute recovery overhead to IO/decode
+    recovery = {k: int(diagnostics.get(k, 0) or 0)
+                for k in ('worker_restarts', 'items_requeued', 'items_quarantined')}
     return {
         'reader_wait_s': round(wait, 4),
         'reader_wait_fraction': diagnostics.get('reader_wait_fraction'),
@@ -95,6 +100,7 @@ def stall_report(diagnostics):
         'bottleneck': bottleneck,
         'hint': _HINTS.get(bottleneck),
         'worker_busy_s': {k: round(v, 4) for k, v in busy.items()},
+        'recovery': recovery,
     }
 
 
@@ -114,4 +120,11 @@ def format_stall_report(report):
         lines.append('  bottleneck: {}'.format(report['bottleneck']))
         if report.get('hint'):
             lines.append('    hint: {}'.format(report['hint']))
+    recovery = report.get('recovery') or {}
+    if any(recovery.values()):
+        lines.append('  recovery events: {} worker restart(s), {} item(s) requeued, '
+                     '{} quarantined — see docs/robustness.md'.format(
+                         recovery.get('worker_restarts', 0),
+                         recovery.get('items_requeued', 0),
+                         recovery.get('items_quarantined', 0)))
     return '\n'.join(lines)

@@ -43,7 +43,8 @@ logger = logging.getLogger(__name__)
 _VENTILATE_EXTRA_ROWGROUPS = 2
 
 
-def _make_pool(reader_pool_type, workers_count, results_queue_size, serializer=None):
+def _make_pool(reader_pool_type, workers_count, results_queue_size, serializer=None,
+               on_error='raise', max_item_retries=None):
     """Pool construction incl. IPC serializer selection. The reference picks a
     columnar serializer only for its batch readers (reference reader.py:269);
     here EVERY worker publishes column blocks, so the raw-buffer
@@ -52,16 +53,29 @@ def _make_pool(reader_pool_type, workers_count, results_queue_size, serializer=N
     Note: block columns crossing the process boundary arrive as WRITABLE numpy
     views over the IPC message (zero-copy receive: shm-ring bytearray, blob
     copy-on-write mmap; the zmq fallback copies once to match) — the same
-    mutate-in-place affordance thread-pool blocks have."""
+    mutate-in-place affordance thread-pool blocks have.
+    ``on_error``/``max_item_retries`` (docs/robustness.md) are implemented by
+    every pool type, so failure behavior is pool-independent."""
+    policy = {'on_error': _resolve_error_policy(on_error, max_item_retries)}
     if reader_pool_type == 'thread':
-        return ThreadPool(workers_count, results_queue_size)
+        return ThreadPool(workers_count, results_queue_size, **policy)
     if reader_pool_type == 'process':
         return ProcessPool(workers_count, results_queue_size,
-                           serializer=serializer or NumpyBlockSerializer())
+                           serializer=serializer or NumpyBlockSerializer(), **policy)
     if reader_pool_type == 'dummy':
-        return DummyPool()
+        return DummyPool(**policy)
     raise ValueError('Unknown reader_pool_type {!r} (expected thread/process/dummy)'.format(
         reader_pool_type))
+
+
+def _resolve_error_policy(on_error, max_item_retries):
+    """Validate the item-failure knobs EARLY — a typo'd policy must fail
+    before any dataset IO happens, not after listing row groups."""
+    from petastorm_tpu.workers.supervision import ErrorPolicy
+    if isinstance(on_error, ErrorPolicy):
+        return on_error
+    return ErrorPolicy(on_error, **({} if max_item_retries is None
+                                    else {'max_item_retries': max_item_retries}))
 
 
 def _columnar_results_reader_factory(output, batch_size, drop_last, rows_factory):
@@ -114,7 +128,8 @@ def make_reader(dataset_url,
                 resume_state=None,
                 storage_retry_policy=None,
                 chunk_cache=None, chunk_cache_size_limit=None,
-                telemetry=None):
+                telemetry=None,
+                on_error='raise', max_item_retries=None):
     """Reader for datasets written by :func:`materialize_dataset` — rows decoded
     through the stored Unischema's codecs (reference reader.py:50-174).
 
@@ -168,7 +183,22 @@ def make_reader(dataset_url,
         :class:`petastorm_tpu.observability.TelemetryConfig`. ``None`` keeps
         the process's current configuration. Applied process-wide and carried
         into worker processes. See ``docs/observability.md``.
+    :param on_error: item-failure policy, identical across pool types
+        (``docs/robustness.md``): ``'raise'`` (default) surfaces the first
+        worker error to the iterating thread with the worker-side traceback
+        attached; ``'retry'`` re-runs a failed row group up to
+        ``max_item_retries`` times before raising; ``'skip'`` retries, then
+        *quarantines* — the row group is recorded
+        (:attr:`Reader.quarantined_items`), counted in
+        ``diagnostics['items_quarantined']``, and the epoch completes without
+        it. Worker-process DEATH (process pools) is always survived via
+        respawn + requeue regardless of this policy; ``on_error`` only
+        decides what happens when the same item exhausts its retry budget.
+    :param max_item_retries: consecutive failures (errors or worker-killing
+        crashes) one item may cause before the policy's terminal action
+        (default 2 — an item runs at most 3 times).
     """
+    error_policy = _resolve_error_policy(on_error, max_item_retries)
     try:
         schema = dataset_metadata.get_schema(dataset_url, retry_policy=storage_retry_policy)
     except dataset_metadata.PetastormMetadataError:
@@ -197,7 +227,8 @@ def make_reader(dataset_url,
             lambda out_schema: RowResultsQueueReader(out_schema, ngram))
 
     cache = _make_cache(cache_type, cache_location, cache_size_limit, cache_row_size_estimate)
-    pool = _make_pool(reader_pool_type, workers_count, results_queue_size)
+    pool = _make_pool(reader_pool_type, workers_count, results_queue_size,
+                      on_error=error_policy)
     return Reader(dataset_url, schema,
                   worker_class=RowGroupDecoderWorker,
                   results_queue_reader_factory=results_queue_reader_factory,
@@ -230,7 +261,8 @@ def make_batch_reader(dataset_url,
                       resume_state=None,
                       storage_retry_policy=None,
                       chunk_cache=None, chunk_cache_size_limit=None,
-                      telemetry=None):
+                      telemetry=None,
+                      on_error='raise', max_item_retries=None):
     """Columnar reader for ANY Parquet store (reference reader.py:177-289):
     yields one namedtuple of numpy column arrays per row group
     (``batched_output=True``). Schema is inferred from the Arrow schema unless
@@ -247,11 +279,16 @@ def make_batch_reader(dataset_url,
 
     ``telemetry``: pipeline telemetry level ('off' | 'counters' | 'spans' |
     TelemetryConfig) — identical semantics to :func:`make_reader`.
+
+    ``on_error``/``max_item_retries``: item-failure policy ('raise' | 'skip' |
+    'retry', docs/robustness.md) — identical semantics to :func:`make_reader`.
     """
+    error_policy = _resolve_error_policy(on_error, max_item_retries)
     schema = dataset_metadata.infer_or_load_unischema(dataset_url,
                                                       retry_policy=storage_retry_policy)
     cache = _make_cache(cache_type, cache_location, cache_size_limit, cache_row_size_estimate)
-    pool = _make_pool(reader_pool_type, workers_count, results_queue_size)
+    pool = _make_pool(reader_pool_type, workers_count, results_queue_size,
+                      on_error=error_policy)
     results_queue_reader_factory = _columnar_results_reader_factory(
         'columnar', batch_size, drop_last, None)
     return Reader(dataset_url, schema,
@@ -512,10 +549,19 @@ class Reader(object):
         self._pool.join()
 
     @property
+    def quarantined_items(self):
+        """Structured error records of row groups quarantined under
+        ``on_error='skip'`` (docs/robustness.md): dicts with
+        seq/item/attempts/kind ('error'|'crash')/error/traceback/worker_id."""
+        return getattr(self._pool, 'quarantined_items', [])
+
+    @property
     def diagnostics(self):
         """Pipeline health view: the unified pool schema (``workers_count``,
         ``items_ventilated``/``items_completed``/``items_in_flight``,
-        ``results_queue_depth`` — identical keys and units for every pool
+        ``results_queue_depth``, and the recovery counters
+        ``worker_restarts``/``items_requeued``/``items_quarantined`` —
+        identical keys and units for every pool
         type), the telemetry registry's counters/gauges (this process's
         registry merged with the pool workers' shipped snapshots — per-stage
         ``stage_*_s`` timers, page-scan vs Arrow column counts, …), and the

@@ -38,6 +38,12 @@ from petastorm_tpu.pafs_util import DelegatingHandler
 
 logger = logging.getLogger(__name__)
 
+#: fault-injection hook (``petastorm_tpu.faults``): when armed, invoked before
+#: every :meth:`RetryPolicy.call` attempt so seeded chaos runs can exercise
+#: the transient-backoff path; None (the production state) costs one global
+#: load per retried operation — storage ops, never per row
+FAULT_POINT = None
+
 #: errnos that signal a transient network/storage condition
 _TRANSIENT_ERRNOS = frozenset({
     errno.EAGAIN, errno.ETIMEDOUT, errno.ECONNRESET, errno.ECONNABORTED,
@@ -129,6 +135,8 @@ class RetryPolicy(object):
         attempt = 1
         while True:
             try:
+                if FAULT_POINT is not None:
+                    FAULT_POINT()
                 return fn(*args, **kwargs)
             except Exception as e:  # noqa: BLE001 — classifier decides
                 if attempt >= self.max_attempts or not self.classify(e):

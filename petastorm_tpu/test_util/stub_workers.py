@@ -86,6 +86,25 @@ class HardExitWorker(WorkerBase):
         self.publish([item])
 
 
+class CrashOnceWorker(WorkerBase):
+    """SIGKILLs the worker process the FIRST time it sees ``args['crash_on']``
+    (coordinated across respawns through ``args['flag_path']``); every other
+    item — and the retried crash item — passes through. The minimal
+    recover-and-deliver-exactly-once scenario."""
+
+    def process(self, item):
+        import os
+        if item == self.args['crash_on']:
+            try:
+                fd = os.open(self.args['flag_path'], os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            except FileExistsError:
+                pass  # already crashed once; succeed this time
+            else:
+                os.close(fd)
+                os.kill(os.getpid(), 9)
+        self.publish(item)
+
+
 class EnvEchoWorker(WorkerBase):
     """Publishes the value of the env var named in ``args`` as seen INSIDE the
     worker (process pools: the spawned child's environment)."""

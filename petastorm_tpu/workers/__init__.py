@@ -1,12 +1,18 @@
-"""Execution runtime: worker pools and the ventilator.
+"""Execution runtime: worker pools, the ventilator, and worker supervision.
 
 Parity: /root/reference/petastorm/workers_pool/ — a uniform
 ``start/ventilate/get_results/stop/join`` pool protocol over threads, spawned
-processes (ZMQ transport), or the caller thread (dummy), fed by a
+processes (shm-ring/ZMQ transport), or the caller thread (dummy), fed by a
 ``ConcurrentVentilator`` with bounded in-flight work.
+
+Beyond the reference: the process pool supervises its workers (heartbeats,
+exitcode polling, respawn + exactly-once requeue), and every pool implements
+the uniform ``on_error``/``max_item_retries`` item-failure policy with
+poison-item quarantine — see ``docs/robustness.md``.
 """
 
 from petastorm_tpu.workers.worker_base import WorkerBase, EmptyResultError  # noqa: F401
+from petastorm_tpu.workers.supervision import ErrorPolicy  # noqa: F401
 from petastorm_tpu.workers.thread_pool import ThreadPool  # noqa: F401
 from petastorm_tpu.workers.dummy_pool import DummyPool  # noqa: F401
 from petastorm_tpu.workers.process_pool import ProcessPool  # noqa: F401

@@ -7,25 +7,34 @@ it. That is why ``ventilate`` only ENQUEUES tasks: the actual
 (with a ventilator attached, ``ventilate`` is invoked from the ventilator
 thread — processing there would hide the hot loop from per-thread profilers
 AND leave the consumer sleep-polling for results).
+
+Item failures follow the pool-independent ``on_error``/``max_item_retries``
+policy (``workers/supervision.py``) so reader behavior does not change when a
+pipeline is dropped onto the dummy pool for debugging.
 """
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from collections import deque
 
-from petastorm_tpu import observability as obs
-from petastorm_tpu.workers.worker_base import EmptyResultError
+from petastorm_tpu import faults, observability as obs
+from petastorm_tpu.errors import EmptyResultError
+from petastorm_tpu.workers.supervision import (ErrorPolicy, attach_remote_context,
+                                               format_exception_tb, quarantine_record)
 
+logger = logging.getLogger(__name__)
 
 _DATA, _DONE = 0, 1
 
 
 class DummyPool(object):
-    def __init__(self, workers_count=1, results_queue_size=None):
+    def __init__(self, workers_count=1, results_queue_size=None,
+                 on_error='raise', max_item_retries=None):
         self._results = deque()  # (_DATA, seq, payload) | (_DONE, seq, None)
-        self._pending = deque()  # (args, kwargs) not yet processed (_seq rides kwargs)
+        self._pending = deque()  # (args, kwargs, attempts) not yet processed (_seq rides kwargs)
         self._pending_lock = threading.Lock()
         self._worker = None
         self._ventilator = None
@@ -33,6 +42,11 @@ class DummyPool(object):
         self._current_seq = None
         self._ventilated_items = 0
         self._completed_items = 0
+        self._items_requeued = 0
+        self._quarantined = []
+        self._policy = (on_error if isinstance(on_error, ErrorPolicy)
+                        else ErrorPolicy(on_error, **({} if max_item_retries is None
+                                                      else {'max_item_retries': max_item_retries})))
         self.workers_count = workers_count
         # checkpoint plumbing (see thread_pool.py)
         self.last_result_seq = None
@@ -50,7 +64,7 @@ class DummyPool(object):
 
     def ventilate(self, *args, **kwargs):
         with self._pending_lock:
-            self._pending.append((args, kwargs))
+            self._pending.append((args, kwargs, 0))
             self._ventilated_items += 1
 
     def _process_one(self):
@@ -59,21 +73,50 @@ class DummyPool(object):
         with self._pending_lock:
             if not self._pending:
                 return False
-            args, kwargs = self._pending.popleft()
-        kwargs = dict(kwargs)
+            args, orig_kwargs, attempts = self._pending.popleft()
+        kwargs = dict(orig_kwargs)
         self._current_seq = kwargs.pop('_seq', None)
+        completed = True
         try:
+            faults.on_item(kwargs)
             self._worker.process(*args, **kwargs)
             self._results.append((_DONE, self._current_seq, None))
-        except Exception as e:  # noqa: BLE001 - forwarded like Thread/ProcessPool
-            self._worker_error = e
-            if self._ventilator is not None:
-                self._ventilator.stop()
+        except Exception as e:  # noqa: BLE001 - routed through the error policy
+            completed = self._handle_item_failure(e, args, orig_kwargs, attempts + 1)
         finally:
+            if completed:
+                with self._pending_lock:
+                    self._completed_items += 1
+                if self._ventilator is not None:
+                    self._ventilator.processed_item()
+        return True
+
+    def _handle_item_failure(self, exc, args, orig_kwargs, attempts):
+        """Apply the on_error policy. Returns True when the item reached a
+        terminal state (counts complete), False when it was requeued."""
+        seq = self._current_seq
+        if self._policy.should_retry_error(attempts):
+            logger.warning('Item seq=%s failed (attempt %d/%d); requeueing: %s',
+                           seq, attempts, self._policy.max_item_retries + 1, exc)
             with self._pending_lock:
-                self._completed_items += 1
-            if self._ventilator is not None:
-                self._ventilator.processed_item()
+                self._pending.append((args, orig_kwargs, attempts))
+                self._items_requeued += 1
+            obs.count('items_requeued')
+            return False
+        if self._policy.quarantines():
+            record = quarantine_record(seq, attempts, 'error', error=exc,
+                                       tb=format_exception_tb(exc), worker_id=0,
+                                       item={'args': args, 'kwargs': orig_kwargs})
+            with self._pending_lock:
+                self._quarantined.append(record)
+            obs.count('items_quarantined')
+            logger.error('Quarantining item seq=%s after %d failed attempts: %s',
+                         seq, attempts, record['error'])
+            return True
+        attach_remote_context(exc, format_exception_tb(exc), worker_id=0, seq=seq)
+        self._worker_error = exc
+        if self._ventilator is not None:
+            self._ventilator.stop()
         return True
 
     def _pop_ready(self):
@@ -138,16 +181,27 @@ class DummyPool(object):
             self._worker = None
 
     @property
+    def quarantined_items(self):
+        """Structured records of quarantined items (``on_error='skip'``)."""
+        with self._pending_lock:
+            return list(self._quarantined)
+
+    @property
     def diagnostics(self):
         """The unified pool diagnostics schema (docs/observability.md)."""
         with self._pending_lock:
             ventilated = self._ventilated_items
             completed = self._completed_items
+            requeued = self._items_requeued
+            quarantined = len(self._quarantined)
         return {'workers_count': self.workers_count,
                 'items_ventilated': ventilated,
                 'items_completed': completed,
                 'items_in_flight': ventilated - completed,
-                'results_queue_depth': len(self._results)}
+                'results_queue_depth': len(self._results),
+                'worker_restarts': 0,
+                'items_requeued': requeued,
+                'items_quarantined': quarantined}
 
     def telemetry_snapshots(self):
         """Worker metrics already live in this process's registry."""

@@ -14,6 +14,21 @@ fallback (``transport='zmq'``). Ventilation and control stay on ZMQ (ipc://
 endpoints in a private temp dir): they are low-bandwidth and need fan-out/
 fan-in semantics the ring does not provide.
 
+Supervision (``docs/robustness.md``): the pool is its workers' supervisor.
+Every ventilated item gets a pool-assigned *dispatch id*; workers claim the
+item they are processing via a heartbeat message piggybacked on the results
+transport, and the consumer-side idle loop polls ``Process.exitcode`` — so a
+dead worker is detected in O(heartbeat interval), not O(results timeout). On
+death the supervisor respawns the worker (fresh ring for the shm transport)
+and requeues exactly the items the dead worker owned; requeued items get a
+NEW dispatch id, so any straggler message from the old attempt is recognized
+as stale and dropped — each item completes exactly once no matter how many
+times it was retried. Items that keep killing or erroring workers are
+governed by the uniform ``on_error``/``max_item_retries`` policy
+(``workers/supervision.py``): quarantined and skipped, or surfaced as
+:class:`PoisonItemError`. When respawn itself keeps failing the pool sheds
+the broken slot with a loud warning and only fails at zero live workers.
+
 Note: workers are spawned, so (as with any ``multiprocessing`` spawn user)
 scripts creating a ProcessPool at module level must guard the pool-creating code
 with ``if __name__ == '__main__':`` — the child re-imports ``__main__``.
@@ -35,9 +50,11 @@ import uuid
 
 import zmq
 
-from petastorm_tpu import observability as obs
-from petastorm_tpu.serializers import PickleSerializer
-from petastorm_tpu.workers.worker_base import EmptyResultError, TimeoutWaitingForResultError
+from petastorm_tpu import faults, observability as obs
+from petastorm_tpu.errors import (EmptyResultError, PoisonItemError,
+                                  TimeoutWaitingForResultError, WorkerPoolDepletedError)
+from petastorm_tpu.workers.supervision import (ErrorPolicy, attach_remote_context,
+                                               format_exception_tb, quarantine_record)
 
 logger = logging.getLogger(__name__)
 
@@ -48,10 +65,26 @@ _STARTED, _DATA, _DONE, _ERROR, _BLOB = b'S', b'D', b'F', b'E', b'B'
 #: completed item — the same route the payloads travel, so ordering guarantees
 #: the final snapshot arrives before the consumer sees the pool as drained
 _METRICS = b'M'
+#: supervision piggyback on the results channel: liveness + item-ownership
+#: beacons. A worker sends one *claim* heartbeat (busy=dispatch id) before
+#: processing an item, one idle heartbeat after finishing it, and periodic
+#: idle heartbeats while waiting for work — so the supervisor always knows
+#: which item a worker holds and how stale its liveness information is.
+_HEARTBEAT = b'H'
 
 _WORKER_STARTUP_TIMEOUT_S = 30
 _DEFAULT_RESULTS_HWM = 50
 _DEFAULT_RING_BYTES = 64 << 20
+#: default worker heartbeat period; death detection latency is one supervise
+#: tick (<= 100ms) for exitcode-visible deaths, one interval for wedge age
+_DEFAULT_HEARTBEAT_S = 0.5
+#: wait after a death before requeueing its orphaned items: in-transit
+#: messages from the dead worker (zmq delivery, ring leftovers) land first,
+#: so an item that actually completed is never re-run
+_REQUEUE_GRACE_S = 0.25
+#: consecutive startup deaths (never claimed an item) before a worker slot is
+#: declared broken and shed
+_MAX_RESPAWN_FAILURES = 3
 #: payloads at least this large ride the per-message /dev/shm blob sidechannel
 #: (when the serializer supports single-copy serialize_into): the worker writes
 #: the message straight into an mmapped tmpfs file and only the file name
@@ -146,7 +179,9 @@ def _ring_unpack(view):
 class ProcessPool(object):
     def __init__(self, workers_count, results_queue_size=_DEFAULT_RESULTS_HWM, serializer=None,
                  results_timeout_s=None, transport=None, ring_bytes=_DEFAULT_RING_BYTES,
-                 blob_threshold_bytes=_DEFAULT_BLOB_THRESHOLD):
+                 blob_threshold_bytes=_DEFAULT_BLOB_THRESHOLD,
+                 on_error='raise', max_item_retries=None,
+                 supervision=True, heartbeat_interval_s=_DEFAULT_HEARTBEAT_S):
         """``results_timeout_s``: raise if no worker message arrives within this
         many seconds (None = block indefinitely, matching ThreadPool).
         ``transport``: 'shm' (first-party C++ shared-memory rings) | 'zmq' |
@@ -155,9 +190,16 @@ class ProcessPool(object):
         serialized row-group payload must fit.
         ``blob_threshold_bytes``: payloads >= this ride the single-copy
         /dev/shm blob sidechannel when the serializer supports
-        ``serialize_into`` (0 disables)."""
+        ``serialize_into`` (0 disables).
+        ``on_error``/``max_item_retries``: the uniform item-failure policy
+        ('raise' | 'skip' | 'retry'; see ``workers/supervision.py``).
+        ``supervision``: heartbeat + exitcode monitoring with respawn/requeue;
+        disabling it restores the legacy behavior where a dead worker strands
+        its items until ``results_timeout_s``.
+        ``heartbeat_interval_s``: worker liveness beacon period."""
         self._workers_count = workers_count
         self._results_hwm = results_queue_size
+        from petastorm_tpu.serializers import PickleSerializer
         self._serializer = serializer or PickleSerializer()
         self._results_timeout_s = results_timeout_s
         if transport is None:
@@ -168,10 +210,16 @@ class ProcessPool(object):
         self._transport = transport
         self._ring_bytes = ring_bytes
         self._blob_threshold = blob_threshold_bytes
+        self._policy = (on_error if isinstance(on_error, ErrorPolicy)
+                        else ErrorPolicy(on_error, **({} if max_item_retries is None
+                                                      else {'max_item_retries': max_item_retries})))
+        self._supervision = bool(supervision)
+        self._heartbeat_interval_s = heartbeat_interval_s
         self._blob_dir = None
-        self._rings = []
+        self._rings = []            # per-slot ring (or None); index == worker_id
+        self._retired_rings = []    # dead workers' rings, polled until drained
         self._context = None
-        self._processes = []
+        self._processes = []        # per-slot Process (None = slot shed)
         self._ventilator = None
         self._ventilated_items = 0
         self._completed_items = 0
@@ -181,7 +229,32 @@ class ProcessPool(object):
         # get_results() poll loop against the join() drain so two threads never
         # race pstpu_ring_read on the same ring.
         self._ring_lock = threading.Lock()
-        # checkpoint plumbing (see thread_pool.py): messages carry the item seq
+        # item ownership/accounting state — _state_lock guards everything the
+        # ventilator thread (ventilate) and the consumer thread (get_results/
+        # supervise) both touch; callbacks into the ventilator always run with
+        # it RELEASED (single lock, no ordering cycles)
+        self._state_lock = threading.Lock()
+        self._next_dispatch = 0
+        self._inflight = {}         # dispatch id -> item record dict
+        self._orphans = {}          # dispatch id -> monotonic death time
+        self._quarantined = []
+        self._items_requeued = 0
+        self._worker_restarts = 0
+        # zmq PUSH sockets are not thread-safe: the ventilator thread and the
+        # consumer-side requeue both send on _ventilator_send
+        self._vent_lock = threading.Lock()
+        # supervision bookkeeping (consumer thread only)
+        self._worker_state = {}     # worker_id -> liveness/ownership view
+        self._heartbeats_received = 0  # overhead accounting (tests assert the bound)
+        self._dying = {}            # worker_id -> {'proc', 'ring', 'at'} awaiting drain
+        self._respawn_failures = {}
+        self._deaths_seen = False
+        self._idle_sweep_since = None
+        self._last_supervise = 0.0
+        self._spawn_info = None
+        self._run_id = uuid.uuid4().hex[:12]
+        # checkpoint plumbing (see thread_pool.py): data messages resolve to
+        # the ventilator-assigned item seq through the in-flight records
         self.last_result_seq = None
         self.done_callback = None
         # pid -> latest cumulative metrics snapshot from that worker process
@@ -191,6 +264,9 @@ class ProcessPool(object):
     @property
     def transport(self):
         return self._transport
+
+    def _ring_name(self, worker_id, generation):
+        return '/pstpu_{}_{}_{}g{}'.format(os.getpid(), self._run_id, worker_id, generation)
 
     def _create_rings(self, ring_names):
         from petastorm_tpu.native.shm_ring import ShmRing
@@ -209,15 +285,39 @@ class ProcessPool(object):
             raise OSError(
                 '/dev/shm has {} bytes free; {} rings of {} bytes will not fit'.format(
                     avail, self._workers_count, self._ring_bytes))
-        run_id = uuid.uuid4().hex[:12]
         for worker_id in range(self._workers_count):
-            name = '/pstpu_{}_{}_{}'.format(os.getpid(), run_id, worker_id)
-            self._rings.append(ShmRing.create(name, self._ring_bytes))
+            name = self._ring_name(worker_id, 0)
+            with self._ring_lock:
+                self._rings.append(ShmRing.create(name, self._ring_bytes))
             ring_names[worker_id] = name
 
     @property
     def workers_count(self):
         return self._workers_count
+
+    def workers_alive(self):
+        """Live worker processes (slots shed by repeated respawn failure are
+        None and do not count)."""
+        return sum(1 for p in self._processes if p is not None and p.is_alive())
+
+    def _all_slots_shed(self):
+        """True when every worker slot was permanently given up on — the only
+        state in which the supervised pool declares itself depleted (a dead
+        worker mid-respawn does NOT count: it is about to come back)."""
+        return bool(self._processes) and all(p is None for p in self._processes)
+
+    def _spawn_worker(self, worker_id, ring_name):
+        setup_blob, vent_addr, result_addr, control_addr = self._spawn_info
+        ctx = multiprocessing.get_context('spawn')
+        p = ctx.Process(
+            target=_worker_bootstrap,
+            args=(worker_id, os.getpid(), setup_blob, vent_addr, result_addr, control_addr,
+                  self._results_hwm, ring_name,
+                  self._blob_dir, self._blob_threshold, self._workers_count,
+                  self._heartbeat_interval_s if self._supervision else None),
+            daemon=True)
+        p.start()
+        return p
 
     def start(self, worker_class, worker_setup_args=None, ventilator=None):
         if self._processes:
@@ -245,12 +345,15 @@ class ProcessPool(object):
                 # catchable error by the pre-faulting create, not SIGBUS):
                 # degrade to the zmq transport rather than dying later.
                 logger.warning('shm ring allocation failed (%s); falling back to zmq transport', e)
-                for ring in self._rings:
-                    ring.close()
-                self._rings = []
+                with self._ring_lock:
+                    for ring in self._rings:
+                        ring.close()
+                    self._rings = []
                 ring_names = [None] * self._workers_count
                 self._transport = 'zmq'
         if self._transport == 'zmq':
+            with self._ring_lock:
+                self._rings = [None] * self._workers_count
             self._results_receive = self._context.socket(zmq.PULL)
             self._results_receive.setsockopt(zmq.RCVHWM, self._results_hwm)
             self._results_receive.bind(result_addr)
@@ -273,20 +376,19 @@ class ProcessPool(object):
             except OSError:
                 self._blob_dir = None
 
+        # an installed fault plan rides the setup args into spawned workers,
+        # exactly like the telemetry config
+        if isinstance(worker_setup_args, dict) and 'fault_plan' not in worker_setup_args \
+                and faults.get_plan() is not None:
+            worker_setup_args = dict(worker_setup_args, fault_plan=faults.get_plan())
+
         # spawn (NOT fork): forked children inherit locked mutexes/threads from
         # Arrow, JAX, etc. (reference process_pool.py:15-17 for the JVM analog)
-        ctx = multiprocessing.get_context('spawn')
         setup_blob = pickle.dumps((worker_class, worker_setup_args, self._serializer),
                                   protocol=pickle.HIGHEST_PROTOCOL)
+        self._spawn_info = (setup_blob, vent_addr, result_addr, control_addr)
         for worker_id in range(self._workers_count):
-            p = ctx.Process(
-                target=_worker_bootstrap,
-                args=(worker_id, os.getpid(), setup_blob, vent_addr, result_addr, control_addr,
-                      self._results_hwm, ring_names[worker_id],
-                      self._blob_dir, self._blob_threshold, self._workers_count),
-                daemon=True)
-            p.start()
-            self._processes.append(p)
+            self._processes.append(self._spawn_worker(worker_id, ring_names[worker_id]))
 
         # startup handshake: wait until every worker connected and reported in
         deadline = time.monotonic() + _WORKER_STARTUP_TIMEOUT_S
@@ -298,8 +400,11 @@ class ProcessPool(object):
                     'Only {} of {} workers started within {}s'.format(
                         started, self._workers_count, _WORKER_STARTUP_TIMEOUT_S))
             msg = self._poll_message(100)
-            if msg is not None and msg[0] == _STARTED:
-                started += 1
+            if msg is not None:
+                if msg[0] == _STARTED:
+                    started += 1
+                elif msg[0] == _HEARTBEAT:
+                    self._note_heartbeat(msg[2])
 
         if ventilator is not None:
             self._ventilator = ventilator
@@ -307,7 +412,8 @@ class ProcessPool(object):
 
     def _poll_message(self, timeout_ms):
         """Next (kind, seq, payload_bytes) from the results transport, or None
-        after ``timeout_ms``. shm: round-robin over the per-worker rings."""
+        after ``timeout_ms``. shm: round-robin over the per-worker rings
+        (including dead workers' retired rings until they drain)."""
         if self._transport == 'zmq':
             if not self._results_receive.poll(timeout_ms):
                 return None
@@ -323,6 +429,12 @@ class ProcessPool(object):
         while True:
             with self._ring_lock:
                 for ring in self._rings:
+                    if ring is None:
+                        continue
+                    view = ring.try_read_view()
+                    if view is not None:
+                        return _ring_unpack(view)
+                for ring in self._retired_rings:
                     view = ring.try_read_view()
                     if view is not None:
                         return _ring_unpack(view)
@@ -334,8 +446,50 @@ class ProcessPool(object):
             sleep_s = min(sleep_s * 2, 0.002)
 
     def ventilate(self, *args, **kwargs):
-        self._ventilated_items += 1
-        self._ventilator_send.send_pyobj((args, kwargs))
+        seq = kwargs.pop('_seq', None)
+        with self._state_lock:
+            self._ventilated_items += 1
+            d = self._next_dispatch
+            self._next_dispatch += 1
+            self._inflight[d] = {'seq': seq, 'args': args, 'kwargs': kwargs,
+                                 'attempts': 0, 'published': False}
+        with self._vent_lock:
+            self._ventilator_send.send_pyobj((d, args, kwargs))
+
+    def _requeue(self, d, rec):
+        """Re-dispatch an in-flight item under a NEW dispatch id (any straggler
+        message tagged with the old id is thereby stale and ignored). Does NOT
+        touch the ventilated/completed counters: the logical item is still the
+        same in-flight unit of work."""
+        with self._state_lock:
+            if self._inflight.get(d) is not rec:
+                return  # resolved concurrently
+            del self._inflight[d]
+            nd = self._next_dispatch
+            self._next_dispatch += 1
+            rec['attempts'] += 1
+            rec['published'] = False
+            self._inflight[nd] = rec
+            self._items_requeued += 1
+        obs.count('items_requeued')
+        with self._vent_lock:
+            self._ventilator_send.send_pyobj((nd, rec['args'], rec['kwargs']))
+
+    def _complete(self, d, rec, delivered):
+        """Exactly-once completion accounting for one logical item:
+        ``delivered`` marks whether its payload reached the consumer (drives
+        the checkpoint ``done_callback``); either way the epoch's
+        completed-items count and the ventilator's in-flight budget advance
+        exactly once."""
+        with self._state_lock:
+            if d is not None and self._inflight.pop(d, None) is None:
+                return  # stale duplicate (e.g. _DONE from a pre-requeue attempt)
+            self._completed_items += 1
+        if self._ventilator is not None:
+            self._ventilator.processed_item()
+        if delivered and rec is not None and rec['seq'] is not None \
+                and self.done_callback is not None:
+            self.done_callback(rec['seq'])
 
     def get_results(self, timeout_s=None):
         with obs.stage('pool_wait', cat='pool'):
@@ -346,32 +500,365 @@ class ProcessPool(object):
         deadline = (time.monotonic() + timeout_s) if timeout_s is not None else None
         while True:
             msg = self._poll_message(50)
+            if self._supervision and self._processes and (
+                    msg is None or time.monotonic() - self._last_supervise > 0.2):
+                self._supervise(idle=msg is None)
             if msg is None:
                 if self._all_done():
                     raise EmptyResultError()
+                if self._supervision and self._all_slots_shed():
+                    raise WorkerPoolDepletedError(
+                        'All {} worker slots are dead and respawn kept failing; {} items '
+                        'in flight will never complete'.format(
+                            self._workers_count,
+                            self._ventilated_items - self._completed_items))
                 if deadline is not None and time.monotonic() > deadline:
-                    raise TimeoutWaitingForResultError(
-                        'No results from worker processes in {}s; {} items in flight'.format(
-                            timeout_s, self._ventilated_items - self._completed_items))
+                    raise TimeoutWaitingForResultError(self._timeout_message(timeout_s))
                 continue
-            kind, seq, payload = msg
-            if kind == _DATA:
-                self.last_result_seq = seq
-                return self._serializer.deserialize(payload)
-            elif kind == _BLOB:
-                self.last_result_seq = seq
+            kind, d, payload = msg
+            if kind == _DATA or kind == _BLOB:
+                with self._state_lock:
+                    rec = self._inflight.get(d) if d is not None else None
+                if d is not None and rec is None:
+                    # stale duplicate from a requeued attempt: the item was (or
+                    # will be) delivered under its new dispatch id
+                    if kind == _BLOB:
+                        try:
+                            os.unlink(bytes(payload).decode())
+                        except OSError:
+                            pass
+                    continue
+                if rec is not None:
+                    rec['published'] = True
+                self.last_result_seq = rec['seq'] if rec is not None else None
+                if kind == _DATA:
+                    return self._serializer.deserialize(payload)
                 return self._serializer.deserialize(_read_blob(bytes(payload).decode()))
             elif kind == _DONE:
-                self._completed_items += 1
-                if self._ventilator is not None:
-                    self._ventilator.processed_item()
-                if seq is not None and self.done_callback is not None:
-                    self.done_callback(seq)
+                self._clear_claim(d)
+                with self._state_lock:
+                    rec = self._inflight.get(d) if d is not None else None
+                if d is not None and rec is None:
+                    continue  # stale duplicate
+                self._complete(d, rec, delivered=True)
             elif kind == _METRICS:
                 self._absorb_telemetry(payload)
+            elif kind == _HEARTBEAT:
+                self._note_heartbeat(payload)
             elif kind == _ERROR:
-                raise pickle.loads(payload)
+                self._clear_claim(d)
+                exc = self._handle_worker_error(d, payload)
+                if exc is not None:
+                    raise exc
             # late _STARTED messages are ignored
+
+    def _handle_worker_error(self, d, payload):
+        """Apply the item-failure policy to a worker-raised exception. Returns
+        an exception to raise to the consumer, or None when the item was
+        requeued/quarantined and iteration continues."""
+        try:
+            err = pickle.loads(bytes(payload))
+        except Exception as e:  # noqa: BLE001 - a malformed error report must still fail loudly
+            err = RuntimeError('worker error report could not be unpickled: {}'.format(e))
+        if isinstance(err, dict):
+            exc, tb = err.get('exc'), err.get('tb')
+            worker_id, pid = err.get('worker_id'), err.get('pid')
+        else:  # legacy payload: a bare pickled exception
+            exc, tb, worker_id, pid = err, None, None, None
+        with self._state_lock:
+            rec = self._inflight.get(d) if d is not None else None
+        if d is not None and rec is None:
+            return None  # stale report from a pre-requeue attempt
+        attempts = (rec['attempts'] if rec is not None else 0) + 1
+        seq = rec['seq'] if rec is not None else None
+        if rec is not None and self._policy.should_retry_error(attempts):
+            logger.warning('Worker %s failed on item seq=%s (attempt %d/%d); requeueing: %s',
+                           worker_id, seq, attempts, self._policy.max_item_retries + 1, exc)
+            self._requeue(d, rec)
+            return None
+        if rec is not None and self._policy.quarantines():
+            self._quarantine(d, rec, kind='error', error=exc, tb=tb, worker_id=worker_id)
+            return None
+        # 'raise' (or retry budget exhausted): the item completes undelivered —
+        # a checkpoint will re-read it — and the failure surfaces with its
+        # worker-side traceback attached
+        self._complete(d, rec, delivered=False)
+        return attach_remote_context(exc, tb, worker_id=worker_id, seq=seq, pid=pid)
+
+    def _quarantine(self, d, rec, kind, error=None, tb=None, worker_id=None):
+        record = quarantine_record(rec['seq'], rec['attempts'] + 1, kind, error=error,
+                                   tb=tb, worker_id=worker_id,
+                                   item={'args': rec['args'], 'kwargs': rec['kwargs']})
+        with self._state_lock:
+            self._quarantined.append(record)
+        obs.count('items_quarantined')
+        logger.error('Quarantining item seq=%s after %d failed attempts (%s): %s',
+                     record['seq'], record['attempts'], kind, record['error'])
+        self._complete(d, rec, delivered=False)
+
+    # -- supervision --------------------------------------------------------
+
+    def _clear_claim(self, d):
+        """A _DONE/_ERROR for dispatch ``d`` implicitly releases its owner's
+        claim (the results transport is ordered, so the claim beacon always
+        precedes its item's completion) — saving the worker a trailing idle
+        beacon per item. Also counts as a liveness proof."""
+        if d is None:
+            return
+        for state in self._worker_state.values():
+            if state.get('busy') == d:
+                state['busy'] = None
+                state['last_hb'] = time.monotonic()
+                return
+
+    def _note_heartbeat(self, payload):
+        try:
+            hb = pickle.loads(bytes(payload))
+            worker_id = hb['worker_id']
+        except Exception as e:  # noqa: BLE001 - malformed beacon must never kill the read loop
+            logger.debug('dropping malformed heartbeat: %s', e)
+            return
+        self._heartbeats_received += 1
+        state = self._worker_state.setdefault(worker_id, {})
+        state['pid'] = hb.get('pid')
+        state['busy'] = hb.get('busy')
+        state['last_hb'] = time.monotonic()
+        if state['busy'] is not None:
+            state['claimed_since_spawn'] = True
+
+    def _supervise(self, idle):
+        """The supervisor tick, run on the consumer thread from the results
+        loop: poll exitcodes, respawn the dead, resolve orphaned items, and
+        sweep items lost in a dead worker's unclaimed dispatch pipe."""
+        now = time.monotonic()
+        self._last_supervise = now
+        for worker_id, p in enumerate(self._processes):
+            if p is not None and p.exitcode is not None and worker_id not in self._dying:
+                self._begin_worker_death(worker_id, p, now)
+        for worker_id in list(self._dying):
+            if self._death_drained(worker_id, now):
+                info = self._dying.pop(worker_id)
+                self._finish_worker_death(worker_id, info, time.monotonic())
+        if self._worker_state:
+            ages = [now - s['last_hb'] for s in self._worker_state.values() if 'last_hb' in s]
+            if ages:
+                obs.gauge_set('heartbeat_age_s', round(max(ages), 3))
+        if self._orphans:
+            self._resolve_orphans(now)
+        if idle:
+            self._sweep_lost_items(now)
+        else:
+            self._idle_sweep_since = None
+
+    def _begin_worker_death(self, worker_id, p, now):
+        """Stage 1 of death handling: retire the dead worker's ring so the
+        normal poll loop drains its final committed messages (shared memory
+        outlives the writer; a partially-written message is invisible — the
+        writer commits by index advance). Ownership/respawn decisions wait for
+        :meth:`_death_drained` — deciding off a stale worker_state while the
+        worker's final claim beacon still sits in its ring would misattribute
+        the crash."""
+        p.join()  # reap the zombie
+        logger.warning('Worker %d (pid %s) died with exitcode %s; draining its results',
+                       worker_id, p.pid, p.exitcode)
+        self._deaths_seen = True
+        old_ring = self._rings[worker_id] if worker_id < len(self._rings) else None
+        if old_ring is not None:
+            with self._ring_lock:
+                self._retired_rings.append(old_ring)
+                self._rings[worker_id] = None
+        self._dying[worker_id] = {'proc': p, 'ring': old_ring, 'at': now}
+
+    def _death_drained(self, worker_id, now):
+        """All in-transit messages from the dead worker have been consumed:
+        shm — its retired ring is empty (non-consuming probe); zmq — a grace
+        period passed (the shared PULL buffer has no per-worker view)."""
+        info = self._dying[worker_id]
+        ring = info['ring']
+        if ring is not None:
+            with self._ring_lock:
+                return not ring.has_message()
+        return now - info['at'] >= _REQUEUE_GRACE_S
+
+    def _finish_worker_death(self, worker_id, info, now):
+        """Stage 2: with the dead worker's messages fully absorbed, its
+        ownership view is current — orphan what it held, account the respawn
+        budget, and bring up a replacement on a FRESH ring."""
+        p = info['proc']
+        state = self._worker_state.get(worker_id, {})
+        owned = state.get('busy')
+        if owned is not None:
+            logger.warning('Dead worker %d owned item dispatch=%s; scheduling requeue',
+                           worker_id, owned)
+            self._orphans.setdefault(owned, now)
+        # startup death (never claimed an item since this spawn) counts toward
+        # the slot's respawn-failure budget; a death while working is
+        # item-/environment-attributed and resets it
+        if state.get('claimed_since_spawn'):
+            self._respawn_failures[worker_id] = 0
+        else:
+            self._respawn_failures[worker_id] = self._respawn_failures.get(worker_id, 0) + 1
+        new_ring_name = None
+        if self._respawn_failures[worker_id] >= _MAX_RESPAWN_FAILURES:
+            self._processes[worker_id] = None
+            logger.error(
+                'Worker slot %d died %d consecutive times at startup; shedding the slot. '
+                'Pool degraded to %d live workers (of %d configured).',
+                worker_id, self._respawn_failures[worker_id],
+                self.workers_alive(), self._workers_count)
+            self._worker_state.pop(worker_id, None)
+            return
+        try:
+            if info['ring'] is not None:
+                from petastorm_tpu.native.shm_ring import ShmRing
+                new_ring_name = self._ring_name(worker_id, self._worker_restarts + 1)
+                new_ring = ShmRing.create(new_ring_name, self._ring_bytes)
+                with self._ring_lock:
+                    self._rings[worker_id] = new_ring
+            self._processes[worker_id] = self._spawn_worker(worker_id, new_ring_name)
+        except Exception as e:  # noqa: BLE001 - respawn failure degrades, never kills the consumer
+            with self._ring_lock:
+                ring, self._rings[worker_id] = self._rings[worker_id], None
+            if ring is not None:
+                ring.close()
+            self._processes[worker_id] = None
+            self._respawn_failures[worker_id] = _MAX_RESPAWN_FAILURES
+            logger.error('Respawning worker %d failed (%s); shedding the slot. '
+                         'Pool degraded to %d live workers.', worker_id, e, self.workers_alive())
+            self._worker_state.pop(worker_id, None)
+            return
+        self._worker_restarts += 1
+        obs.count('worker_restarts')
+        self._worker_state[worker_id] = {'pid': self._processes[worker_id].pid, 'busy': None,
+                                         'last_hb': now, 'claimed_since_spawn': False}
+        logger.warning('Respawned worker %d as pid %s', worker_id,
+                       self._processes[worker_id].pid)
+
+    def _retired_rings_drained(self):
+        """True when no retired ring holds an unconsumed message (NON-consuming
+        probe — the messages belong to the consumer loop); empty retired rings
+        are closed and dropped along the way."""
+        with self._ring_lock:
+            for ring in list(self._retired_rings):
+                if not ring.has_message():
+                    ring.close()
+                    self._retired_rings.remove(ring)
+                else:
+                    return False
+        return True
+
+    def _resolve_orphans(self, now):
+        """Requeue (or quarantine/poison) the items dead workers owned, once
+        the dead workers' in-transit messages have had a chance to land —
+        an item whose result already arrived is completed, not re-run."""
+        if not self._retired_rings_drained():
+            return
+        for d, died_at in list(self._orphans.items()):
+            if now - died_at < _REQUEUE_GRACE_S:
+                continue
+            self._orphans.pop(d)
+            with self._state_lock:
+                rec = self._inflight.get(d)
+            if rec is None:
+                continue  # its _DONE landed during the grace window
+            if rec['published']:
+                # payload was delivered; only the completion sentinel was lost
+                self._complete(d, rec, delivered=True)
+                continue
+            self._fail_crashed_item(d, rec)
+
+    def _fail_crashed_item(self, d, rec):
+        attempts = rec['attempts'] + 1
+        if self._policy.should_retry_crash(attempts):
+            logger.warning('Requeueing item seq=%s lost to a dead worker (attempt %d/%d)',
+                           rec['seq'], attempts, self._policy.max_item_retries + 1)
+            self._requeue(d, rec)
+            return
+        if self._policy.quarantines():
+            self._quarantine(d, rec, kind='crash',
+                             error=RuntimeError('item killed {} consecutive worker '
+                                                'processes'.format(attempts)))
+            return
+        self._complete(d, rec, delivered=False)
+        raise PoisonItemError(
+            'Item seq={} (kwargs={}) killed {} consecutive worker processes; '
+            "use on_error='skip' to quarantine poison items instead".format(
+                rec['seq'], rec['kwargs'], attempts))
+
+    def _sweep_lost_items(self, now):
+        """Recover items lost in a dead worker's UNCLAIMED dispatch pipe: zmq
+        PUSH had already routed them to the dead peer, so no claim ever named
+        an owner. Detection is by elimination — a death happened, every live
+        worker has been provably idle (fresh heartbeats, no claim) for a full
+        quiet window, the transport is silent, yet items remain in flight:
+        nothing can ever run them, so requeue. Requeued items get new dispatch
+        ids, so even a mis-judged sweep delivers exactly once (the stale
+        attempt's messages are dropped)."""
+        if not self._deaths_seen or self._orphans or not self._supervision:
+            return
+        with self._state_lock:
+            in_flight = len(self._inflight)
+        if in_flight == 0 or not self._retired_rings_drained():
+            self._idle_sweep_since = None
+            return
+        hb = self._heartbeat_interval_s or _DEFAULT_HEARTBEAT_S
+        for worker_id, p in enumerate(self._processes):
+            if p is None:
+                continue
+            state = self._worker_state.get(worker_id)
+            if state is None or state.get('busy') is not None \
+                    or now - state.get('last_hb', 0) > 2 * hb + 0.5:
+                self._idle_sweep_since = None
+                return
+        if self._idle_sweep_since is None:
+            self._idle_sweep_since = now
+            return
+        if now - self._idle_sweep_since < max(2 * hb, 1.0):
+            return
+        self._idle_sweep_since = None
+        with self._state_lock:
+            lost = list(self._inflight.items())
+        logger.warning('Sweeping %d item(s) lost in dead workers\' dispatch pipes', len(lost))
+        for d, rec in lost:
+            if rec['published']:
+                self._complete(d, rec, delivered=True)
+            else:
+                self._fail_crashed_item(d, rec)
+
+    def _timeout_message(self, timeout_s):
+        """The per-worker liveness snapshot for TimeoutWaitingForResultError:
+        a bare 'N items in flight' forces the operator to re-run under a
+        debugger; alive/exitcode + heartbeat age + ownership usually names the
+        culprit directly."""
+        with self._state_lock:
+            in_flight = self._ventilated_items - self._completed_items
+            owned = {d: rec['seq'] for d, rec in self._inflight.items()}
+        now = time.monotonic()
+        lines = ['No results from worker processes in {}s; {} items in flight.'.format(
+            timeout_s, in_flight), 'Worker liveness:']
+        for worker_id, p in enumerate(self._processes):
+            if p is None:
+                lines.append('  worker {}: slot shed after repeated respawn failures'.format(
+                    worker_id))
+                continue
+            state = self._worker_state.get(worker_id, {})
+            if p.exitcode is not None:
+                status = 'DEAD exitcode={}'.format(p.exitcode)
+            else:
+                status = 'alive'
+            hb_age = ('{:.1f}s ago'.format(now - state['last_hb'])
+                      if state.get('last_hb') else 'never')
+            busy = state.get('busy')
+            owning = ('idle' if busy is None else
+                      'processing item seq={}'.format(owned.get(busy, '?')))
+            lines.append('  worker {}: pid {} {}, last heartbeat {}, {}'.format(
+                worker_id, p.pid, status, hb_age, owning))
+        if not self._supervision:
+            lines.append('  (supervision disabled: no heartbeat/ownership data)')
+        lines.append('Run petastorm-tpu-diagnose against this dataset for a full stall report.')
+        return '\n'.join(lines)
+
+    # -- telemetry ----------------------------------------------------------
 
     def _absorb_telemetry(self, payload):
         """Record a worker's cumulative metrics snapshot and merge its trace
@@ -412,7 +899,8 @@ class ProcessPool(object):
         if not self._stopped:
             raise RuntimeError('join() must be called after stop()')
         deadline = time.monotonic() + 10
-        while any(p.is_alive() for p in self._processes) and time.monotonic() < deadline:
+        while any(p is not None and p.is_alive() for p in self._processes) \
+                and time.monotonic() < deadline:
             self._control_send.send(_CONTROL_FINISHED)
             # drain results so workers blocked on a full transport can exit
             if self._transport == 'zmq':
@@ -420,19 +908,26 @@ class ProcessPool(object):
                     self._results_receive.recv_multipart()
             else:
                 with self._ring_lock:
-                    for ring in self._rings:
+                    for ring in self._rings + self._retired_rings:
+                        if ring is None:
+                            continue
                         while ring.try_read() is not None:
                             pass
             time.sleep(0.05)
         for p in self._processes:
+            if p is None:
+                continue
             if p.is_alive():
                 logger.warning('Terminating unresponsive worker pid=%s', p.pid)
                 p.terminate()
             p.join()
         self._processes = []
-        for ring in self._rings:
-            ring.close()
-        self._rings = []
+        with self._ring_lock:
+            for ring in self._rings + self._retired_rings:
+                if ring is not None:
+                    ring.close()
+            self._rings = []
+            self._retired_rings = []
         for sock in (self._ventilator_send, self._results_receive, self._control_send):
             if sock is not None:
                 sock.close()
@@ -446,15 +941,30 @@ class ProcessPool(object):
             self._blob_dir = None
 
     @property
+    def quarantined_items(self):
+        """Structured records of quarantined items (``on_error='skip'``):
+        dicts with seq/item/attempts/kind/error/traceback/worker_id."""
+        with self._state_lock:
+            return list(self._quarantined)
+
+    @property
     def diagnostics(self):
         """The unified pool diagnostics schema (docs/observability.md).
         ``results_queue_depth`` is 0 here: buffered results live in zmq/ring
         transport buffers this process cannot observe."""
+        with self._state_lock:
+            ventilated = self._ventilated_items
+            completed = self._completed_items
+            requeued = self._items_requeued
+            quarantined = len(self._quarantined)
         return {'workers_count': self._workers_count,
-                'items_ventilated': self._ventilated_items,
-                'items_completed': self._completed_items,
-                'items_in_flight': self._ventilated_items - self._completed_items,
-                'results_queue_depth': 0}
+                'items_ventilated': ventilated,
+                'items_completed': completed,
+                'items_in_flight': ventilated - completed,
+                'results_queue_depth': 0,
+                'worker_restarts': self._worker_restarts,
+                'items_requeued': requeued,
+                'items_quarantined': quarantined}
 
     @property
     def results_qsize(self):
@@ -467,10 +977,11 @@ class ProcessPool(object):
 
 def _worker_bootstrap(worker_id, main_pid, setup_blob, vent_addr, result_addr, control_addr,
                       results_hwm, ring_name=None, blob_dir=None, blob_threshold=0,
-                      workers_count=1):
+                      workers_count=1, heartbeat_interval_s=None):
     """Entry point of a spawned worker process. ``ring_name`` selects the shm
     results transport; None = zmq PUSH. ``blob_dir`` enables the large-payload
-    /dev/shm sidechannel."""
+    /dev/shm sidechannel. ``heartbeat_interval_s`` enables the supervision
+    beacons (None = legacy silent worker)."""
     # The native image-decode thread budget is PER-PROCESS state — sibling
     # workers cannot see each other's grants — so each spawned worker gets an
     # equal share of the host's cores (unless the user pinned the env var
@@ -485,6 +996,11 @@ def _worker_bootstrap(worker_id, main_pid, setup_blob, vent_addr, result_addr, c
     # and ring to match the reader's before any instrumented code runs
     if isinstance(worker_setup_args, dict) and worker_setup_args.get('telemetry') is not None:
         obs.configure(worker_setup_args['telemetry'])
+    # fault injection rides the same route; SIGKILL faults are only honored
+    # here, in a process whose death the supervisor can absorb
+    faults.mark_in_spawned_worker()
+    if isinstance(worker_setup_args, dict) and worker_setup_args.get('fault_plan') is not None:
+        faults.install(worker_setup_args['fault_plan'])
 
     _start_orphan_monitor(main_pid)
 
@@ -522,7 +1038,34 @@ def _worker_bootstrap(worker_id, main_pid, setup_blob, vent_addr, result_addr, c
             seq_bytes = b'' if seq is None else str(seq).encode()
             result_send.send_multipart([kind, seq_bytes, payload])
 
-    current = {'seq': None}  # seq of the item being processed, for publish tagging
+    current = {'seq': None}  # dispatch id of the item being processed, for message tagging
+
+    last_hb = {'t': 0.0}
+
+    def send_heartbeat(busy, blocking=False):
+        """Liveness + ownership beacon. Claim beacons (``busy`` set, blocking)
+        MUST land — they are what makes a crashed item requeueable; idle
+        beacons are best-effort and skipped when the transport is congested
+        (a congested transport means results are flowing, which is liveness
+        evidence in itself)."""
+        if heartbeat_interval_s is None:
+            return
+        payload = pickle.dumps({'worker_id': worker_id, 'pid': os.getpid(), 'busy': busy},
+                               protocol=pickle.HIGHEST_PROTOCOL)
+        try:
+            if ring is not None:
+                header = _ring_header(_HEARTBEAT, None)
+                if blocking:
+                    ring.write2(header, payload, stop_check=check_finished)
+                else:
+                    ring.try_write2(header, payload)
+            elif blocking:
+                result_send.send_multipart([_HEARTBEAT, b'', payload])
+            else:
+                result_send.send_multipart([_HEARTBEAT, b'', payload], flags=zmq.NOBLOCK)
+        except zmq.Again:
+            return
+        last_hb['t'] = time.monotonic()
 
     def _blob_backpressure(incoming):
         """The byte analog of the ring's capacity bound: blobs are unlinked on
@@ -643,6 +1186,7 @@ def _worker_bootstrap(worker_id, main_pid, setup_blob, vent_addr, result_addr, c
 
     worker = worker_class(worker_id, publish, worker_setup_args)
     send(_STARTED, None)
+    send_heartbeat(None)
 
     poller = zmq.Poller()
     poller.register(vent_recv, zmq.POLLIN)
@@ -655,24 +1199,37 @@ def _worker_bootstrap(worker_id, main_pid, setup_blob, vent_addr, result_addr, c
                 if finished['flag'] or control_recv.recv() == _CONTROL_FINISHED:
                     break
             if vent_recv in events:
-                args, kwargs = vent_recv.recv_pyobj()
-                current['seq'] = kwargs.pop('_seq', None)
+                dispatch, args, kwargs = vent_recv.recv_pyobj()
+                current['seq'] = dispatch
+                # claim beacon FIRST: if this item kills the process, the
+                # supervisor knows exactly what to requeue
+                send_heartbeat(dispatch, blocking=True)
                 try:
+                    faults.on_item(kwargs)
                     worker.process(*args, **kwargs)
                     send(_DONE, current['seq'])
                     flush_telemetry()
                 except Exception:  # noqa: BLE001 - forwarded to the main process
                     exc = sys.exc_info()[1]
                     logger.exception('Worker %d failed', worker_id)
+                    tb = format_exception_tb(exc)
+                    report = {'tb': tb, 'worker_id': worker_id, 'pid': os.getpid()}
                     try:
-                        blob = pickle.dumps(exc)
+                        blob = pickle.dumps(dict(report, exc=exc))
                     except Exception:  # unpicklable exception: forward a summary
-                        blob = pickle.dumps(RuntimeError('{}: {}'.format(type(exc).__name__, exc)))
-                    send(_ERROR, None, blob)
-                    # seq-less sentinel: the failed item stays undelivered so a
-                    # checkpoint re-reads it (see thread_pool.py)
-                    send(_DONE, None)
+                        blob = pickle.dumps(dict(report, exc=RuntimeError(
+                            '{}: {}'.format(type(exc).__name__, exc))))
+                    # completion accounting for a failed item happens on the
+                    # supervisor side (requeue/quarantine/raise) — no _DONE here
+                    send(_ERROR, current['seq'], blob)
                     flush_telemetry()
+                # no trailing idle beacon: the _DONE/_ERROR message itself
+                # clears the claim on the supervisor side (ordered transport),
+                # keeping supervision at ONE extra message per item
+                current['seq'] = None
+            elif heartbeat_interval_s is not None \
+                    and time.monotonic() - last_hb['t'] >= heartbeat_interval_s:
+                send_heartbeat(None)
     finally:
         worker.shutdown()
         if ring is not None:

@@ -7,6 +7,14 @@ per-thread cProfile :41-49,190-198; ``diagnostics`` :219-221).
 
 Threads are the right default on the TPU host: the hot work (Parquet decode,
 image decode) happens in Arrow/OpenCV C++ which releases the GIL.
+
+Item failures follow the pool-independent ``on_error``/``max_item_retries``
+policy (``workers/supervision.py``): 'raise' forwards the first error to the
+consumer (the historical behavior), 'retry' re-enqueues the item up to the
+budget, 'skip' quarantines it after the budget so the epoch completes.
+Threads cannot die the way processes can, so there is no heartbeat/respawn
+machinery here — an exception IS the totality of a thread worker's failure
+modes.
 """
 
 from __future__ import annotations
@@ -17,8 +25,10 @@ import queue
 import sys
 import threading
 
-from petastorm_tpu import observability as obs
-from petastorm_tpu.workers.worker_base import (EmptyResultError, WorkerTerminationRequested)
+from petastorm_tpu import faults, observability as obs
+from petastorm_tpu.errors import EmptyResultError, WorkerTerminationRequested
+from petastorm_tpu.workers.supervision import (ErrorPolicy, attach_remote_context,
+                                               format_exception_tb, quarantine_record)
 
 logger = logging.getLogger(__name__)
 
@@ -27,7 +37,8 @@ DEFAULT_RESULTS_QUEUE_SIZE = 50
 
 
 class ThreadPool(object):
-    def __init__(self, workers_count, results_queue_size=DEFAULT_RESULTS_QUEUE_SIZE, profiling_enabled=False):
+    def __init__(self, workers_count, results_queue_size=DEFAULT_RESULTS_QUEUE_SIZE,
+                 profiling_enabled=False, on_error='raise', max_item_retries=None):
         self._workers_count = workers_count
         self._results_queue = queue.Queue(maxsize=results_queue_size)
         self._profiling_enabled = profiling_enabled
@@ -38,6 +49,11 @@ class ThreadPool(object):
         self._ventilator = None
         self._ventilated_items = 0
         self._completed_items = 0
+        self._items_requeued = 0
+        self._quarantined = []
+        self._policy = (on_error if isinstance(on_error, ErrorPolicy)
+                        else ErrorPolicy(on_error, **({} if max_item_retries is None
+                                                      else {'max_item_retries': max_item_retries})))
         self._counter_lock = threading.Lock()
         self._tls = threading.local()  # per-worker-thread current item seq
         # checkpoint plumbing: seq of the payload last returned by get_results,
@@ -66,7 +82,7 @@ class ThreadPool(object):
         seq = kwargs.pop('_seq', None)
         with self._counter_lock:
             self._ventilated_items += 1
-        self._task_queue.put((seq, args, kwargs))
+        self._task_queue.put((seq, args, kwargs, 0))
 
     def get_results(self):
         """Block until a result is available; raise :class:`EmptyResultError` when
@@ -135,17 +151,29 @@ class ThreadPool(object):
             stats.sort_stats('cumulative').print_stats()
 
     @property
+    def quarantined_items(self):
+        """Structured records of quarantined items (``on_error='skip'``)."""
+        with self._counter_lock:
+            return list(self._quarantined)
+
+    @property
     def diagnostics(self):
         """The unified pool diagnostics schema (docs/observability.md): every
-        pool type reports the same keys and units."""
+        pool type reports the same keys and units. ``worker_restarts`` is
+        always 0 here — threads fail by exception, never by death."""
         with self._counter_lock:
             ventilated = self._ventilated_items
             completed = self._completed_items
+            requeued = self._items_requeued
+            quarantined = len(self._quarantined)
         return {'workers_count': self._workers_count,
                 'items_ventilated': ventilated,
                 'items_completed': completed,
                 'items_in_flight': ventilated - completed,
-                'results_queue_depth': self._results_queue.qsize()}
+                'results_queue_depth': self._results_queue.qsize(),
+                'worker_restarts': 0,
+                'items_requeued': requeued,
+                'items_quarantined': quarantined}
 
     def telemetry_snapshots(self):
         """Worker metrics already live in this process's registry."""
@@ -171,6 +199,42 @@ class ThreadPool(object):
                 continue
         raise WorkerTerminationRequested()
 
+    def _handle_item_failure(self, worker, seq, args, kwargs, attempts):
+        """Apply the on_error policy to one failed item, on the worker thread.
+        ``attempts`` counts this failure. May raise WorkerTerminationRequested
+        (propagated by the loop)."""
+        exc = sys.exc_info()[1]
+        if self._policy.should_retry_error(attempts):
+            logger.warning('Worker %d failed on item seq=%s (attempt %d/%d); requeueing: %s',
+                           worker.worker_id, seq, attempts,
+                           self._policy.max_item_retries + 1, exc)
+            with self._counter_lock:
+                self._items_requeued += 1
+            obs.count('items_requeued')
+            self._task_queue.put((seq, args, kwargs, attempts))
+            return
+        if self._policy.quarantines():
+            record = quarantine_record(seq, attempts, 'error', error=exc,
+                                       tb=format_exception_tb(exc),
+                                       worker_id=worker.worker_id,
+                                       item={'args': args, 'kwargs': kwargs})
+            with self._counter_lock:
+                self._quarantined.append(record)
+            obs.count('items_quarantined')
+            logger.error('Quarantining item seq=%s after %d failed attempts: %s',
+                         seq, attempts, record['error'])
+            # completion sentinel WITHOUT a seq: the item counts complete for
+            # epoch/flow-control accounting but is never marked delivered
+            self._stop_aware_put((_DONE, None, None))
+            return
+        logger.exception('Worker %d failed processing an item', worker.worker_id)
+        attach_remote_context(exc, format_exception_tb(exc),
+                              worker_id=worker.worker_id, seq=seq)
+        self._stop_aware_put((_ERROR, None, exc))
+        # seq-less sentinel: flow control counts the item but it is
+        # NOT marked delivered — a checkpoint will re-read it
+        self._stop_aware_put((_DONE, None, None))
+
     def _worker_loop(self, worker):
         profiler = None
         if self._profiling_enabled:
@@ -179,7 +243,7 @@ class ThreadPool(object):
         try:
             while not self._stop_event.is_set():
                 try:
-                    seq, args, kwargs = self._task_queue.get(timeout=0.05)
+                    seq, args, kwargs, attempts = self._task_queue.get(timeout=0.05)
                 except queue.Empty:
                     continue
                 self._tls.seq = seq
@@ -187,6 +251,7 @@ class ThreadPool(object):
                     if profiler is not None:
                         profiler.enable()
                     try:
+                        faults.on_item(kwargs)
                         worker.process(*args, **kwargs)
                     finally:
                         if profiler is not None:
@@ -194,14 +259,9 @@ class ThreadPool(object):
                     self._stop_aware_put((_DONE, seq, None))
                 except WorkerTerminationRequested:
                     return
-                except Exception:  # noqa: BLE001 - forwarded to consumer
-                    exc = sys.exc_info()[1]
-                    logger.exception('Worker %d failed processing an item', worker.worker_id)
+                except Exception:  # noqa: BLE001 - routed through the error policy
                     try:
-                        self._stop_aware_put((_ERROR, None, exc))
-                        # seq-less sentinel: flow control counts the item but it is
-                        # NOT marked delivered — a checkpoint will re-read it
-                        self._stop_aware_put((_DONE, None, None))
+                        self._handle_item_failure(worker, seq, args, kwargs, attempts + 1)
                     except WorkerTerminationRequested:
                         return
         finally:

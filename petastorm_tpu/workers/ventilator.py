@@ -124,7 +124,13 @@ class ConcurrentVentilator(VentilatorBase):
 
     def processed_item(self):
         """Called by the pool/consumer when one ventilated item finished
-        processing; unblocks the feeding thread."""
+        processing; unblocks the feeding thread.
+
+        Supervision contract (docs/robustness.md): pools must call this
+        EXACTLY ONCE per ventilated item, no matter how many times the item
+        was requeued after a worker death or a retried error — a double call
+        would over-open the in-flight budget, a missed call would wedge the
+        feeding thread and the epoch would never terminate."""
         with self._in_flight_cv:
             self._in_flight -= 1
             self._in_flight_cv.notify()

@@ -2,23 +2,17 @@
 
 Parity: /root/reference/petastorm/workers_pool/worker_base.py:18-35 and the
 sentinels in workers_pool/__init__.py:16-26.
+
+The worker-plane exceptions are defined in :mod:`petastorm_tpu.errors` (rooted
+at ``PetastormTpuError``); the names below are kept as import aliases because
+this module was their historical home.
 """
 
 from __future__ import annotations
 
-
-class EmptyResultError(Exception):
-    """Raised by ``pool.get_results()`` when all ventilated work has been
-    processed and no further results will arrive."""
-
-
-class TimeoutWaitingForResultError(Exception):
-    """Raised when a pool timed out waiting for worker results."""
-
-
-class WorkerTerminationRequested(Exception):
-    """Raised inside a worker's ``process`` by ``publish`` when the pool is
-    stopping, to unwind the worker promptly."""
+from petastorm_tpu.errors import (EmptyResultError,  # noqa: F401 - compat aliases
+                                  TimeoutWaitingForResultError,
+                                  WorkerTerminationRequested)
 
 
 class WorkerBase(object):

@@ -1,0 +1,415 @@
+"""Worker supervision and fault tolerance (docs/robustness.md).
+
+The chaos matrix the supervision layer is accepted against:
+
+* SIGKILL mid-item (process pool) -> the epoch completes with every
+  non-quarantined row delivered EXACTLY once, ``worker_restarts >= 1``, and no
+  ``TimeoutWaitingForResultError``.
+* a deterministic poison row group under ``on_error='skip'`` -> one
+  quarantine record, complete epoch — on process, thread AND dummy pools
+  (the policy is pool-independent).
+* ``on_error='raise'`` -> fast failure carrying the worker-side traceback.
+* ``on_error='retry'`` -> transient item errors are retried and the epoch
+  completes in full.
+* storage faults injected through ``retry.py`` exercise the transient
+  backoff path.
+* overhead guards: supervision works at item granularity — heartbeats and
+  ownership tracking add ZERO per-row work (the PR-3-style structural bound)
+  and <1% warm throughput (timing guard, slow-marked).
+
+All faults come from ``petastorm_tpu.faults`` — deterministic, seeded into
+the REAL code paths, coordinated across spawned workers via one-shot state
+files.
+"""
+
+import collections
+import time
+
+import pytest
+
+from petastorm_tpu import faults, make_reader
+from petastorm_tpu import observability as obs
+from petastorm_tpu.errors import (EmptyResultError, PetastormTpuError, PoisonItemError,
+                                  TimeoutWaitingForResultError, WorkerTerminationRequested)
+from petastorm_tpu.retry import RetryPolicy
+from petastorm_tpu.workers import DummyPool, ErrorPolicy, ProcessPool, ThreadPool
+from petastorm_tpu.workers.supervision import attach_remote_context
+
+ALL_POOL_TYPES = ['thread', 'dummy']  # in-process matrix; 'process' has dedicated tests
+
+
+@pytest.fixture
+def fault_state(tmp_path):
+    """State dir for one-shot faults; always disarms the hooks afterwards."""
+    yield str(tmp_path)
+    faults.uninstall()
+
+
+def _drain_ids(reader):
+    ids = []
+    for batch in reader:
+        ids.extend(int(x) for x in batch.id)
+    return ids
+
+
+# ---------------------------------------------------------------------------
+# error taxonomy (satellite: everything roots at PetastormTpuError)
+# ---------------------------------------------------------------------------
+
+def test_worker_errors_root_at_petastorm_tpu_error():
+    for exc in (EmptyResultError, TimeoutWaitingForResultError,
+                WorkerTerminationRequested, PoisonItemError):
+        assert issubclass(exc, PetastormTpuError)
+    # the historical import location keeps working
+    from petastorm_tpu.workers.worker_base import EmptyResultError as alias
+    assert alias is EmptyResultError
+
+
+def test_error_policy_validation():
+    with pytest.raises(ValueError, match='on_error'):
+        ErrorPolicy('explode')
+    with pytest.raises(ValueError, match='max_item_retries'):
+        ErrorPolicy('skip', -1)
+    with pytest.raises(ValueError, match='on_error'):
+        make_reader('file:///nonexistent', on_error='explode')
+
+
+def test_attach_remote_context_preserves_type_and_traceback():
+    exc = ValueError('boom')
+    out = attach_remote_context(exc, 'Traceback ...worker side...', worker_id=3, seq=7, pid=42)
+    assert out is exc
+    assert exc.worker_traceback == 'Traceback ...worker side...'
+    assert exc.item_seq == 7
+    assert 'worker 3 (pid 42)' in str(exc.__cause__)
+    assert 'worker side' in str(exc.__cause__)
+
+
+# ---------------------------------------------------------------------------
+# fault plan mechanics
+# ---------------------------------------------------------------------------
+
+def test_fault_plan_one_shot_needs_state_dir():
+    with pytest.raises(ValueError, match='state_dir'):
+        faults.FaultPlan(kill_items=(1,), kill_once=True)
+    with pytest.raises(ValueError, match='state_dir'):
+        faults.FaultPlan(error_items=(1,), error_times=2)
+
+
+def test_storage_faults_exercise_retry_backoff(fault_state):
+    faults.install(faults.FaultPlan(storage_fail_first=2))
+    calls = {'n': 0}
+
+    def op():
+        calls['n'] += 1
+        return 'ok'
+
+    policy = RetryPolicy(max_attempts=4, initial_backoff_s=0.001)
+    assert policy.call(op) == 'ok'
+    # two injected ECONNRESETs consumed two attempts before op succeeded
+    assert calls['n'] == 1
+    faults.uninstall()
+    from petastorm_tpu import retry
+    assert retry.FAULT_POINT is None  # hook disarmed
+
+
+def test_kill_fault_degrades_to_error_outside_spawned_worker(fault_state):
+    faults.install(faults.FaultPlan(kill_items=(5,), kill_once=False, state_dir=fault_state))
+    with pytest.raises(faults.FaultInjectedError, match='degraded to an error'):
+        faults.on_item({'piece_index': 5})
+
+
+# ---------------------------------------------------------------------------
+# THE chaos test: SIGKILL mid-item, exactly-once epoch (process pool)
+# ---------------------------------------------------------------------------
+
+def test_sigkill_mid_item_epoch_completes_exactly_once(synthetic_dataset, fault_state):
+    faults.install(faults.FaultPlan(kill_items=(3,), kill_once=True, state_dir=fault_state))
+    with make_reader(synthetic_dataset.url, schema_fields=['id'],
+                     reader_pool_type='process', workers_count=2,
+                     output='columnar', seed=0) as reader:
+        ids = _drain_ids(reader)  # no TimeoutWaitingForResultError may surface
+        counts = collections.Counter(ids)
+        assert len(ids) == 100, 'every row of every row group must be delivered'
+        assert all(v == 1 for v in counts.values()), 'exactly once: no duplicates'
+        diag = reader.diagnostics
+        assert diag['worker_restarts'] >= 1
+        assert diag['items_requeued'] >= 1
+        assert diag['items_quarantined'] == 0
+        assert diag['items_ventilated'] == diag['items_completed'] == 10
+        assert diag['items_in_flight'] == 0
+
+
+def test_process_pool_poison_quarantine_and_raise(synthetic_dataset, fault_state):
+    """One poison row group on the process pool: 'skip' quarantines it with a
+    worker-side traceback in the record; 'raise' surfaces the remote traceback."""
+    faults.install(faults.FaultPlan(error_items=(2,), state_dir=fault_state))
+    with make_reader(synthetic_dataset.url, schema_fields=['id'],
+                     reader_pool_type='process', workers_count=1,
+                     output='columnar', seed=0,
+                     on_error='skip', max_item_retries=1) as reader:
+        ids = _drain_ids(reader)
+        assert len(ids) == 90 and len(set(ids)) == 90
+        records = reader.quarantined_items
+        assert len(records) == 1
+        assert records[0]['kind'] == 'error' and records[0]['attempts'] == 2
+        assert 'FaultInjectedError' in records[0]['error']
+        assert 'injected poison' in records[0]['traceback']
+        assert reader.diagnostics['items_quarantined'] == 1
+
+    with make_reader(synthetic_dataset.url, schema_fields=['id'],
+                     reader_pool_type='process', workers_count=1,
+                     output='columnar', seed=0, on_error='raise') as reader:
+        with pytest.raises(faults.FaultInjectedError) as exc_info:
+            _drain_ids(reader)
+        assert 'injected poison' in exc_info.value.worker_traceback
+        assert 'worker-side traceback' in str(exc_info.value.__cause__)
+
+
+# ---------------------------------------------------------------------------
+# the same policy matrix on the in-process pools
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize('pool_type', ALL_POOL_TYPES)
+def test_poison_skip_completes_epoch(synthetic_dataset, fault_state, pool_type):
+    faults.install(faults.FaultPlan(error_items=(2,), state_dir=fault_state))
+    with make_reader(synthetic_dataset.url, schema_fields=['id'],
+                     reader_pool_type=pool_type, workers_count=2,
+                     output='columnar', seed=0,
+                     on_error='skip', max_item_retries=1) as reader:
+        ids = _drain_ids(reader)
+        assert len(ids) == 90 and len(set(ids)) == 90
+        records = reader.quarantined_items
+        assert len(records) == 1
+        assert records[0]['kind'] == 'error'
+        assert 'injected poison' in records[0]['traceback']
+        diag = reader.diagnostics
+        assert diag['items_quarantined'] == 1
+        assert diag['items_requeued'] == 1  # one retry before quarantine
+        assert diag['items_ventilated'] == diag['items_completed'] == 10
+
+
+@pytest.mark.parametrize('pool_type', ALL_POOL_TYPES)
+def test_poison_raise_surfaces_traceback(synthetic_dataset, fault_state, pool_type):
+    faults.install(faults.FaultPlan(error_items=(2,), state_dir=fault_state))
+    with make_reader(synthetic_dataset.url, schema_fields=['id'],
+                     reader_pool_type=pool_type, workers_count=2,
+                     output='columnar', seed=0, on_error='raise') as reader:
+        with pytest.raises(faults.FaultInjectedError) as exc_info:
+            _drain_ids(reader)
+        assert 'injected poison' in exc_info.value.worker_traceback
+        assert exc_info.value.item_seq is not None
+
+
+@pytest.mark.parametrize('pool_type', ALL_POOL_TYPES)
+def test_transient_error_retry_recovers_full_epoch(synthetic_dataset, fault_state, pool_type):
+    faults.install(faults.FaultPlan(error_items=(4,), error_times=1, state_dir=fault_state))
+    with make_reader(synthetic_dataset.url, schema_fields=['id'],
+                     reader_pool_type=pool_type, workers_count=2,
+                     output='columnar', seed=0,
+                     on_error='retry', max_item_retries=2) as reader:
+        ids = _drain_ids(reader)
+        assert sorted(ids) == list(range(100))
+        diag = reader.diagnostics
+        assert diag['items_requeued'] == 1
+        assert diag['items_quarantined'] == 0
+
+
+def test_retry_budget_exhaustion_raises(synthetic_dataset, fault_state):
+    faults.install(faults.FaultPlan(error_items=(4,), state_dir=fault_state))
+    with make_reader(synthetic_dataset.url, schema_fields=['id'],
+                     reader_pool_type='thread', workers_count=1,
+                     output='columnar', seed=0,
+                     on_error='retry', max_item_retries=1) as reader:
+        with pytest.raises(faults.FaultInjectedError):
+            _drain_ids(reader)
+
+
+# ---------------------------------------------------------------------------
+# recovery events surface through observability
+# ---------------------------------------------------------------------------
+
+def test_recovery_counters_and_stall_report(synthetic_dataset, fault_state):
+    obs.get_registry().reset()
+    faults.install(faults.FaultPlan(error_items=(2,), state_dir=fault_state))
+    with make_reader(synthetic_dataset.url, schema_fields=['id'],
+                     reader_pool_type='thread', workers_count=1,
+                     output='columnar', seed=0, telemetry='counters',
+                     on_error='skip', max_item_retries=0) as reader:
+        _drain_ids(reader)
+        diag = reader.diagnostics
+    report = obs.stall_report(dict(diag, reader_wait_s=1.0))
+    assert report['recovery']['items_quarantined'] == 1
+    text = obs.format_stall_report(report)
+    assert 'recovery events' in text and '1 quarantined' in text
+
+
+def test_heartbeat_age_gauge_updates(synthetic_dataset):
+    obs.get_registry().reset()
+    with make_reader(synthetic_dataset.url, schema_fields=['id'],
+                     reader_pool_type='process', workers_count=1,
+                     output='columnar', seed=0, telemetry='counters') as reader:
+        _drain_ids(reader)
+        diag = reader.diagnostics
+        assert 'heartbeat_age_s' in diag
+        assert 0 <= diag['heartbeat_age_s'] < 60
+
+
+# ---------------------------------------------------------------------------
+# overhead guards (acceptance: <1% on bench.py; guarded structurally like the
+# PR-3 telemetry-off guard, plus a slow-marked timing ratio)
+# ---------------------------------------------------------------------------
+
+def test_supervision_overhead_is_per_item_not_per_row():
+    """The structural bound: supervision costs one claim + one idle beacon per
+    ITEM plus one periodic beacon per heartbeat interval per worker — never
+    per-row work. 40 items through a 2-worker pool must stay within that
+    message budget (a per-row leak would add hundreds)."""
+    from petastorm_tpu.test_util.stub_workers import IdentityWorker
+    pool = ProcessPool(2, heartbeat_interval_s=0.5)
+    pool.start(IdentityWorker)
+    t0 = time.monotonic()
+    try:
+        for i in range(40):
+            pool.ventilate(i)
+        got = []
+        while True:
+            try:
+                got.append(pool.get_results(timeout_s=60))
+            except EmptyResultError:
+                break
+        assert sorted(got) == list(range(40))
+        elapsed = time.monotonic() - t0
+        # one claim beacon per item (the completion message clears it) + the
+        # periodic idle beacons + startup slack
+        budget = 40 + 2 * (elapsed / 0.5 + 3)
+        assert pool._heartbeats_received <= budget, \
+            'heartbeat traffic {} exceeds the per-item budget {}'.format(
+                pool._heartbeats_received, budget)
+        # ownership tracking cleans up after itself: nothing accumulates
+        assert pool._inflight == {} and pool._orphans == {}
+    finally:
+        pool.stop()
+        pool.join()
+
+
+def test_supervision_off_sends_no_heartbeats():
+    from petastorm_tpu.test_util.stub_workers import IdentityWorker
+    pool = ProcessPool(1, supervision=False)
+    pool.start(IdentityWorker)
+    try:
+        for i in range(5):
+            pool.ventilate(i)
+        got = []
+        while True:
+            try:
+                got.append(pool.get_results(timeout_s=60))
+            except EmptyResultError:
+                break
+        assert sorted(got) == list(range(5))
+        assert pool._heartbeats_received == 0
+    finally:
+        pool.stop()
+        pool.join()
+
+
+@pytest.mark.slow
+def test_supervision_throughput_overhead_under_budget():
+    """Timing form of the overhead guard (the <1% budget is asserted with CI
+    slack; the structural test above is the regression tripwire): identical
+    warm workload — items shaped like real row groups (milliseconds of work,
+    not microseconds, matching bench.py's decode items) — with supervision on
+    vs off."""
+    from petastorm_tpu.test_util.stub_workers import SleepyIdentityWorker
+
+    def run(supervision):
+        pool = ProcessPool(2, supervision=supervision)
+        pool.start(SleepyIdentityWorker)
+        try:
+            for i in range(20):  # warm
+                pool.ventilate(i, sleep_s=0.005)
+            for _ in range(20):
+                pool.get_results(timeout_s=60)
+            t0 = time.perf_counter()
+            for i in range(150):
+                pool.ventilate(i, sleep_s=0.005)
+            for _ in range(150):
+                pool.get_results(timeout_s=60)
+            return time.perf_counter() - t0
+        finally:
+            pool.stop()
+            pool.join()
+
+    on, off = run(True), run(False)
+    assert on <= off * 1.1, 'supervision overhead {:.1%} exceeds budget'.format(on / off - 1)
+
+
+# ---------------------------------------------------------------------------
+# graceful degradation: respawn failure sheds the slot, fails at zero workers
+# ---------------------------------------------------------------------------
+
+def test_respawn_failure_sheds_slot_and_depletes_pool():
+    """When respawn itself fails the pool degrades (slot shed, loud error)
+    rather than crash-looping, and only a fully-shed pool raises
+    WorkerPoolDepletedError."""
+    from petastorm_tpu.errors import WorkerPoolDepletedError
+    from petastorm_tpu.test_util.stub_workers import HardExitWorker
+    pool = ProcessPool(1)
+    pool.start(HardExitWorker, {'crash_on': 1})
+    try:
+        pool.ventilate(0)
+        assert pool.get_results(timeout_s=60) == [0]
+
+        def broken_spawn(worker_id, ring_name):
+            raise OSError('simulated: fork/exec failed')
+
+        pool._spawn_worker = broken_spawn
+        pool.ventilate(1)  # kills the only worker; its respawn now fails
+        with pytest.raises(WorkerPoolDepletedError, match='respawn kept failing'):
+            while True:
+                pool.get_results(timeout_s=60)
+        assert pool._all_slots_shed()
+    finally:
+        pool.stop()
+        pool.join()
+
+
+# ---------------------------------------------------------------------------
+# thread-pool exactly-once accounting under requeue (no reader involved)
+# ---------------------------------------------------------------------------
+
+def test_thread_pool_retry_accounting_exact():
+    from petastorm_tpu.test_util.stub_workers import ExceptionEveryNWorker
+    pool = ThreadPool(1, on_error='skip', max_item_retries=1)
+    pool.start(ExceptionEveryNWorker, worker_setup_args=5)  # value % 5 == 0 fails
+    for i in [1, 2, 5, 3]:
+        pool.ventilate(i)
+    got = []
+    while True:
+        try:
+            got.append(pool.get_results())
+        except EmptyResultError:
+            break
+    assert sorted(got) == [1, 2, 3]
+    diag = pool.diagnostics
+    assert diag['items_ventilated'] == diag['items_completed'] == 4
+    assert diag['items_requeued'] == 1 and diag['items_quarantined'] == 1
+    assert len(pool.quarantined_items) == 1
+    pool.stop(); pool.join()
+
+
+def test_dummy_pool_skip_does_not_stop_epoch():
+    from petastorm_tpu.test_util.stub_workers import ExceptionEveryNWorker
+    pool = DummyPool(on_error='skip', max_item_retries=0)
+    pool.start(ExceptionEveryNWorker, worker_setup_args=2)
+    for i in [1, 2, 3, 4, 5]:
+        pool.ventilate(i)
+    got = []
+    while True:
+        try:
+            got.append(pool.get_results())
+        except EmptyResultError:
+            break
+    assert sorted(got) == [1, 3, 5]
+    assert pool.diagnostics['items_quarantined'] == 2
+    assert pool.diagnostics['items_completed'] == 5
+    pool.stop(); pool.join()

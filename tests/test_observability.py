@@ -202,7 +202,8 @@ def test_unified_pool_diagnostics_schema():
     """Every pool type reports the same diagnostics keys and units."""
     from petastorm_tpu.workers import DummyPool, ProcessPool, ThreadPool
     expected = {'workers_count', 'items_ventilated', 'items_completed',
-                'items_in_flight', 'results_queue_depth'}
+                'items_in_flight', 'results_queue_depth',
+                'worker_restarts', 'items_requeued', 'items_quarantined'}
     pools = [DummyPool(), ThreadPool(2), ProcessPool(2)]
     for pool in pools:
         assert set(pool.diagnostics) == expected, type(pool).__name__

@@ -22,7 +22,8 @@ from petastorm_tpu.analysis import ALL_CHECKERS, run_analysis
 from petastorm_tpu.analysis.buffers import NativeBufferChecker
 from petastorm_tpu.analysis.core import (Baseline, SourceFile, load_baseline,
                                          run_checkers, write_baseline)
-from petastorm_tpu.analysis.exceptions import ExceptionHygieneChecker
+from petastorm_tpu.analysis.exceptions import (BaseExceptionContainmentChecker,
+                                               ExceptionHygieneChecker)
 from petastorm_tpu.analysis.hashability import HashabilityChecker
 from petastorm_tpu.analysis.jax_purity import JaxPurityChecker
 from petastorm_tpu.analysis.lifecycle import ResourceLifecycleChecker
@@ -389,6 +390,85 @@ def test_pt300_ble001_alias_suppresses():
 def test_pt300_scope_excludes_etl():
     src = SourceFile('<fixture>', 'etl/metadata.py', 'x = 1\n')
     assert not ExceptionHygieneChecker().matches(src)
+
+
+# ---------------------------------------------------------------------------
+# PT701 BaseException containment in worker loops
+# ---------------------------------------------------------------------------
+
+def test_pt701_swallowed_baseexception():
+    code = '''
+        def worker_loop(q):
+            try:
+                q.get()
+            except BaseException:
+                pass
+    '''
+    assert _codes(BaseExceptionContainmentChecker(), code) == ['PT701']
+
+
+def test_pt701_logging_alone_is_not_containment():
+    """Stricter than PT300: a KeyboardInterrupt handler that logs and carries
+    on still eats the cancellation — the pool wedges."""
+    code = '''
+        import logging
+        logger = logging.getLogger(__name__)
+
+        def worker_loop(q):
+            try:
+                q.get()
+            except KeyboardInterrupt:
+                logger.info('interrupted, continuing')
+    '''
+    assert _codes(BaseExceptionContainmentChecker(), code) == ['PT701']
+
+
+def test_pt701_tuple_clause_matched():
+    code = '''
+        def worker_loop(q):
+            try:
+                q.get()
+            except (ValueError, SystemExit):
+                return None
+    '''
+    assert _codes(BaseExceptionContainmentChecker(), code) == ['PT701']
+
+
+def test_pt701_reraise_forward_and_exit_pass():
+    code = '''
+        import os
+
+        def cleanup_reraise(path, write, unlink):
+            try:
+                write(path)
+            except BaseException:
+                unlink(path)
+                raise
+
+        def forwards_to_error_channel(pump, q, put_final):
+            try:
+                pump(q)
+            except BaseException as exc:
+                put_final(exc)
+
+        def deliberate_suicide(run):
+            try:
+                run()
+            except KeyboardInterrupt:
+                os._exit(1)
+
+        def narrow_is_not_pt701(q):
+            try:
+                q.get()
+            except Exception:  # noqa: BLE001 - PT300 territory, not PT701
+                pass
+    '''
+    assert _codes(BaseExceptionContainmentChecker(), code) == []
+
+
+def test_pt701_scope_excludes_etl():
+    src = SourceFile('<fixture>', 'etl/metadata.py', 'x = 1\n')
+    assert not BaseExceptionContainmentChecker().matches(src)
 
 
 # ---------------------------------------------------------------------------

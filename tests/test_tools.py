@@ -249,6 +249,26 @@ def test_bench_scaling_smoke(tmp_path, capsys):
     assert rec['samples_per_sec'] > 0
 
 
+def test_bench_scaling_chaos_smoke(tmp_path, capsys):
+    """--chaos runs the sweep under seeded fault injection (docs/robustness.md):
+    the run must complete end to end, report a positive rate, carry the
+    recovery counters, and have actually recovered from at least one injected
+    fault — and the hooks must be disarmed afterwards."""
+    import bench_scaling
+    from petastorm_tpu import faults, retry
+    bench_scaling.main(['--workers', '1', '--pools', 'thread', '--store', 'raw',
+                        '--rows', '64', '--measure-rows', '64',
+                        '--warmup-rows', '32', '--reps', '1', '--chaos',
+                        '--keep-dir', str(tmp_path)])
+    recs = _scaling_records(capsys)
+    assert len(recs) == 1
+    rec = recs[0]
+    assert rec['samples_per_sec'] > 0
+    assert rec['chaos']['items_requeued'] >= 1
+    assert rec['chaos']['items_quarantined'] == 0  # transient, not poison
+    assert faults.get_plan() is None and retry.FAULT_POINT is None
+
+
 def test_bench_scaling_remote_mock_exercises_chunk_store(tmp_path, capsys):
     """--store raw --remote-mock measures the chunk-cached remote path: the
     run must complete with a positive warm-cache rate AND have actually

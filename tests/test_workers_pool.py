@@ -2,6 +2,7 @@
 (modeled on reference workers_pool/tests/test_workers_pool.py)."""
 
 import os
+import tempfile
 
 import pytest
 
@@ -407,8 +408,8 @@ class TestNumpyBlockSerializer:
         serializer = NumpyBlockSerializer() if serializer_name == 'numpy_block' else PickleSerializer()
         orig = reader_mod._make_pool
 
-        def patched(pool_type, workers, qsize, serializer_arg=None):
-            return orig(pool_type, workers, qsize, serializer=serializer)
+        def patched(pool_type, workers, qsize, serializer_arg=None, **kwargs):
+            return orig(pool_type, workers, qsize, serializer=serializer, **kwargs)
 
         reader_mod._make_pool = patched
         try:
@@ -463,18 +464,74 @@ class TestShmRingStress:
             pool.stop()
             pool.join()
 
-    def test_worker_crash_mid_run_times_out_cleanly(self):
+    def test_worker_crash_poison_item_raises_after_retries(self):
+        """A crash-looping item is bounded by max_item_retries: the supervisor
+        respawns + requeues, then surfaces PoisonItemError — no timeout, no
+        hang (supervision replaced the old strand-until-timeout behavior)."""
+        from petastorm_tpu.errors import PoisonItemError
+        from petastorm_tpu.test_util.stub_workers import HardExitWorker
+        pool = ProcessPool(1, transport='shm', ring_bytes=1 << 20, max_item_retries=1)
+        pool.start(HardExitWorker, {'crash_on': 1})
+        try:
+            pool.ventilate(0)
+            assert pool.get_results(timeout_s=60) == [0]
+            pool.ventilate(1)  # kills every worker that touches it
+            with pytest.raises(PoisonItemError, match='killed 2 consecutive worker'):
+                while True:
+                    pool.get_results(timeout_s=60)
+            assert pool.diagnostics['worker_restarts'] >= 1
+            assert pool.diagnostics['items_in_flight'] == 0
+        finally:
+            pool.stop()
+            pool.join()
+
+    def test_worker_crash_unsupervised_times_out_with_liveness_snapshot(self):
+        """supervision=False restores the legacy behavior (a dead worker
+        strands its items until the results timeout) — and the timeout message
+        now carries the per-worker liveness snapshot."""
         from petastorm_tpu.test_util.stub_workers import HardExitWorker
         from petastorm_tpu.workers.process_pool import TimeoutWaitingForResultError
-        pool = ProcessPool(1, transport='shm', ring_bytes=1 << 20, results_timeout_s=3)
+        pool = ProcessPool(1, transport='shm', ring_bytes=1 << 20, results_timeout_s=3,
+                           supervision=False)
         pool.start(HardExitWorker, {'crash_on': 1})
         try:
             pool.ventilate(0)
             assert pool.get_results() == [0]
             pool.ventilate(1)  # worker dies here
-            with pytest.raises(TimeoutWaitingForResultError):
+            with pytest.raises(TimeoutWaitingForResultError) as exc_info:
                 while True:
                     pool.get_results()
+            msg = str(exc_info.value)
+            assert 'items in flight' in msg
+            assert 'Worker liveness' in msg and 'DEAD exitcode=13' in msg
+            assert 'petastorm-tpu-diagnose' in msg
+        finally:
+            pool.stop()
+            pool.join()
+
+    @pytest.mark.parametrize('transport', ['shm', 'zmq'])
+    def test_worker_crash_recovers_and_delivers_exactly_once(self, transport):
+        """SIGKILL mid-item with a crash that does NOT repeat (the worker dies
+        once, its replacement succeeds): every item is delivered exactly once
+        and the restart is visible in diagnostics. Both transports: shm drains
+        the dead worker's retired ring; zmq sweeps its lost dispatch pipe."""
+        from petastorm_tpu.test_util.stub_workers import CrashOnceWorker
+        pool = ProcessPool(2, transport=transport, ring_bytes=1 << 20)
+        crash_flag = os.path.join(tempfile.mkdtemp(prefix='pstpu_crash_once_'), 'fired')
+        pool.start(CrashOnceWorker, {'crash_on': 3, 'flag_path': crash_flag})
+        try:
+            for i in range(10):
+                pool.ventilate(i)
+            got = []
+            while True:
+                try:
+                    got.append(pool.get_results(timeout_s=60))
+                except EmptyResultError:
+                    break
+            assert sorted(got) == list(range(10))
+            assert pool.diagnostics['worker_restarts'] >= 1
+            assert pool.diagnostics['items_requeued'] >= 1
+            assert pool.diagnostics['items_in_flight'] == 0
         finally:
             pool.stop()
             pool.join()
@@ -548,8 +605,8 @@ class TestBlobSidechannel:
         from petastorm_tpu import reader as reader_mod
         orig = reader_mod._make_pool
 
-        def patched(pool_type, workers, qsize, serializer=None):
-            pool = orig(pool_type, workers, qsize, serializer=serializer)
+        def patched(pool_type, workers, qsize, serializer=None, **kwargs):
+            pool = orig(pool_type, workers, qsize, serializer=serializer, **kwargs)
             if hasattr(pool, '_blob_threshold'):
                 pool._blob_threshold = 1024
             return pool
