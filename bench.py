@@ -403,6 +403,12 @@ def main(argv=None):
                              'measured run (docs/robustness.md): the headline rate '
                              'then includes recovery overhead, and the output '
                              'carries the recovery counters')
+    parser.add_argument('--protocol-monitor', action='store_true',
+                        help='attach the worker-pool protocol conformance monitor '
+                             '(docs/protocol.md) to every measured reader: a chaos '
+                             'run then also PROVES the recovery followed the '
+                             'supervision protocol (any violation aborts the run '
+                             'with ProtocolViolation)')
     # parse_known_args: the capture entry point is also invoked as a plain
     # function from tests (bench.main()) where sys.argv belongs to pytest
     args, _unknown = parser.parse_known_args(argv)
@@ -437,6 +443,8 @@ def main(argv=None):
         --chaos each run additionally recovers from one injected transient
         worker error (fresh one-shot state dir per run)."""
         reader_kwargs = {'seed': 0}
+        if args.protocol_monitor:
+            reader_kwargs['protocol_monitor'] = True
         if args.chaos:
             import tempfile
             from petastorm_tpu import faults

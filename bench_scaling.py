@@ -49,7 +49,7 @@ def build_store(url, rows, store='png', image_size=160, num_classes=1000):
 
 
 def measure(url, pool, workers, measure_rows=2000, warmup_rows=200,
-            chunk_cache=None, telemetry=None, chaos=False):
+            chunk_cache=None, telemetry=None, chaos=False, protocol_monitor=False):
     from petastorm_tpu import faults, make_reader
     recovery = None
     if chaos:
@@ -68,6 +68,7 @@ def measure(url, pool, workers, measure_rows=2000, warmup_rows=200,
                          output='columnar', shuffle_row_groups=True, seed=0,
                          num_epochs=None, chunk_cache=chunk_cache,
                          telemetry=telemetry,
+                         protocol_monitor=True if protocol_monitor else None,
                          on_error='skip' if chaos else 'raise') as reader:
             it = iter(reader)
             seen = 0
@@ -116,6 +117,12 @@ def main(argv=None):
                              'transient error) — the measured rate then INCLUDES '
                              'recovery overhead, and each point reports the '
                              'recovery counters (docs/robustness.md)')
+    parser.add_argument('--protocol-monitor', action='store_true',
+                        help='attach the worker-pool protocol conformance monitor '
+                             '(docs/protocol.md) to every measured reader — a '
+                             '--chaos sweep then also PROVES each recovery followed '
+                             'the supervision protocol, not just that row counts '
+                             'came out right')
     args = parser.parse_args(argv)
     telemetry = args.telemetry
     if args.trace_out and telemetry in (None, 'off', 'counters'):
@@ -143,7 +150,8 @@ def main(argv=None):
         for w in (int(x) for x in args.workers.split(',')):
             results = [measure(url, pool.strip(), w, measure_rows=args.measure_rows,
                                warmup_rows=args.warmup_rows, chunk_cache=chunk_cache,
-                               telemetry=telemetry, chaos=args.chaos)
+                               telemetry=telemetry, chaos=args.chaos,
+                               protocol_monitor=args.protocol_monitor)
                        for _ in range(args.reps)]
             runs = [r for r, _ in results]
             point = {'metric': 'scaling', 'pool': pool.strip(), 'workers': w,

@@ -32,6 +32,12 @@ Rule families (see ``docs/analysis.md`` for bad/good examples):
   ``BaseException``/``KeyboardInterrupt`` without re-raising, forwarding the
   exception object, or exiting the process: eaten cancellation wedges the
   pool in ways supervision cannot detect.
+* **PT800/PT801** worker-pool protocol discipline — consumer switches over
+  results-channel message kinds must cover every kind declared in
+  ``workers/protocol.MESSAGE_KINDS`` (or carry an else); protocol
+  constants/bytes may only be defined in the canonical
+  ``workers/protocol.py``. The static complement of the protocol verifier
+  (``petastorm_tpu/analysis/protocol/``, ``docs/protocol.md``).
 
 Suppress a single finding with ``# noqa: PT###`` (reason encouraged) on its
 line; absorb pre-existing findings with an ``analysis_baseline.json`` (see
@@ -50,6 +56,7 @@ from petastorm_tpu.analysis.hashability import HashabilityChecker
 from petastorm_tpu.analysis.jax_purity import JaxPurityChecker
 from petastorm_tpu.analysis.lifecycle import ResourceLifecycleChecker
 from petastorm_tpu.analysis.locks import LockDisciplineChecker
+from petastorm_tpu.analysis.protocol_lints import ProtocolLintChecker
 from petastorm_tpu.analysis.telemetry import TelemetrySpanChecker
 
 #: the full first-party rule set, in rule-id order
@@ -62,15 +69,19 @@ ALL_CHECKERS = (
     HashabilityChecker,
     TelemetrySpanChecker,
     BaseExceptionContainmentChecker,
+    ProtocolLintChecker,
 )
 
 
-def run_analysis(paths, baseline=None, select=None):
+def run_analysis(paths, baseline=None, select=None, ignore=None):
     """Run every checker over ``paths`` (files or directories).
 
     :param baseline: a :class:`core.Baseline` (or None) absorbing known findings
     :param select: iterable of rule-id prefixes (e.g. ``['PT1', 'PT500']``)
         restricting which findings are reported; None = all
+    :param ignore: iterable of rule-id prefixes to suppress, applied AFTER
+        ``select`` — the staged-rollout knob (``--ignore PT8`` ships a new
+        family dark)
     :returns: sorted list of non-suppressed, non-baselined :class:`Finding`
     """
     sources = collect_sources(paths)
@@ -79,6 +90,9 @@ def run_analysis(paths, baseline=None, select=None):
     if select is not None:
         prefixes = tuple(select)
         findings = [f for f in findings if f.code.startswith(prefixes)]
+    if ignore is not None and tuple(ignore):
+        prefixes = tuple(ignore)
+        findings = [f for f in findings if not f.code.startswith(prefixes)]
     return findings
 
 
@@ -86,7 +100,7 @@ __all__ = [
     'ALL_CHECKERS', 'Baseline', 'BaseExceptionContainmentChecker', 'Checker',
     'ExceptionHygieneChecker', 'Finding',
     'HashabilityChecker', 'JaxPurityChecker', 'LockDisciplineChecker',
-    'NativeBufferChecker', 'ResourceLifecycleChecker', 'SourceFile',
-    'TelemetrySpanChecker', 'collect_sources', 'load_baseline', 'run_analysis',
-    'run_checkers',
+    'NativeBufferChecker', 'ProtocolLintChecker', 'ResourceLifecycleChecker',
+    'SourceFile', 'TelemetrySpanChecker', 'collect_sources', 'load_baseline',
+    'run_analysis', 'run_checkers',
 ]

@@ -5,8 +5,20 @@
     python -m petastorm_tpu.analysis [paths ...] [options]
     petastorm-tpu-lint [paths ...] [options]
 
-Default path is the installed ``petastorm_tpu`` package. Exit status: 0 when
-clean (after noqa + baseline), 1 when findings remain, 2 on usage errors.
+Default path is the installed ``petastorm_tpu`` package.
+
+Exit-code contract (stable; scripts and CI may rely on it):
+
+* ``0`` — clean: no findings remain after noqa suppression, baseline
+  absorption and ``--select``/``--ignore`` filtering (also: ``--rules`` and
+  ``--write-baseline`` succeeded).
+* ``1`` — findings remain (each printed to stdout).
+* ``2`` — usage error: unknown option, missing path, or a ``--select``/
+  ``--ignore`` token that matches no known rule family.
+
+``--select``/``--ignore`` take comma-separated rule-id prefixes and make
+staged rollouts possible: ship new rule families dark with ``--ignore PT8``,
+or gate a single family with ``--select PT8``.
 """
 
 from __future__ import annotations
@@ -15,6 +27,11 @@ import argparse
 import json
 import os
 import sys
+
+#: the documented exit-code contract
+EXIT_CLEAN = 0
+EXIT_FINDINGS = 1
+EXIT_USAGE = 2
 
 
 def _default_target():
@@ -40,7 +57,11 @@ def build_parser():
                         help='write the current findings as a baseline and exit 0')
     parser.add_argument('--select', metavar='CODES',
                         help='comma-separated rule-id prefixes to report '
-                             '(e.g. PT1,PT500)')
+                             '(e.g. PT1,PT500); everything else is dropped')
+    parser.add_argument('--ignore', metavar='CODES',
+                        help='comma-separated rule-id prefixes to suppress '
+                             '(applied after --select) — stage a new family '
+                             'dark with e.g. --ignore PT8')
     parser.add_argument('--rules', action='store_true',
                         help='list the rule families and exit')
     return parser
@@ -55,23 +76,40 @@ def main(argv=None):
     if args.rules:
         for cls in ALL_CHECKERS:
             print('{:<7} {:<22} {}'.format(cls.code, cls.name, cls.description))
-        return 0
+        return EXIT_CLEAN
 
     paths = args.paths or [_default_target()]
     for p in paths:
         if not os.path.exists(p):
             print('error: no such path: {}'.format(p), file=sys.stderr)
-            return 2
+            return EXIT_USAGE
 
-    select = [c.strip().upper() for c in args.select.split(',')] if args.select else None
+    def parse_prefixes(raw, flag):
+        if not raw:
+            return None
+        prefixes = [c.strip().upper() for c in raw.split(',') if c.strip()]
+        known = [cls.code for cls in ALL_CHECKERS] + ['PT000']
+        for prefix in prefixes:
+            if not any(code.startswith(prefix) for code in known):
+                print('error: {} prefix {!r} matches no known rule family '
+                      '(see --rules)'.format(flag, prefix), file=sys.stderr)
+                return EXIT_USAGE
+        return prefixes
+
+    select = parse_prefixes(args.select, '--select')
+    if select == EXIT_USAGE:
+        return EXIT_USAGE
+    ignore = parse_prefixes(args.ignore, '--ignore')
+    if ignore == EXIT_USAGE:
+        return EXIT_USAGE
     baseline = load_baseline(args.baseline) if args.baseline else None
-    findings = run_analysis(paths, baseline=baseline, select=select)
+    findings = run_analysis(paths, baseline=baseline, select=select, ignore=ignore)
 
     if args.write_baseline:
         write_baseline(args.write_baseline, findings)
         print('baseline with {} entr{} written to {}'.format(
             len(findings), 'y' if len(findings) == 1 else 'ies', args.write_baseline))
-        return 0
+        return EXIT_CLEAN
 
     if args.format == 'json':
         print(json.dumps({'findings': [f.to_dict() for f in findings],
@@ -82,7 +120,7 @@ def main(argv=None):
             if f.snippet:
                 print('    {}'.format(f.snippet))
         print('{} finding{}'.format(len(findings), '' if len(findings) == 1 else 's'))
-    return 1 if findings else 0
+    return EXIT_FINDINGS if findings else EXIT_CLEAN
 
 
 if __name__ == '__main__':

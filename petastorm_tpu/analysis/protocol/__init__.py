@@ -1,0 +1,33 @@
+"""Worker-pool protocol verifier: executable spec, exhaustive small-scope
+model checker, and runtime conformance monitor (``docs/protocol.md``).
+
+* :mod:`spec` — the supervision protocol (dispatch-id ownership, claim
+  heartbeats, two-stage death handling, stale dropping, quiet-window sweep)
+  as an explicit-state transition system with its five invariants stated as
+  predicates.
+* :mod:`modelcheck` — BFS over all interleavings for small configurations
+  with canonical state hashing and counterexample minimization; the
+  ``petastorm-tpu-modelcheck`` console script and the tier-1 budgeted test.
+* :mod:`monitor` — the opt-in runtime hook the pools feed their observed
+  events through; any sequence the spec rejects raises
+  :class:`~petastorm_tpu.errors.ProtocolViolation`.
+
+The PT8xx protocol lints (non-exhaustive kind dispatch, constants defined
+outside ``workers/protocol.py``) live in
+:mod:`petastorm_tpu.analysis.protocol_lints` with the other rule families.
+"""
+
+from __future__ import annotations
+
+from petastorm_tpu.analysis.protocol.modelcheck import (CheckResult, check,
+                                                        format_trace, minimize_trace)
+from petastorm_tpu.analysis.protocol.monitor import (ProtocolMonitor,
+                                                     ProtocolViolation, monitor_from_env)
+from petastorm_tpu.analysis.protocol.spec import (INVARIANTS, MUTATIONS, SpecConfig,
+                                                  replay_into_monitor, replay_trace)
+
+__all__ = [
+    'CheckResult', 'INVARIANTS', 'MUTATIONS', 'ProtocolMonitor',
+    'ProtocolViolation', 'SpecConfig', 'check', 'format_trace',
+    'minimize_trace', 'monitor_from_env', 'replay_into_monitor', 'replay_trace',
+]

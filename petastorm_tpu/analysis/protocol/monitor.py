@@ -1,0 +1,182 @@
+"""Runtime conformance monitor for the worker-pool supervision protocol.
+
+The observer projection of ``spec.py``: a :class:`ProtocolMonitor` ingests the
+consumer-visible events of a live pool — dispatches, requeues, consumed
+messages with the pool's live/stale classification, completions, epoch drains
+— and raises :class:`~petastorm_tpu.errors.ProtocolViolation` on any sequence
+the spec rejects. Where the model checker proves the *design* for small
+scopes, the monitor checks that the *implementation* actually walks the
+spec's transition relation on every real run (ThreadSanitizer-style: the
+checking rides the workload you already run).
+
+Opt in per pool (``ProcessPool(..., protocol_monitor=True)``), per reader
+(``make_reader(..., protocol_monitor=True)``), or process-wide via
+``PSTPU_PROTOCOL_MONITOR=1`` — which is how ``tests/test_fault_tolerance.py``
+and the ``--protocol-monitor`` bench flags turn every existing crash /
+requeue / poison scenario into a conformance proof. Overhead is one guarded
+method call per *item-level* event (never per row); with the monitor off the
+pools pay a single ``None`` check.
+
+Event rules (the spec's conformance contract, ``docs/protocol.md``):
+
+* dispatch ids are issued monotonically and NEVER reused;
+* a requeue must take a live id out of flight and issue a fresh one — and must
+  never requeue an item whose payload was already delivered (that is the
+  double-delivery defect the model checker surfaces as ``requeue_published``);
+* every consumed message must reference an issued id, and the pool's
+  live/stale classification must match the monitor's in-flight view;
+* each logical item (a dispatch-id chain linked by requeues) completes at most
+  once, and only from a live id;
+* at epoch drain the pool's ventilated/completed counters must equal the
+  monitor's, with nothing left in flight.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from petastorm_tpu.errors import ProtocolViolation
+
+
+class ProtocolMonitor(object):
+    """Thread-safe conformance monitor (pools emit events from consumer and
+    worker threads). All state is dispatch-id keyed, so it works for the
+    process pool's wire protocol and the thread/dummy pools' in-process
+    equivalent alike."""
+
+    def __init__(self, name='pool'):
+        self._name = name
+        self._lock = threading.Lock()
+        self._last_id = -1
+        self._inflight = {}    # live dispatch id -> root id (logical item chain)
+        self._resolved = {}    # retired/completed dispatch id -> root id
+        self._published = set()  # live ids whose payload reached the consumer
+        self._completed_roots = set()
+        self._seq_by_root = {}
+        self.ventilated = 0
+        self.completed = 0
+        self.violations_checked = 0
+
+    def _fail(self, message):
+        raise ProtocolViolation('[protocol monitor: {}] {}'.format(self._name, message))
+
+    def _fresh(self, d, what):
+        if d in self._inflight or d in self._resolved:
+            self._fail('{} reuses dispatch id {} — ids must never be reused or '
+                       'stale messages become indistinguishable from live ones'
+                       .format(what, d))
+        if d <= self._last_id:
+            self._fail('{} issued non-monotonic dispatch id {} (last was {})'
+                       .format(what, d, self._last_id))
+        self._last_id = d
+
+    # -- events --------------------------------------------------------------
+
+    def on_dispatch(self, d, seq=None):
+        """A new item was ventilated under dispatch id ``d``."""
+        with self._lock:
+            self.violations_checked += 1
+            self._fresh(d, 'dispatch')
+            self._inflight[d] = d
+            self._seq_by_root[d] = seq
+            self.ventilated += 1
+
+    def on_requeue(self, old_d, new_d):
+        """An in-flight item moved from ``old_d`` to a fresh ``new_d``."""
+        with self._lock:
+            self.violations_checked += 1
+            root = self._inflight.get(old_d)
+            if root is None:
+                self._fail('requeue of dispatch id {} which is not in flight '
+                           '(stale or never issued)'.format(old_d))
+            if old_d in self._published:
+                self._fail('requeue of dispatch id {} whose payload was already '
+                           'delivered — re-running it would deliver the item '
+                           'twice'.format(old_d))
+            self._fresh(new_d, 'requeue')
+            del self._inflight[old_d]
+            self._resolved[old_d] = root
+            self._inflight[new_d] = root
+
+    def on_message(self, kind, d, live=None):
+        """The consumer processed a ``kind`` message for dispatch ``d``.
+        ``live`` is the pool's stale/live classification (None when the kind
+        carries no such decision, e.g. claims)."""
+        if d is None:
+            return  # untagged message (startup, idle beacon): nothing to check
+        with self._lock:
+            self.violations_checked += 1
+            known = d in self._inflight or d in self._resolved
+            if not known:
+                self._fail('{} message for dispatch id {} which was never '
+                           'issued'.format(kind, d))
+            if live is True and d not in self._inflight:
+                self._fail('pool treated a {} for retired dispatch id {} as '
+                           'live — stale stragglers must be dropped'.format(kind, d))
+            if live is False and d in self._inflight:
+                self._fail('pool dropped a {} for live dispatch id {} as '
+                           'stale'.format(kind, d))
+            if kind == 'data' and live:
+                self._published.add(d)
+
+    def on_complete(self, d, delivered, quarantined=False):
+        """The pool resolved dispatch ``d`` (done consumed / orphan published /
+        quarantine / error-completion) and advanced its completion counter."""
+        with self._lock:
+            self.violations_checked += 1
+            root = self._inflight.pop(d, None)
+            if root is None:
+                self._fail('completion for dispatch id {} which is not in '
+                           'flight — a stale duplicate must not advance the '
+                           'epoch accounting'.format(d))
+            self._resolved[d] = root
+            self._published.discard(d)
+            if root in self._completed_roots:
+                self._fail('item (root dispatch {}, seq {}) completed twice'
+                           .format(root, self._seq_by_root.get(root)))
+            self._completed_roots.add(root)
+            self.completed += 1
+
+    def on_drained(self, pool_ventilated, pool_completed):
+        """The pool declared the epoch drained (``EmptyResultError``)."""
+        with self._lock:
+            self.violations_checked += 1
+            if self._inflight:
+                self._fail('epoch declared drained with {} dispatch id(s) still '
+                           'in flight: {}'.format(
+                               len(self._inflight), sorted(self._inflight)))
+            if (pool_ventilated, pool_completed) != (self.ventilated, self.completed):
+                self._fail('pool counters (ventilated={}, completed={}) diverge '
+                           'from observed events (ventilated={}, completed={})'
+                           .format(pool_ventilated, pool_completed,
+                                   self.ventilated, self.completed))
+            if pool_ventilated != pool_completed:
+                self._fail('drained epoch with ventilated={} != completed={}'
+                           .format(pool_ventilated, pool_completed))
+
+    @property
+    def snapshot(self):
+        """Diagnostics view: counters + in-flight ids (for test assertions)."""
+        with self._lock:
+            return {'ventilated': self.ventilated, 'completed': self.completed,
+                    'in_flight': sorted(self._inflight),
+                    'events_checked': self.violations_checked}
+
+
+def monitor_from_env(explicit, name):
+    """Resolve a pool's ``protocol_monitor`` constructor argument: a
+    :class:`ProtocolMonitor` instance is used as-is, truthy builds a fresh
+    one, ``None`` consults ``PSTPU_PROTOCOL_MONITOR`` (the process-wide
+    opt-in used by the fault-tolerance suite and the bench ``--protocol-
+    monitor`` flags), falsy disables."""
+    import os
+    if explicit is None:
+        explicit = os.environ.get('PSTPU_PROTOCOL_MONITOR', '') not in ('', '0')
+    if not explicit:
+        return None
+    if isinstance(explicit, ProtocolMonitor):
+        return explicit
+    return ProtocolMonitor(name=name)
+
+
+__all__ = ['ProtocolMonitor', 'ProtocolViolation', 'monitor_from_env']

@@ -52,3 +52,11 @@ class PoisonItemError(PetastormTpuError):
 class WorkerPoolDepletedError(PetastormTpuError):
     """Worker respawn kept failing and the pool degraded to zero live
     workers — nothing is left to process ventilated items."""
+
+
+class ProtocolViolation(PetastormTpuError):
+    """An observed worker-pool event sequence the supervision protocol spec
+    rejects (``petastorm_tpu/analysis/protocol/``): a reused dispatch id, a
+    message for a never-issued id, a live/stale misclassification, a second
+    completion for one item, or diverged accounting at epoch drain. Raised by
+    the opt-in runtime conformance monitor (``docs/protocol.md``)."""

@@ -44,7 +44,7 @@ _VENTILATE_EXTRA_ROWGROUPS = 2
 
 
 def _make_pool(reader_pool_type, workers_count, results_queue_size, serializer=None,
-               on_error='raise', max_item_retries=None):
+               on_error='raise', max_item_retries=None, protocol_monitor=None):
     """Pool construction incl. IPC serializer selection. The reference picks a
     columnar serializer only for its batch readers (reference reader.py:269);
     here EVERY worker publishes column blocks, so the raw-buffer
@@ -56,7 +56,8 @@ def _make_pool(reader_pool_type, workers_count, results_queue_size, serializer=N
     mutate-in-place affordance thread-pool blocks have.
     ``on_error``/``max_item_retries`` (docs/robustness.md) are implemented by
     every pool type, so failure behavior is pool-independent."""
-    policy = {'on_error': _resolve_error_policy(on_error, max_item_retries)}
+    policy = {'on_error': _resolve_error_policy(on_error, max_item_retries),
+              'protocol_monitor': protocol_monitor}
     if reader_pool_type == 'thread':
         return ThreadPool(workers_count, results_queue_size, **policy)
     if reader_pool_type == 'process':
@@ -129,7 +130,8 @@ def make_reader(dataset_url,
                 storage_retry_policy=None,
                 chunk_cache=None, chunk_cache_size_limit=None,
                 telemetry=None,
-                on_error='raise', max_item_retries=None):
+                on_error='raise', max_item_retries=None,
+                protocol_monitor=None):
     """Reader for datasets written by :func:`materialize_dataset` — rows decoded
     through the stored Unischema's codecs (reference reader.py:50-174).
 
@@ -197,6 +199,14 @@ def make_reader(dataset_url,
     :param max_item_retries: consecutive failures (errors or worker-killing
         crashes) one item may cause before the policy's terminal action
         (default 2 — an item runs at most 3 times).
+    :param protocol_monitor: opt-in runtime conformance checking of the
+        worker-pool supervision protocol (``docs/protocol.md``): truthy
+        attaches a fresh monitor to the pool, a
+        :class:`~petastorm_tpu.analysis.protocol.monitor.ProtocolMonitor`
+        instance is used as-is, None honors the ``PSTPU_PROTOCOL_MONITOR``
+        env var. Any event sequence the protocol spec rejects raises
+        :class:`~petastorm_tpu.errors.ProtocolViolation` on the iterating
+        thread.
     """
     error_policy = _resolve_error_policy(on_error, max_item_retries)
     try:
@@ -228,7 +238,7 @@ def make_reader(dataset_url,
 
     cache = _make_cache(cache_type, cache_location, cache_size_limit, cache_row_size_estimate)
     pool = _make_pool(reader_pool_type, workers_count, results_queue_size,
-                      on_error=error_policy)
+                      on_error=error_policy, protocol_monitor=protocol_monitor)
     return Reader(dataset_url, schema,
                   worker_class=RowGroupDecoderWorker,
                   results_queue_reader_factory=results_queue_reader_factory,
@@ -262,7 +272,8 @@ def make_batch_reader(dataset_url,
                       storage_retry_policy=None,
                       chunk_cache=None, chunk_cache_size_limit=None,
                       telemetry=None,
-                      on_error='raise', max_item_retries=None):
+                      on_error='raise', max_item_retries=None,
+                      protocol_monitor=None):
     """Columnar reader for ANY Parquet store (reference reader.py:177-289):
     yields one namedtuple of numpy column arrays per row group
     (``batched_output=True``). Schema is inferred from the Arrow schema unless
@@ -282,13 +293,17 @@ def make_batch_reader(dataset_url,
 
     ``on_error``/``max_item_retries``: item-failure policy ('raise' | 'skip' |
     'retry', docs/robustness.md) — identical semantics to :func:`make_reader`.
+
+    ``protocol_monitor``: opt-in runtime conformance checking of the pool
+    supervision protocol (docs/protocol.md) — identical semantics to
+    :func:`make_reader`.
     """
     error_policy = _resolve_error_policy(on_error, max_item_retries)
     schema = dataset_metadata.infer_or_load_unischema(dataset_url,
                                                       retry_policy=storage_retry_policy)
     cache = _make_cache(cache_type, cache_location, cache_size_limit, cache_row_size_estimate)
     pool = _make_pool(reader_pool_type, workers_count, results_queue_size,
-                      on_error=error_policy)
+                      on_error=error_policy, protocol_monitor=protocol_monitor)
     results_queue_reader_factory = _columnar_results_reader_factory(
         'columnar', batch_size, drop_last, None)
     return Reader(dataset_url, schema,

@@ -5,6 +5,7 @@ from __future__ import annotations
 
 import time
 
+from petastorm_tpu.workers import protocol
 from petastorm_tpu.workers.worker_base import WorkerBase
 
 
@@ -112,3 +113,38 @@ class EnvEchoWorker(WorkerBase):
     def process(self, item):
         import os
         self.publish((item, os.environ.get(self.args)))
+
+
+class ProtocolEchoWorker(WorkerBase):
+    """Publishes the canonical message-kind table as resolved INSIDE the
+    worker — proves a spawned worker and the supervisor share ONE protocol
+    module (``workers/protocol.py``), the single-definition-site property
+    PT801 enforces statically."""
+
+    def process(self, item):
+        self.publish((item, sorted(protocol.MESSAGE_KINDS.values()),
+                      protocol.RING_HEADER_LEN))
+
+
+class PublishThenErrorWorker(WorkerBase):
+    """Publishes its item, THEN raises — on the first attempt per item in
+    ``args['fail_on']`` (one-shot via an ``O_EXCL`` flag file under
+    ``args['state_dir']``, so it coordinates across spawned processes).
+
+    This is the runnable form of the protocol model checker's
+    ``requeue_published`` counterexample: dispatch -> claim -> publish ->
+    error. A pool that requeues here delivers the published rows twice; the
+    conforming pool must complete the item as delivered instead
+    (``tests/test_fault_tolerance.py``)."""
+
+    def process(self, item):
+        import os
+        self.publish(item)
+        if item in self.args.get('fail_on', ()):
+            token = os.path.join(self.args['state_dir'], 'pub_err_{}'.format(item))
+            try:
+                fd = os.open(token, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            except FileExistsError:
+                return  # already failed once; succeed this attempt
+            os.close(fd)
+            raise ValueError('post-publish failure on {}'.format(item))

@@ -8,9 +8,14 @@ processes (shm-ring/ZMQ transport), or the caller thread (dummy), fed by a
 Beyond the reference: the process pool supervises its workers (heartbeats,
 exitcode polling, respawn + exactly-once requeue), and every pool implements
 the uniform ``on_error``/``max_item_retries`` item-failure policy with
-poison-item quarantine — see ``docs/robustness.md``.
+poison-item quarantine — see ``docs/robustness.md``. The supervision wire
+protocol itself is canonical in :mod:`petastorm_tpu.workers.protocol` and
+formally checked by ``petastorm_tpu/analysis/protocol/`` (executable spec,
+exhaustive small-scope model checker, opt-in runtime conformance monitor via
+``protocol_monitor=``/``PSTPU_PROTOCOL_MONITOR`` — ``docs/protocol.md``).
 """
 
+from petastorm_tpu.workers import protocol  # noqa: F401
 from petastorm_tpu.workers.worker_base import WorkerBase, EmptyResultError  # noqa: F401
 from petastorm_tpu.workers.supervision import ErrorPolicy  # noqa: F401
 from petastorm_tpu.workers.thread_pool import ThreadPool  # noqa: F401

@@ -22,24 +22,29 @@ from collections import deque
 
 from petastorm_tpu import faults, observability as obs
 from petastorm_tpu.errors import EmptyResultError
+# canonical message-kind vocabulary + dispatch-id allocator (workers/protocol.py);
+# PT801 rejects local kind definitions
+from petastorm_tpu.workers.protocol import MSG_DATA, MSG_DONE, DispatchIds
 from petastorm_tpu.workers.supervision import (ErrorPolicy, attach_remote_context,
                                                format_exception_tb, quarantine_record)
 
 logger = logging.getLogger(__name__)
 
-_DATA, _DONE = 0, 1
-
 
 class DummyPool(object):
     def __init__(self, workers_count=1, results_queue_size=None,
-                 on_error='raise', max_item_retries=None):
-        self._results = deque()  # (_DATA, seq, payload) | (_DONE, seq, None)
-        self._pending = deque()  # (args, kwargs, attempts) not yet processed (_seq rides kwargs)
+                 on_error='raise', max_item_retries=None, protocol_monitor=None):
+        self._results = deque()  # (MSG_DATA, seq, payload) | (MSG_DONE, seq, None)
+        self._pending = deque()  # (dispatch, args, kwargs, attempts) (_seq rides kwargs)
         self._pending_lock = threading.Lock()
         self._worker = None
+        self._stopped = False
         self._ventilator = None
         self._worker_error = None
         self._current_seq = None
+        self._current_dispatch = None
+        self._current_published = False
+        self._dispatch_ids = DispatchIds()
         self._ventilated_items = 0
         self._completed_items = 0
         self._items_requeued = 0
@@ -51,21 +56,38 @@ class DummyPool(object):
         # checkpoint plumbing (see thread_pool.py)
         self.last_result_seq = None
         self.done_callback = None
+        # opt-in protocol conformance monitor (docs/protocol.md). The dummy
+        # pool runs worker.process on the consumer thread, so payloads enter
+        # the results deque BEFORE the item's completion bookkeeping — the
+        # delivery event therefore fires at publish time, not at pop time.
+        import os
+        self.protocol_monitor = None
+        if protocol_monitor or (protocol_monitor is None and
+                                os.environ.get('PSTPU_PROTOCOL_MONITOR', '') not in ('', '0')):
+            from petastorm_tpu.analysis.protocol.monitor import monitor_from_env
+            self.protocol_monitor = monitor_from_env(protocol_monitor, 'dummy-pool')
 
     def start(self, worker_class, worker_setup_args=None, ventilator=None):
         if self._worker is not None:
             raise RuntimeError('Pool already started')
-        self._worker = worker_class(
-            0, lambda data: self._results.append((_DATA, self._current_seq, data)),
-            worker_setup_args)
+        self._worker = worker_class(0, self._publish, worker_setup_args)
         if ventilator is not None:
             self._ventilator = ventilator
             self._ventilator.start()
 
+    def _publish(self, data):
+        self._current_published = True
+        if self.protocol_monitor is not None and self._current_dispatch is not None:
+            self.protocol_monitor.on_message('data', self._current_dispatch, live=True)
+        self._results.append((MSG_DATA, self._current_seq, data))
+
     def ventilate(self, *args, **kwargs):
         with self._pending_lock:
-            self._pending.append((args, kwargs, 0))
             self._ventilated_items += 1
+            d = self._dispatch_ids.next()
+            if self.protocol_monitor is not None:
+                self.protocol_monitor.on_dispatch(d, dict(kwargs).get('_seq'))
+            self._pending.append((d, args, kwargs, 0))
 
     def _process_one(self):
         """Run one pending task on THIS thread. Returns False when none were
@@ -73,36 +95,54 @@ class DummyPool(object):
         with self._pending_lock:
             if not self._pending:
                 return False
-            args, orig_kwargs, attempts = self._pending.popleft()
+            d, args, orig_kwargs, attempts = self._pending.popleft()
         kwargs = dict(orig_kwargs)
         self._current_seq = kwargs.pop('_seq', None)
+        self._current_dispatch = d
+        self._current_published = False
         completed = True
+        delivered = False
         try:
             faults.on_item(kwargs)
             self._worker.process(*args, **kwargs)
-            self._results.append((_DONE, self._current_seq, None))
+            self._results.append((MSG_DONE, self._current_seq, None))
+            delivered = True
         except Exception as e:  # noqa: BLE001 - routed through the error policy
-            completed = self._handle_item_failure(e, args, orig_kwargs, attempts + 1)
+            completed, delivered = self._handle_item_failure(e, d, args, orig_kwargs,
+                                                             attempts + 1)
         finally:
             if completed:
                 with self._pending_lock:
                     self._completed_items += 1
+                    if self.protocol_monitor is not None:
+                        self.protocol_monitor.on_complete(d, delivered)
                 if self._ventilator is not None:
                     self._ventilator.processed_item()
         return True
 
-    def _handle_item_failure(self, exc, args, orig_kwargs, attempts):
-        """Apply the on_error policy. Returns True when the item reached a
-        terminal state (counts complete), False when it was requeued."""
+    def _handle_item_failure(self, exc, d, args, orig_kwargs, attempts):
+        """Apply the on_error policy. Returns ``(completed, delivered)``:
+        completed False means the item was requeued."""
         seq = self._current_seq
+        if self._current_published and self._policy.on_error != 'raise':
+            # publishes already landed in the results deque — a re-run would
+            # deliver them twice (the protocol model checker's
+            # requeue_published counterexample); complete delivered instead
+            logger.warning('Item seq=%s failed AFTER publishing; completing the '
+                           'item rather than re-running it: %s', seq, exc)
+            self._results.append((MSG_DONE, seq, None))
+            return True, True
         if self._policy.should_retry_error(attempts):
             logger.warning('Item seq=%s failed (attempt %d/%d); requeueing: %s',
                            seq, attempts, self._policy.max_item_retries + 1, exc)
             with self._pending_lock:
-                self._pending.append((args, orig_kwargs, attempts))
+                nd = self._dispatch_ids.next()
+                if self.protocol_monitor is not None:
+                    self.protocol_monitor.on_requeue(d, nd)
+                self._pending.append((nd, args, orig_kwargs, attempts))
                 self._items_requeued += 1
             obs.count('items_requeued')
-            return False
+            return False, False
         if self._policy.quarantines():
             record = quarantine_record(seq, attempts, 'error', error=exc,
                                        tb=format_exception_tb(exc), worker_id=0,
@@ -112,19 +152,19 @@ class DummyPool(object):
             obs.count('items_quarantined')
             logger.error('Quarantining item seq=%s after %d failed attempts: %s',
                          seq, attempts, record['error'])
-            return True
+            return True, False
         attach_remote_context(exc, format_exception_tb(exc), worker_id=0, seq=seq)
         self._worker_error = exc
         if self._ventilator is not None:
             self._ventilator.stop()
-        return True
+        return True, False
 
     def _pop_ready(self):
         """Pop queued entries until a payload is found; process completion
         sentinels on the way. Returns the payload or None."""
         while self._results:
             kind, seq, payload = self._results.popleft()
-            if kind == _DATA:
+            if kind == MSG_DATA:
                 self.last_result_seq = seq
                 return payload
             if seq is not None and self.done_callback is not None:
@@ -160,12 +200,20 @@ class DummyPool(object):
                 if self._worker_error is not None:
                     error, self._worker_error = self._worker_error, None
                     raise error
+                if self.protocol_monitor is not None and not self._stopped:
+                    # after stop() the pending queue was deliberately dropped,
+                    # so the drain is not a convergence claim
+                    with self._pending_lock:
+                        ventilated, completed = (self._ventilated_items,
+                                                 self._completed_items)
+                    self.protocol_monitor.on_drained(ventilated, completed)
                 raise EmptyResultError()
             # brief wait: only reachable while the ventilator thread is between
             # enqueues (it does no processing, so this resolves in microseconds)
             time.sleep(0.0001)
 
     def stop(self):
+        self._stopped = True
         if self._ventilator is not None:
             self._ventilator.stop()
         # parity with ThreadPool (whose workers exit on the stop event): items

@@ -41,7 +41,6 @@ import multiprocessing
 import os
 import pickle
 import shutil
-import struct
 import sys
 import tempfile
 import threading
@@ -53,24 +52,20 @@ import zmq
 from petastorm_tpu import faults, observability as obs
 from petastorm_tpu.errors import (EmptyResultError, PoisonItemError,
                                   TimeoutWaitingForResultError, WorkerPoolDepletedError)
+# every wire constant (message kinds, ring framing, dispatch ids) comes from
+# the canonical protocol module — lint rule PT801 rejects local redefinitions.
+# MSG_HEARTBEAT is the supervision piggyback (claim + liveness beacons);
+# MSG_METRICS the telemetry piggyback — both ride the ordered results channel
+# so a claim always precedes its item's completion and the final metrics
+# snapshot lands before the pool looks drained.
+from petastorm_tpu.workers.protocol import (CONTROL_FINISHED, MSG_BLOB, MSG_DATA,
+                                            MSG_DONE, MSG_ERROR, MSG_HEARTBEAT,
+                                            MSG_METRICS, MSG_STARTED, DispatchIds,
+                                            ring_header, ring_unpack)
 from petastorm_tpu.workers.supervision import (ErrorPolicy, attach_remote_context,
                                                format_exception_tb, quarantine_record)
 
 logger = logging.getLogger(__name__)
-
-_CONTROL_FINISHED = b'FINISHED'
-_STARTED, _DATA, _DONE, _ERROR, _BLOB = b'S', b'D', b'F', b'E', b'B'
-#: telemetry piggyback on the results channel: a worker ships its cumulative
-#: metrics snapshot (and, at spans level, its drained trace events) after each
-#: completed item — the same route the payloads travel, so ordering guarantees
-#: the final snapshot arrives before the consumer sees the pool as drained
-_METRICS = b'M'
-#: supervision piggyback on the results channel: liveness + item-ownership
-#: beacons. A worker sends one *claim* heartbeat (busy=dispatch id) before
-#: processing an item, one idle heartbeat after finishing it, and periodic
-#: idle heartbeats while waiting for work — so the supervisor always knows
-#: which item a worker holds and how stale its liveness information is.
-_HEARTBEAT = b'H'
 
 _WORKER_STARTUP_TIMEOUT_S = 30
 _DEFAULT_RESULTS_HWM = 50
@@ -163,25 +158,13 @@ def _read_blob(path):
     return memoryview(mm)
 
 
-def _ring_header(kind, seq):
-    """Ring message framing: kind byte + little-endian int64 seq (-1 = None),
-    then the payload; header and payload are gather-written as one message."""
-    return kind + struct.pack('<q', -1 if seq is None else seq)
-
-
-def _ring_unpack(view):
-    """(kind, seq, payload_view) from a message memoryview — the payload stays
-    a zero-copy view handed straight to the deserializer."""
-    seq = struct.unpack_from('<q', view, 1)[0]
-    return bytes(view[0:1]), (None if seq < 0 else seq), view[9:]
-
-
 class ProcessPool(object):
     def __init__(self, workers_count, results_queue_size=_DEFAULT_RESULTS_HWM, serializer=None,
                  results_timeout_s=None, transport=None, ring_bytes=_DEFAULT_RING_BYTES,
                  blob_threshold_bytes=_DEFAULT_BLOB_THRESHOLD,
                  on_error='raise', max_item_retries=None,
-                 supervision=True, heartbeat_interval_s=_DEFAULT_HEARTBEAT_S):
+                 supervision=True, heartbeat_interval_s=_DEFAULT_HEARTBEAT_S,
+                 protocol_monitor=None):
         """``results_timeout_s``: raise if no worker message arrives within this
         many seconds (None = block indefinitely, matching ThreadPool).
         ``transport``: 'shm' (first-party C++ shared-memory rings) | 'zmq' |
@@ -196,7 +179,14 @@ class ProcessPool(object):
         ``supervision``: heartbeat + exitcode monitoring with respawn/requeue;
         disabling it restores the legacy behavior where a dead worker strands
         its items until ``results_timeout_s``.
-        ``heartbeat_interval_s``: worker liveness beacon period."""
+        ``heartbeat_interval_s``: worker liveness beacon period.
+        ``protocol_monitor``: opt-in runtime conformance checking of the
+        supervision protocol (``docs/protocol.md``) — a
+        :class:`~petastorm_tpu.analysis.protocol.monitor.ProtocolMonitor`
+        instance, truthy for a fresh one, or None to honor the
+        ``PSTPU_PROTOCOL_MONITOR`` env var; any observed event sequence the
+        protocol spec rejects raises
+        :class:`~petastorm_tpu.errors.ProtocolViolation`."""
         self._workers_count = workers_count
         self._results_hwm = results_queue_size
         from petastorm_tpu.serializers import PickleSerializer
@@ -234,7 +224,7 @@ class ProcessPool(object):
         # supervise) both touch; callbacks into the ventilator always run with
         # it RELEASED (single lock, no ordering cycles)
         self._state_lock = threading.Lock()
-        self._next_dispatch = 0
+        self._dispatch_ids = DispatchIds()
         self._inflight = {}         # dispatch id -> item record dict
         self._orphans = {}          # dispatch id -> monotonic death time
         self._quarantined = []
@@ -260,6 +250,16 @@ class ProcessPool(object):
         # pid -> latest cumulative metrics snapshot from that worker process
         # (consumer thread only; merged by Reader.diagnostics)
         self._telemetry_by_pid = {}
+        # opt-in protocol conformance monitor (docs/protocol.md); the analysis
+        # import stays lazy so the default path never loads the linter stack.
+        # Monitor events are emitted under _state_lock where they must order
+        # with the accounting they describe (dispatch/requeue/complete), so
+        # the only lock nesting is _state_lock -> monitor lock, never reverse.
+        self.protocol_monitor = None
+        if protocol_monitor or (protocol_monitor is None and
+                                os.environ.get('PSTPU_PROTOCOL_MONITOR', '') not in ('', '0')):
+            from petastorm_tpu.analysis.protocol.monitor import monitor_from_env
+            self.protocol_monitor = monitor_from_env(protocol_monitor, 'process-pool')
 
     @property
     def transport(self):
@@ -401,10 +401,14 @@ class ProcessPool(object):
                         started, self._workers_count, _WORKER_STARTUP_TIMEOUT_S))
             msg = self._poll_message(100)
             if msg is not None:
-                if msg[0] == _STARTED:
+                if msg[0] == MSG_STARTED:
                     started += 1
-                elif msg[0] == _HEARTBEAT:
+                elif msg[0] == MSG_HEARTBEAT:
                     self._note_heartbeat(msg[2])
+                else:
+                    # nothing else can legally precede the handshake (items are
+                    # ventilated only after start() returns); PT800-exhaustive
+                    logger.warning('dropping pre-handshake message of kind %r', msg[0])
 
         if ventilator is not None:
             self._ventilator = ventilator
@@ -418,7 +422,7 @@ class ProcessPool(object):
             if not self._results_receive.poll(timeout_ms):
                 return None
             kind, seq_bytes, payload = self._results_receive.recv_multipart()
-            if kind == _DATA:
+            if kind == MSG_DATA:
                 # bytes are immutable and would make the deserializer's views
                 # read-only; the ring and blob channels hand out writable
                 # views, and the contract must not depend on the transport
@@ -433,11 +437,11 @@ class ProcessPool(object):
                         continue
                     view = ring.try_read_view()
                     if view is not None:
-                        return _ring_unpack(view)
+                        return ring_unpack(view)
                 for ring in self._retired_rings:
                     view = ring.try_read_view()
                     if view is not None:
-                        return _ring_unpack(view)
+                        return ring_unpack(view)
             if time.monotonic() >= deadline:
                 return None
             # exponential backoff to 2ms: a sleeping consumer leaves the cores
@@ -449,10 +453,13 @@ class ProcessPool(object):
         seq = kwargs.pop('_seq', None)
         with self._state_lock:
             self._ventilated_items += 1
-            d = self._next_dispatch
-            self._next_dispatch += 1
+            d = self._dispatch_ids.next()
             self._inflight[d] = {'seq': seq, 'args': args, 'kwargs': kwargs,
                                  'attempts': 0, 'published': False}
+            if self.protocol_monitor is not None:
+                # inside the lock: id allocation and the dispatch event must
+                # be atomic or concurrent ventilates report out of order
+                self.protocol_monitor.on_dispatch(d, seq)
         with self._vent_lock:
             self._ventilator_send.send_pyobj((d, args, kwargs))
 
@@ -465,12 +472,13 @@ class ProcessPool(object):
             if self._inflight.get(d) is not rec:
                 return  # resolved concurrently
             del self._inflight[d]
-            nd = self._next_dispatch
-            self._next_dispatch += 1
+            nd = self._dispatch_ids.next()
             rec['attempts'] += 1
             rec['published'] = False
             self._inflight[nd] = rec
             self._items_requeued += 1
+            if self.protocol_monitor is not None:
+                self.protocol_monitor.on_requeue(d, nd)
         obs.count('items_requeued')
         with self._vent_lock:
             self._ventilator_send.send_pyobj((nd, rec['args'], rec['kwargs']))
@@ -483,8 +491,10 @@ class ProcessPool(object):
         exactly once."""
         with self._state_lock:
             if d is not None and self._inflight.pop(d, None) is None:
-                return  # stale duplicate (e.g. _DONE from a pre-requeue attempt)
+                return  # stale duplicate (e.g. MSG_DONE from a pre-requeue attempt)
             self._completed_items += 1
+            if self.protocol_monitor is not None and d is not None:
+                self.protocol_monitor.on_complete(d, delivered)
         if self._ventilator is not None:
             self._ventilator.processed_item()
         if delivered and rec is not None and rec['seq'] is not None \
@@ -505,6 +515,11 @@ class ProcessPool(object):
                 self._supervise(idle=msg is None)
             if msg is None:
                 if self._all_done():
+                    if self.protocol_monitor is not None:
+                        with self._state_lock:
+                            ventilated, completed = (self._ventilated_items,
+                                                     self._completed_items)
+                        self.protocol_monitor.on_drained(ventilated, completed)
                     raise EmptyResultError()
                 if self._supervision and self._all_slots_shed():
                     raise WorkerPoolDepletedError(
@@ -516,13 +531,15 @@ class ProcessPool(object):
                     raise TimeoutWaitingForResultError(self._timeout_message(timeout_s))
                 continue
             kind, d, payload = msg
-            if kind == _DATA or kind == _BLOB:
+            if kind == MSG_DATA or kind == MSG_BLOB:
                 with self._state_lock:
                     rec = self._inflight.get(d) if d is not None else None
+                if self.protocol_monitor is not None and d is not None:
+                    self.protocol_monitor.on_message('data', d, live=rec is not None)
                 if d is not None and rec is None:
                     # stale duplicate from a requeued attempt: the item was (or
                     # will be) delivered under its new dispatch id
-                    if kind == _BLOB:
+                    if kind == MSG_BLOB:
                         try:
                             os.unlink(bytes(payload).decode())
                         except OSError:
@@ -531,26 +548,33 @@ class ProcessPool(object):
                 if rec is not None:
                     rec['published'] = True
                 self.last_result_seq = rec['seq'] if rec is not None else None
-                if kind == _DATA:
+                if kind == MSG_DATA:
                     return self._serializer.deserialize(payload)
                 return self._serializer.deserialize(_read_blob(bytes(payload).decode()))
-            elif kind == _DONE:
+            elif kind == MSG_DONE:
                 self._clear_claim(d)
                 with self._state_lock:
                     rec = self._inflight.get(d) if d is not None else None
+                if self.protocol_monitor is not None and d is not None:
+                    self.protocol_monitor.on_message('done', d, live=rec is not None)
                 if d is not None and rec is None:
                     continue  # stale duplicate
                 self._complete(d, rec, delivered=True)
-            elif kind == _METRICS:
+            elif kind == MSG_METRICS:
                 self._absorb_telemetry(payload)
-            elif kind == _HEARTBEAT:
+            elif kind == MSG_HEARTBEAT:
                 self._note_heartbeat(payload)
-            elif kind == _ERROR:
+            elif kind == MSG_ERROR:
                 self._clear_claim(d)
                 exc = self._handle_worker_error(d, payload)
                 if exc is not None:
                     raise exc
-            # late _STARTED messages are ignored
+            elif kind == MSG_STARTED:
+                pass  # late joiner after the startup handshake already passed
+            else:
+                # PT800 keeps this dispatch exhaustive over protocol.ALL_KINDS;
+                # an unknown byte means a framing bug, never a silent drop
+                logger.warning('dropping message with unknown protocol kind %r', kind)
 
     def _handle_worker_error(self, d, payload):
         """Apply the item-failure policy to a worker-raised exception. Returns
@@ -567,10 +591,24 @@ class ProcessPool(object):
             exc, tb, worker_id, pid = err, None, None, None
         with self._state_lock:
             rec = self._inflight.get(d) if d is not None else None
+        if self.protocol_monitor is not None and d is not None:
+            self.protocol_monitor.on_message('error', d, live=rec is not None)
         if d is not None and rec is None:
             return None  # stale report from a pre-requeue attempt
         attempts = (rec['attempts'] if rec is not None else 0) + 1
         seq = rec['seq'] if rec is not None else None
+        if rec is not None and rec['published'] and self._policy.on_error != 'raise':
+            # The item's payload already reached the consumer — the results
+            # channel is FIFO, so its MSG_DATA preceded this MSG_ERROR.
+            # Re-running (or quarantining) it would deliver the rows twice (or
+            # retract a delivery); it completes delivered instead, exactly as
+            # a crash after publish does in _resolve_orphans. Surfaced by the
+            # protocol model checker as the requeue_published counterexample.
+            logger.warning('Worker %s failed on item seq=%s AFTER its payload was '
+                           'delivered; completing the item rather than re-running '
+                           'it: %s', worker_id, seq, exc)
+            self._complete(d, rec, delivered=True)
+            return None
         if rec is not None and self._policy.should_retry_error(attempts):
             logger.warning('Worker %s failed on item seq=%s (attempt %d/%d); requeueing: %s',
                            worker_id, seq, attempts, self._policy.max_item_retries + 1, exc)
@@ -599,7 +637,7 @@ class ProcessPool(object):
     # -- supervision --------------------------------------------------------
 
     def _clear_claim(self, d):
-        """A _DONE/_ERROR for dispatch ``d`` implicitly releases its owner's
+        """A MSG_DONE/MSG_ERROR for dispatch ``d`` implicitly releases its owner's
         claim (the results transport is ordered, so the claim beacon always
         precedes its item's completion) — saving the worker a trailing idle
         beacon per item. Also counts as a liveness proof."""
@@ -619,6 +657,10 @@ class ProcessPool(object):
             logger.debug('dropping malformed heartbeat: %s', e)
             return
         self._heartbeats_received += 1
+        if self.protocol_monitor is not None and hb.get('busy') is not None:
+            # a claim beacon: the referenced dispatch id must have been issued
+            # (stale claims are legal — the requeue may have already happened)
+            self.protocol_monitor.on_message('claim', hb.get('busy'))
         state = self._worker_state.setdefault(worker_id, {})
         state['pid'] = hb.get('pid')
         state['busy'] = hb.get('busy')
@@ -760,7 +802,7 @@ class ProcessPool(object):
             with self._state_lock:
                 rec = self._inflight.get(d)
             if rec is None:
-                continue  # its _DONE landed during the grace window
+                continue  # its MSG_DONE landed during the grace window
             if rec['published']:
                 # payload was delivered; only the completion sentinel was lost
                 self._complete(d, rec, delivered=True)
@@ -893,7 +935,7 @@ class ProcessPool(object):
         self._stopped = True
         # slow-joiner-safe: a worker that connects its SUB socket after this
         # publish would miss it, so join() rebroadcasts while draining
-        self._control_send.send(_CONTROL_FINISHED)
+        self._control_send.send(CONTROL_FINISHED)
 
     def join(self):
         if not self._stopped:
@@ -901,7 +943,7 @@ class ProcessPool(object):
         deadline = time.monotonic() + 10
         while any(p is not None and p.is_alive() for p in self._processes) \
                 and time.monotonic() < deadline:
-            self._control_send.send(_CONTROL_FINISHED)
+            self._control_send.send(CONTROL_FINISHED)
             # drain results so workers blocked on a full transport can exit
             if self._transport == 'zmq':
                 while self._results_receive.poll(0):
@@ -1017,7 +1059,7 @@ def _worker_bootstrap(worker_id, main_pid, setup_blob, vent_addr, result_addr, c
         """Also polled while blocked on a full ring, so shutdown never
         deadlocks against an unconsumed results transport."""
         if not finished['flag'] and control_recv.poll(0):
-            if control_recv.recv() == _CONTROL_FINISHED:
+            if control_recv.recv() == CONTROL_FINISHED:
                 finished['flag'] = True
         return finished['flag']
 
@@ -1028,7 +1070,7 @@ def _worker_bootstrap(worker_id, main_pid, setup_blob, vent_addr, result_addr, c
         ring = ShmRing.attach(ring_name)
 
         def send(kind, seq, payload=b''):
-            ring.write2(_ring_header(kind, seq), payload, stop_check=check_finished)
+            ring.write2(ring_header(kind, seq), payload, stop_check=check_finished)
     else:
         result_send = context.socket(zmq.PUSH)
         result_send.setsockopt(zmq.SNDHWM, results_hwm)
@@ -1054,15 +1096,15 @@ def _worker_bootstrap(worker_id, main_pid, setup_blob, vent_addr, result_addr, c
                                protocol=pickle.HIGHEST_PROTOCOL)
         try:
             if ring is not None:
-                header = _ring_header(_HEARTBEAT, None)
+                header = ring_header(MSG_HEARTBEAT, None)
                 if blocking:
                     ring.write2(header, payload, stop_check=check_finished)
                 else:
                     ring.try_write2(header, payload)
             elif blocking:
-                result_send.send_multipart([_HEARTBEAT, b'', payload])
+                result_send.send_multipart([MSG_HEARTBEAT, b'', payload])
             else:
-                result_send.send_multipart([_HEARTBEAT, b'', payload], flags=zmq.NOBLOCK)
+                result_send.send_multipart([MSG_HEARTBEAT, b'', payload], flags=zmq.NOBLOCK)
         except zmq.Again:
             return
         last_hb['t'] = time.monotonic()
@@ -1139,7 +1181,7 @@ def _worker_bootstrap(worker_id, main_pid, setup_blob, vent_addr, result_addr, c
                 pass
             raise
         blob_fail['consecutive'] = 0
-        send(_BLOB, current['seq'], path.encode())
+        send(MSG_BLOB, current['seq'], path.encode())
         return True
 
     def publish(data):
@@ -1158,14 +1200,14 @@ def _worker_bootstrap(worker_id, main_pid, setup_blob, vent_addr, result_addr, c
             total = serializer.parts_size(parts)
             fits_ring = ring is not None and total + 17 <= ring.capacity  # 9B+8B framing
             if fits_ring and (not blob_live or total < blob_threshold):
-                ring.writev([_ring_header(_DATA, current['seq'])] + parts,
+                ring.writev([ring_header(MSG_DATA, current['seq'])] + parts,
                             stop_check=check_finished)
                 return
             if blob_live and total >= blob_threshold and _try_blob_write(parts, total):
                 return
-            send(_DATA, current['seq'], serializer.join_parts(parts))
+            send(MSG_DATA, current['seq'], serializer.join_parts(parts))
             return
-        send(_DATA, current['seq'], serializer.serialize(data))
+        send(MSG_DATA, current['seq'], serializer.serialize(data))
 
     def flush_telemetry():
         """Ship this process's cumulative metrics snapshot (and drained trace
@@ -1179,13 +1221,13 @@ def _worker_bootstrap(worker_id, main_pid, setup_blob, vent_addr, result_addr, c
             rec = {'pid': os.getpid(), 'metrics': obs.snapshot()}
             if obs.spans_on():
                 rec['events'] = obs.drain_trace_events()
-            send(_METRICS, None, pickle.dumps(rec, protocol=pickle.HIGHEST_PROTOCOL))
+            send(MSG_METRICS, None, pickle.dumps(rec, protocol=pickle.HIGHEST_PROTOCOL))
         except Exception as e:  # noqa: BLE001 - telemetry is best-effort: a shutdown
-            # race here must not resend _DONE/_ERROR and corrupt item accounting
+            # race here must not resend MSG_DONE/MSG_ERROR and corrupt item accounting
             logger.debug('telemetry flush failed: %s', e)
 
     worker = worker_class(worker_id, publish, worker_setup_args)
-    send(_STARTED, None)
+    send(MSG_STARTED, None)
     send_heartbeat(None)
 
     poller = zmq.Poller()
@@ -1196,7 +1238,7 @@ def _worker_bootstrap(worker_id, main_pid, setup_blob, vent_addr, result_addr, c
         while True:
             events = dict(poller.poll(100))
             if control_recv in events or finished['flag']:
-                if finished['flag'] or control_recv.recv() == _CONTROL_FINISHED:
+                if finished['flag'] or control_recv.recv() == CONTROL_FINISHED:
                     break
             if vent_recv in events:
                 dispatch, args, kwargs = vent_recv.recv_pyobj()
@@ -1207,7 +1249,7 @@ def _worker_bootstrap(worker_id, main_pid, setup_blob, vent_addr, result_addr, c
                 try:
                     faults.on_item(kwargs)
                     worker.process(*args, **kwargs)
-                    send(_DONE, current['seq'])
+                    send(MSG_DONE, current['seq'])
                     flush_telemetry()
                 except Exception:  # noqa: BLE001 - forwarded to the main process
                     exc = sys.exc_info()[1]
@@ -1220,10 +1262,10 @@ def _worker_bootstrap(worker_id, main_pid, setup_blob, vent_addr, result_addr, c
                         blob = pickle.dumps(dict(report, exc=RuntimeError(
                             '{}: {}'.format(type(exc).__name__, exc))))
                     # completion accounting for a failed item happens on the
-                    # supervisor side (requeue/quarantine/raise) — no _DONE here
-                    send(_ERROR, current['seq'], blob)
+                    # supervisor side (requeue/quarantine/raise) — no MSG_DONE here
+                    send(MSG_ERROR, current['seq'], blob)
                     flush_telemetry()
-                # no trailing idle beacon: the _DONE/_ERROR message itself
+                # no trailing idle beacon: the MSG_DONE/MSG_ERROR message itself
                 # clears the claim on the supervisor side (ordered transport),
                 # keeping supervision at ONE extra message per item
                 current['seq'] = None

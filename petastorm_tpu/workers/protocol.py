@@ -1,0 +1,121 @@
+"""The canonical worker-pool wire protocol: message kinds, framing, dispatch ids.
+
+This module is the SINGLE definition site for every constant of the
+supervision protocol (docs/protocol.md). The pools (``process_pool.py``,
+``thread_pool.py``, ``dummy_pool.py``), the test stubs
+(``test_util/stub_workers.py``), the executable spec
+(``petastorm_tpu/analysis/protocol/spec.py``) and the runtime conformance
+monitor all import from here — lint rule PT801 flags any other definition
+site, and PT800 flags consumer dispatch chains that miss a declared kind.
+
+Protocol summary (full semantics in ``docs/protocol.md``):
+
+* Workers send messages over a per-worker FIFO results channel (shm ring or
+  zmq PUSH). The first byte of every message is its *kind*.
+* Every ventilated item carries a pool-assigned *dispatch id* — monotonically
+  increasing, NEVER reused. A requeued item gets a fresh id; any message
+  tagged with a superseded id is stale and must be dropped.
+* A worker claims the item it is processing (``MSG_HEARTBEAT`` with
+  ``busy=<dispatch id>``) BEFORE processing; the item's ``MSG_DONE`` /
+  ``MSG_ERROR`` implicitly releases the claim (the channel is FIFO, so the
+  claim always precedes its item's completion).
+"""
+
+from __future__ import annotations
+
+import struct
+
+#: control-channel (PUB/SUB) shutdown broadcast — not a results-channel kind
+CONTROL_FINISHED = b'FINISHED'
+
+# -- results-channel message kinds (the first byte of every message) --------
+
+MSG_STARTED = b'S'    #: startup handshake: worker connected and reported in
+MSG_DATA = b'D'       #: an item's serialized payload, in-band
+MSG_DONE = b'F'       #: item completion sentinel (releases the claim)
+MSG_ERROR = b'E'      #: pickled worker-side exception report (releases the claim)
+MSG_BLOB = b'B'       #: an item's payload parked in a /dev/shm blob; payload = path
+MSG_METRICS = b'M'    #: cumulative telemetry snapshot piggyback
+MSG_HEARTBEAT = b'H'  #: liveness + item-ownership beacon (claim when busy is set)
+
+#: kind byte -> canonical lowercase name, in protocol order. THE exhaustive
+#: declaration: PT800 checks consumer dispatch chains against this set, and
+#: the spec/monitor use the names as their event vocabulary.
+MESSAGE_KINDS = {
+    MSG_STARTED: 'started',
+    MSG_DATA: 'data',
+    MSG_DONE: 'done',
+    MSG_ERROR: 'error',
+    MSG_BLOB: 'blob',
+    MSG_METRICS: 'metrics',
+    MSG_HEARTBEAT: 'heartbeat',
+}
+
+#: every declared kind byte
+ALL_KINDS = tuple(MESSAGE_KINDS)
+
+#: canonical constant name -> kind byte (what PT800/PT801 recognize in source)
+KIND_CONSTANT_NAMES = {
+    'MSG_STARTED': MSG_STARTED,
+    'MSG_DATA': MSG_DATA,
+    'MSG_DONE': MSG_DONE,
+    'MSG_ERROR': MSG_ERROR,
+    'MSG_BLOB': MSG_BLOB,
+    'MSG_METRICS': MSG_METRICS,
+    'MSG_HEARTBEAT': MSG_HEARTBEAT,
+}
+
+# -- shm-ring framing -------------------------------------------------------
+
+#: ring message header: kind byte + little-endian int64 dispatch id (-1 = None)
+RING_HEADER_LEN = 9
+
+
+def ring_header(kind, dispatch):
+    """Ring message framing: kind byte + little-endian int64 dispatch id
+    (-1 = None), then the payload; header and payload are gather-written as
+    one message."""
+    return kind + struct.pack('<q', -1 if dispatch is None else dispatch)
+
+
+def ring_unpack(view):
+    """(kind, dispatch, payload_view) from a message memoryview — the payload
+    stays a zero-copy view handed straight to the deserializer."""
+    dispatch = struct.unpack_from('<q', view, 1)[0]
+    return bytes(view[0:1]), (None if dispatch < 0 else dispatch), view[RING_HEADER_LEN:]
+
+
+# -- dispatch ids -----------------------------------------------------------
+
+class DispatchIds(object):
+    """Monotonic dispatch-id allocator. Ids are NEVER reused: a requeued item
+    gets a fresh id so straggler messages from its previous attempt are
+    recognizable as stale — the exactly-once invariant rests on this
+    (``petastorm_tpu/analysis/protocol/spec.py`` proves it for small scopes).
+
+    Not thread-safe by itself; callers allocate under their own state lock
+    (the pools already hold one for the in-flight table the id keys into).
+    """
+
+    __slots__ = ('_next',)
+
+    def __init__(self, start=0):
+        self._next = start
+
+    def next(self):
+        d = self._next
+        self._next += 1
+        return d
+
+    @property
+    def issued(self):
+        """How many ids have been allocated so far."""
+        return self._next
+
+
+__all__ = [
+    'ALL_KINDS', 'CONTROL_FINISHED', 'DispatchIds', 'KIND_CONSTANT_NAMES',
+    'MESSAGE_KINDS', 'MSG_BLOB', 'MSG_DATA', 'MSG_DONE', 'MSG_ERROR',
+    'MSG_HEARTBEAT', 'MSG_METRICS', 'MSG_STARTED', 'RING_HEADER_LEN',
+    'ring_header', 'ring_unpack',
+]

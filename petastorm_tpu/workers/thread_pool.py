@@ -20,6 +20,7 @@ modes.
 from __future__ import annotations
 
 import logging
+import os
 import pstats
 import queue
 import sys
@@ -27,18 +28,23 @@ import threading
 
 from petastorm_tpu import faults, observability as obs
 from petastorm_tpu.errors import EmptyResultError, WorkerTerminationRequested
+# in-process pools speak the same canonical message-kind vocabulary as the
+# wire protocol (workers/protocol.py): results-queue records are
+# (kind, seq, payload, dispatch_id) tuples, dispatch ids are allocated by the
+# shared monotonic allocator, and PT801 rejects local kind definitions
+from petastorm_tpu.workers.protocol import MSG_DATA, MSG_DONE, MSG_ERROR, DispatchIds
 from petastorm_tpu.workers.supervision import (ErrorPolicy, attach_remote_context,
                                                format_exception_tb, quarantine_record)
 
 logger = logging.getLogger(__name__)
 
-_DATA, _DONE, _ERROR = 0, 1, 2
 DEFAULT_RESULTS_QUEUE_SIZE = 50
 
 
 class ThreadPool(object):
     def __init__(self, workers_count, results_queue_size=DEFAULT_RESULTS_QUEUE_SIZE,
-                 profiling_enabled=False, on_error='raise', max_item_retries=None):
+                 profiling_enabled=False, on_error='raise', max_item_retries=None,
+                 protocol_monitor=None):
         self._workers_count = workers_count
         self._results_queue = queue.Queue(maxsize=results_queue_size)
         self._profiling_enabled = profiling_enabled
@@ -55,7 +61,15 @@ class ThreadPool(object):
                         else ErrorPolicy(on_error, **({} if max_item_retries is None
                                                       else {'max_item_retries': max_item_retries})))
         self._counter_lock = threading.Lock()
+        self._dispatch_ids = DispatchIds()
         self._tls = threading.local()  # per-worker-thread current item seq
+        # opt-in protocol conformance monitor (docs/protocol.md; lazy import so
+        # the default path never loads the analysis stack)
+        self.protocol_monitor = None
+        if protocol_monitor or (protocol_monitor is None and
+                                os.environ.get('PSTPU_PROTOCOL_MONITOR', '') not in ('', '0')):
+            from petastorm_tpu.analysis.protocol.monitor import monitor_from_env
+            self.protocol_monitor = monitor_from_env(protocol_monitor, 'thread-pool')
         # checkpoint plumbing: seq of the payload last returned by get_results,
         # and an optional callback fired when an item's completion sentinel is
         # consumed (used by results-queue readers to mark empty items delivered)
@@ -82,7 +96,12 @@ class ThreadPool(object):
         seq = kwargs.pop('_seq', None)
         with self._counter_lock:
             self._ventilated_items += 1
-        self._task_queue.put((seq, args, kwargs, 0))
+            d = self._dispatch_ids.next()
+            if self.protocol_monitor is not None:
+                # under the lock: allocation + dispatch event must be atomic
+                # or concurrent ventilates report ids out of order
+                self.protocol_monitor.on_dispatch(d, seq)
+        self._task_queue.put((d, seq, args, kwargs, 0))
 
     def get_results(self):
         """Block until a result is available; raise :class:`EmptyResultError` when
@@ -95,25 +114,42 @@ class ThreadPool(object):
     def _get_results(self):
         while True:
             try:
-                kind, seq, payload = self._results_queue.get(block=False)
+                kind, seq, payload, d = self._results_queue.get(block=False)
             except queue.Empty:
                 if self._all_done():
+                    if self.protocol_monitor is not None and not self._stop_event.is_set():
+                        with self._counter_lock:
+                            ventilated, completed = (self._ventilated_items,
+                                                     self._completed_items)
+                        self.protocol_monitor.on_drained(ventilated, completed)
                     raise EmptyResultError()
                 try:
-                    kind, seq, payload = self._results_queue.get(timeout=0.05)
+                    kind, seq, payload, d = self._results_queue.get(timeout=0.05)
                 except queue.Empty:
                     continue
-            if kind == _DATA:
+            if kind == MSG_DATA:
+                if self.protocol_monitor is not None:
+                    self.protocol_monitor.on_message('data', d, live=True)
                 self.last_result_seq = seq
                 return payload
-            elif kind == _DONE:
-                self._count_completed(seq)
-            else:  # _ERROR
+            elif kind == MSG_DONE:
+                if self.protocol_monitor is not None:
+                    self.protocol_monitor.on_message('done', d, live=True)
+                self._count_completed(seq, d)
+            elif kind == MSG_ERROR:
+                if self.protocol_monitor is not None and d is not None:
+                    self.protocol_monitor.on_message('error', d, live=True)
                 raise payload
+            else:
+                # PT800-exhaustive: protocol.py declares no other in-process
+                # kind; reaching this is a framing bug, never a silent drop
+                raise RuntimeError('unknown results-queue kind {!r}'.format(kind))
 
-    def _count_completed(self, seq=None):
+    def _count_completed(self, seq=None, dispatch=None):
         with self._counter_lock:
             self._completed_items += 1
+            if self.protocol_monitor is not None and dispatch is not None:
+                self.protocol_monitor.on_complete(dispatch, delivered=seq is not None)
         if self._ventilator is not None:
             self._ventilator.processed_item()
         if seq is not None and self.done_callback is not None:
@@ -186,7 +222,9 @@ class ThreadPool(object):
     # -- worker side --------------------------------------------------------
 
     def _publish(self, data):
-        self._stop_aware_put((_DATA, getattr(self._tls, 'seq', None), data))
+        self._tls.published = True
+        self._stop_aware_put((MSG_DATA, getattr(self._tls, 'seq', None), data,
+                              getattr(self._tls, 'dispatch', None)))
 
     def _stop_aware_put(self, item):
         """Bounded put that aborts when the pool is stopping, so workers never
@@ -199,19 +237,33 @@ class ThreadPool(object):
                 continue
         raise WorkerTerminationRequested()
 
-    def _handle_item_failure(self, worker, seq, args, kwargs, attempts):
+    def _handle_item_failure(self, worker, d, seq, args, kwargs, attempts):
         """Apply the on_error policy to one failed item, on the worker thread.
         ``attempts`` counts this failure. May raise WorkerTerminationRequested
         (propagated by the loop)."""
         exc = sys.exc_info()[1]
+        if getattr(self._tls, 'published', False) and self._policy.on_error != 'raise':
+            # the item already published into the results queue — requeueing
+            # would run it (and its publishes) again, delivering rows twice;
+            # it completes delivered instead, like a crash after publish on
+            # the process pool (the protocol model checker's
+            # requeue_published counterexample)
+            logger.warning('Worker %d failed on item seq=%s AFTER publishing; '
+                           'completing the item rather than re-running it: %s',
+                           worker.worker_id, seq, exc)
+            self._stop_aware_put((MSG_DONE, seq, None, d))
+            return
         if self._policy.should_retry_error(attempts):
             logger.warning('Worker %d failed on item seq=%s (attempt %d/%d); requeueing: %s',
                            worker.worker_id, seq, attempts,
                            self._policy.max_item_retries + 1, exc)
             with self._counter_lock:
                 self._items_requeued += 1
+                nd = self._dispatch_ids.next()
+                if self.protocol_monitor is not None:
+                    self.protocol_monitor.on_requeue(d, nd)
             obs.count('items_requeued')
-            self._task_queue.put((seq, args, kwargs, attempts))
+            self._task_queue.put((nd, seq, args, kwargs, attempts))
             return
         if self._policy.quarantines():
             record = quarantine_record(seq, attempts, 'error', error=exc,
@@ -225,15 +277,15 @@ class ThreadPool(object):
                          seq, attempts, record['error'])
             # completion sentinel WITHOUT a seq: the item counts complete for
             # epoch/flow-control accounting but is never marked delivered
-            self._stop_aware_put((_DONE, None, None))
+            self._stop_aware_put((MSG_DONE, None, None, d))
             return
         logger.exception('Worker %d failed processing an item', worker.worker_id)
         attach_remote_context(exc, format_exception_tb(exc),
                               worker_id=worker.worker_id, seq=seq)
-        self._stop_aware_put((_ERROR, None, exc))
+        self._stop_aware_put((MSG_ERROR, None, exc, d))
         # seq-less sentinel: flow control counts the item but it is
         # NOT marked delivered — a checkpoint will re-read it
-        self._stop_aware_put((_DONE, None, None))
+        self._stop_aware_put((MSG_DONE, None, None, d))
 
     def _worker_loop(self, worker):
         profiler = None
@@ -243,10 +295,12 @@ class ThreadPool(object):
         try:
             while not self._stop_event.is_set():
                 try:
-                    seq, args, kwargs, attempts = self._task_queue.get(timeout=0.05)
+                    d, seq, args, kwargs, attempts = self._task_queue.get(timeout=0.05)
                 except queue.Empty:
                     continue
                 self._tls.seq = seq
+                self._tls.dispatch = d
+                self._tls.published = False
                 try:
                     if profiler is not None:
                         profiler.enable()
@@ -256,12 +310,12 @@ class ThreadPool(object):
                     finally:
                         if profiler is not None:
                             profiler.disable()
-                    self._stop_aware_put((_DONE, seq, None))
+                    self._stop_aware_put((MSG_DONE, seq, None, d))
                 except WorkerTerminationRequested:
                     return
                 except Exception:  # noqa: BLE001 - routed through the error policy
                     try:
-                        self._handle_item_failure(worker, seq, args, kwargs, attempts + 1)
+                        self._handle_item_failure(worker, d, seq, args, kwargs, attempts + 1)
                     except WorkerTerminationRequested:
                         return
         finally:

@@ -20,6 +20,13 @@ The chaos matrix the supervision layer is accepted against:
 All faults come from ``petastorm_tpu.faults`` — deterministic, seeded into
 the REAL code paths, coordinated across spawned workers via one-shot state
 files.
+
+Every test in this module runs with the worker-pool protocol conformance
+monitor attached (``docs/protocol.md``; the autouse fixture below): each
+crash/requeue/poison scenario therefore proves not just end-state row counts
+but that every observed event sequence walked the supervision protocol spec —
+any stale-drop, requeue, or accounting divergence raises
+:class:`~petastorm_tpu.errors.ProtocolViolation` on the spot.
 """
 
 import collections
@@ -36,6 +43,13 @@ from petastorm_tpu.workers import DummyPool, ErrorPolicy, ProcessPool, ThreadPoo
 from petastorm_tpu.workers.supervision import attach_remote_context
 
 ALL_POOL_TYPES = ['thread', 'dummy']  # in-process matrix; 'process' has dedicated tests
+
+
+@pytest.fixture(autouse=True)
+def _protocol_monitor_on(monkeypatch):
+    """Arm the protocol conformance monitor for every pool this module
+    constructs — the whole chaos matrix doubles as a conformance proof."""
+    monkeypatch.setenv('PSTPU_PROTOCOL_MONITOR', '1')
 
 
 @pytest.fixture
@@ -222,6 +236,80 @@ def test_retry_budget_exhaustion_raises(synthetic_dataset, fault_state):
                      on_error='retry', max_item_retries=1) as reader:
         with pytest.raises(faults.FaultInjectedError):
             _drain_ids(reader)
+
+
+# ---------------------------------------------------------------------------
+# the requeue_published divergence (found by the protocol model checker):
+# an item that publishes and THEN errors must not be re-run — the payload
+# already reached the consumer, so a requeue delivers the rows twice. These
+# tests replay the minimized counterexample (dispatch -> claim -> publish ->
+# error -> requeue -> re-publish) against the REAL pools via
+# PublishThenErrorWorker; before the fix every pool double-delivered.
+# ---------------------------------------------------------------------------
+
+def _drain_pool(pool, timeout_s=None):
+    got = []
+    while True:
+        try:
+            got.append(pool.get_results(**({'timeout_s': timeout_s}
+                                           if timeout_s is not None else {})))
+        except EmptyResultError:
+            return got
+
+
+@pytest.mark.parametrize('on_error', ['retry', 'skip'])
+def test_publish_then_error_delivers_exactly_once_process_pool(tmp_path, on_error):
+    from petastorm_tpu.test_util.stub_workers import PublishThenErrorWorker
+    pool = ProcessPool(2, on_error=on_error, max_item_retries=2)
+    pool.start(PublishThenErrorWorker,
+               {'fail_on': (2,), 'state_dir': str(tmp_path)})
+    try:
+        for i in range(6):
+            pool.ventilate(i)
+        got = _drain_pool(pool, timeout_s=60)
+    finally:
+        pool.stop()
+        pool.join()
+    counts = collections.Counter(got)
+    assert sorted(counts) == list(range(6))
+    assert all(v == 1 for v in counts.values()), \
+        'post-publish error must not re-run the item: {}'.format(counts)
+    diag = pool.diagnostics
+    assert diag['items_requeued'] == 0 and diag['items_quarantined'] == 0
+    assert diag['items_ventilated'] == diag['items_completed'] == 6
+
+
+@pytest.mark.parametrize('pool_factory', [
+    lambda: ThreadPool(2, on_error='retry', max_item_retries=2),
+    lambda: DummyPool(on_error='retry', max_item_retries=2),
+], ids=['thread', 'dummy'])
+def test_publish_then_error_delivers_exactly_once_in_process(tmp_path, pool_factory):
+    from petastorm_tpu.test_util.stub_workers import PublishThenErrorWorker
+    pool = pool_factory()
+    pool.start(PublishThenErrorWorker,
+               {'fail_on': (1, 3), 'state_dir': str(tmp_path)})
+    for i in range(5):
+        pool.ventilate(i)
+    got = _drain_pool(pool)
+    pool.stop(); pool.join()
+    counts = collections.Counter(got)
+    assert sorted(counts) == list(range(5))
+    assert all(v == 1 for v in counts.values()), \
+        'post-publish error must not re-run the item: {}'.format(counts)
+    assert pool.diagnostics['items_requeued'] == 0
+
+
+def test_publish_then_error_raise_policy_still_raises(tmp_path):
+    """Under on_error='raise' the historical contract holds: the first
+    failure surfaces, delivered payload or not."""
+    from petastorm_tpu.test_util.stub_workers import PublishThenErrorWorker
+    pool = ThreadPool(1, on_error='raise')
+    pool.start(PublishThenErrorWorker,
+               {'fail_on': (0,), 'state_dir': str(tmp_path)})
+    pool.ventilate(0)
+    with pytest.raises(ValueError, match='post-publish failure'):
+        _drain_pool(pool)
+    pool.stop(); pool.join()
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,451 @@
+"""Worker-pool protocol verifier (petastorm_tpu/analysis/protocol/).
+
+Four layers (docs/protocol.md):
+
+* **Spec unit tests** — transition-system sanity, canonical state hashing
+  (slot symmetry, item renaming, dispatch-id renumbering), replay helpers.
+* **Model checker** — small scopes exhaust clean; every seeded spec mutation
+  yields a minimized counterexample; trace minimization actually shrinks;
+  the ``petastorm-tpu-modelcheck`` CLI honors its exit-code contract.
+* **THE tier-1 gate** — the default small-scope configuration (3 workers,
+  4 items, 2 crashes) exhausts within an explicit wall-clock budget with a
+  state-count floor, proving all five invariants; a budget overrun or a
+  degenerated search fails loudly.
+* **Runtime monitor** — accepts every legal schedule (seeded random walks
+  replayed through the spec's observer projection; hypothesis-driven when
+  hypothesis is installed), rejects each mutation counterexample and a
+  catalog of crafted violations, and conforms on real pools (the
+  fault-tolerance suite runs every crash/requeue/poison scenario with the
+  monitor attached — see tests/test_fault_tolerance.py).
+"""
+
+import subprocess
+import sys
+import time
+
+import pytest
+
+from petastorm_tpu.analysis.protocol import modelcheck as M
+from petastorm_tpu.analysis.protocol import spec as S
+from petastorm_tpu.analysis.protocol.monitor import ProtocolMonitor
+from petastorm_tpu.errors import EmptyResultError, ProtocolViolation
+
+TINY = dict(workers=2, items=2, crashes=1)
+
+
+def _check(mutation=None, **kw):
+    cfg = S.SpecConfig(mutation=mutation, **dict(TINY, **kw))
+    return M.check(cfg, budget_s=120)
+
+
+# ---------------------------------------------------------------------------
+# spec: states, transitions, canonicalization
+# ---------------------------------------------------------------------------
+
+def test_initial_state_shape():
+    cfg = S.SpecConfig(**TINY)
+    st = S.initial_state(cfg)
+    assert st[S.NEXT_ITEM] == 0 and st[S.NEXT_D] == 0
+    assert len(st[S.SLOTS]) == cfg.workers
+    assert all(s[S.S_ALIVE] for s in st[S.SLOTS])
+    assert S.check_state(st, cfg) is None
+
+
+def test_successors_from_init_are_dispatches():
+    cfg = S.SpecConfig(**TINY)
+    succ = S.successors(S.initial_state(cfg), cfg)
+    kinds = {label[0] for label, _ in succ}
+    # only dispatch and (budget permitting) idle crashes are enabled at start
+    assert kinds <= {'dispatch', 'crash'}
+    assert 'dispatch' in kinds
+
+
+def test_canonicalize_slot_symmetry():
+    cfg = S.SpecConfig(**TINY)
+    st = S.initial_state(cfg)
+    slot_busy = (1, S.WORK, 0, (), (), -1)
+    slot_idle = st[S.SLOTS][0]
+    a = st[:S.SLOTS] + ((slot_busy, slot_idle),) + st[S.SLOTS + 1:]
+    b = st[:S.SLOTS] + ((slot_idle, slot_busy),) + st[S.SLOTS + 1:]
+    assert S.canonicalize(a, cfg) == S.canonicalize(b, cfg)
+
+
+def test_canonicalize_item_symmetry():
+    """Two dispatched items with identical accounting signatures collapse
+    regardless of which index completed first."""
+    cfg = S.SpecConfig(**TINY)
+    st = S.initial_state(cfg)
+    st = st[:S.NEXT_ITEM] + (2,) + st[S.NEXT_ITEM + 1:]
+    a = S._set(S._set(st, S.COMPLETED, (1, 0)), S.DELIVERED, (1, 0))
+    a = S._set(a, S.COMPLETED_ITEMS, 1)
+    b = S._set(S._set(st, S.COMPLETED, (0, 1)), S.DELIVERED, (0, 1))
+    b = S._set(b, S.COMPLETED_ITEMS, 1)
+    assert S.canonicalize(a, cfg) == S.canonicalize(b, cfg)
+
+
+def test_canonicalize_renumbers_dispatch_ids():
+    """States whose requeue histories burned different id counts are the same
+    canonical state (bisimulation quotient) — but NOT for mutated specs,
+    where trace/monitor id stability wins."""
+    cfg = S.SpecConfig(**TINY)
+    st = S.initial_state(cfg)
+    st = S._set(S._set(st, S.NEXT_ITEM, 1), S.NEXT_D, 9)
+    lo = S._set(st, S.INFLIGHT, ((2, 0, 0, 0),))
+    hi = S._set(st, S.INFLIGHT, ((7, 0, 0, 0),))
+    assert S.canonicalize(lo, cfg) == S.canonicalize(hi, cfg)
+    mcfg = S.SpecConfig(mutation='requeue_same_id', **TINY)
+    assert S.canonicalize(lo, mcfg) != S.canonicalize(hi, mcfg)
+
+
+def test_replay_trace_validates_labels():
+    cfg = S.SpecConfig(**TINY)
+    trace, _final = M.random_walk(cfg, seed=7, max_steps=40)
+    assert trace
+    # canonical replay accepts the canonical re-recording of a legal schedule
+    state = S.canonicalize(S.initial_state(cfg), cfg)
+    canonical_trace = []
+    for _ in range(10):
+        succ = S.successors(state, cfg)
+        if not succ:
+            break
+        label, state = succ[0]
+        canonical_trace.append(label)
+    S.replay_trace(cfg, canonical_trace)
+    with pytest.raises(ProtocolViolation, match='not enabled'):
+        S.replay_trace(cfg, [('pickup', 0, 99)])
+
+
+# ---------------------------------------------------------------------------
+# model checker: clean scopes, mutations, minimization, CLI
+# ---------------------------------------------------------------------------
+
+def test_tiny_scope_exhausts_clean():
+    result = _check()
+    assert result.exhausted and result.violation is None
+    assert result.states > 1_000  # the space is real, not degenerate
+    assert result.terminal_states >= 1
+
+
+def test_error_scope_exhausts_clean():
+    """Worker-raised errors (retry -> quarantine lattice) on top of crashes."""
+    result = M.check(S.SpecConfig(**M.ERROR_SCOPE), budget_s=120)
+    assert result.exhausted and result.violation is None
+
+
+@pytest.mark.parametrize('policy', ['raise', 'retry'])
+def test_other_policies_exhaust_clean(policy):
+    result = _check(policy=policy, errors=1)
+    assert result.exhausted and result.violation is None
+
+
+@pytest.mark.parametrize('mutation,invariant', [
+    ('requeue_same_id', 'exactly_once_delivery'),
+    ('requeue_published', 'exactly_once_delivery'),
+    ('no_stale_drop', 'no_double_count'),
+    ('no_drain_before_respawn', 'epoch_termination'),
+])
+def test_mutations_yield_minimized_counterexamples(mutation, invariant):
+    """Each seeded protocol defect is caught, with a minimized trace that
+    replays through the spec to the violating state — the checker has teeth
+    (the ISSUE acceptance example is requeue_same_id: requeue without a fresh
+    dispatch id)."""
+    result = _check(mutation=mutation, errors=1)
+    assert result.violation == invariant
+    assert result.trace, 'a counterexample must carry its trace'
+    cfg = S.SpecConfig(mutation=mutation, errors=1, **TINY)
+    assert M._trace_violates(cfg, result.trace, invariant)
+    # minimal: removing ANY single step breaks the reproduction
+    for i in range(len(result.trace)):
+        assert not M._trace_violates(cfg, result.trace[:i] + result.trace[i + 1:],
+                                     invariant)
+
+
+def test_minimize_trace_strips_padding():
+    """A counterexample artificially padded with an unrelated item's full
+    lifecycle shrinks back to (at most) its original length."""
+    cfg = S.SpecConfig(mutation='requeue_published', errors=1, **TINY)
+    result = M.check(cfg, budget_s=120)
+    minimal = result.trace
+    padded = list(minimal)
+    # grow a longer valid trace by taking extra enabled steps first, then
+    # checking the original still replays; find a prefix extension that works
+    state = S.canonicalize(S.initial_state(cfg), cfg)
+    extra = []
+    for label, ns in S.successors(state, cfg):
+        if label[0] == 'dispatch' and label != minimal[0]:
+            extra = [label]
+            break
+    if extra and M._trace_violates(cfg, extra + padded, result.violation):
+        out = M.minimize_trace(cfg, extra + padded, result.violation)
+        assert len(out) <= len(minimal)
+
+
+def test_format_trace_is_readable():
+    result = _check(mutation='requeue_same_id', errors=1)
+    text = M.format_trace(result)
+    assert 'counterexample' in text and 'exactly_once_delivery' in text
+    assert 'dispatch item=' in text
+
+
+def test_cli_exit_code_contract(tmp_path):
+    base = [sys.executable, '-m', 'petastorm_tpu.analysis.protocol.modelcheck']
+    clean = subprocess.run(base + ['--workers', '2', '--items', '2', '--crashes', '1',
+                                   '--budget-s', '120'],
+                           capture_output=True, text=True, timeout=300)
+    assert clean.returncode == 0, clean.stdout + clean.stderr
+    assert 'exhausted: all invariants hold' in clean.stdout
+
+    bad = subprocess.run(base + ['--workers', '2', '--items', '2', '--crashes', '1',
+                                 '--errors', '1', '--mutate', 'requeue_same_id'],
+                         capture_output=True, text=True, timeout=300)
+    assert bad.returncode == 1
+    assert 'counterexample' in bad.stdout
+
+    usage = subprocess.run(base + ['--workers', '1', '--items', '1', '--crashes', '3'],
+                           capture_output=True, text=True, timeout=120)
+    assert usage.returncode == 2
+
+    floor = subprocess.run(base + ['--workers', '2', '--items', '1', '--crashes', '1',
+                                   '--min-states', '99999999'],
+                           capture_output=True, text=True, timeout=300)
+    assert floor.returncode == 3
+    assert 'below the declared floor' in floor.stderr
+
+
+def test_console_script_target_resolves():
+    import importlib
+    func = getattr(importlib.import_module(
+        'petastorm_tpu.analysis.protocol.modelcheck'), 'main')
+    assert callable(func)
+
+
+# ---------------------------------------------------------------------------
+# THE tier-1 gate: default small scope, budgeted, with a state-count floor
+# ---------------------------------------------------------------------------
+
+#: wall budget for the default scope — ~2.5x the uncontended runtime so a
+#: loaded CI host cannot flake it, while a genuine blowup still fails
+TIER1_BUDGET_S = 300
+
+
+def test_default_scope_exhausts_within_budget_and_floor():
+    """The acceptance gate: the default configuration (>=3 workers, >=4 items,
+    >=2 injected crashes) is EXHAUSTED — every reachable interleaving visited
+    — within the declared budget, the reported state count clears the
+    declared floor (so the search cannot silently degenerate), and all five
+    invariants hold."""
+    cfg = S.SpecConfig(**M.DEFAULT_SCOPE)
+    assert cfg.workers >= 3 and cfg.items >= 4 and cfg.crashes >= 2
+    t0 = time.monotonic()
+    result = M.check(cfg, budget_s=TIER1_BUDGET_S)
+    elapsed = time.monotonic() - t0
+    assert result.exhausted, \
+        'default scope not exhausted in {:.0f}s ({} states)'.format(
+            elapsed, result.states)
+    assert result.violation is None, M.format_trace(result)
+    assert result.states >= M.DEFAULT_STATE_FLOOR, \
+        'state count {} under the floor {} — the exhaustive search ' \
+        'degenerated'.format(result.states, M.DEFAULT_STATE_FLOOR)
+    assert result.terminal_states >= 1
+    assert elapsed <= TIER1_BUDGET_S + 5
+
+
+# ---------------------------------------------------------------------------
+# runtime monitor: event rules
+# ---------------------------------------------------------------------------
+
+def test_monitor_accepts_the_happy_path():
+    m = ProtocolMonitor()
+    m.on_dispatch(0, seq=10)
+    m.on_message('claim', 0)
+    m.on_message('data', 0, live=True)
+    m.on_message('done', 0, live=True)
+    m.on_complete(0, delivered=True)
+    m.on_drained(1, 1)
+    assert m.snapshot['in_flight'] == []
+
+
+def test_monitor_accepts_requeue_and_stale_drop():
+    m = ProtocolMonitor()
+    m.on_dispatch(0)
+    m.on_message('claim', 0)
+    m.on_requeue(0, 1)                    # crash recovery path
+    m.on_message('done', 0, live=False)   # straggler from the dead attempt
+    m.on_message('data', 1, live=True)
+    m.on_complete(1, delivered=True)
+    m.on_drained(1, 1)
+
+
+def test_monitor_rejects_reused_dispatch_id():
+    m = ProtocolMonitor()
+    m.on_dispatch(0)
+    with pytest.raises(ProtocolViolation, match='reuses dispatch id'):
+        m.on_dispatch(0)
+    m2 = ProtocolMonitor()
+    m2.on_dispatch(0)
+    with pytest.raises(ProtocolViolation, match='reuses dispatch id'):
+        m2.on_requeue(0, 0)
+
+
+def test_monitor_rejects_unknown_id_and_misclassification():
+    m = ProtocolMonitor()
+    m.on_dispatch(0)
+    with pytest.raises(ProtocolViolation, match='never issued'):
+        m.on_message('done', 5, live=True)
+    m2 = ProtocolMonitor()
+    m2.on_dispatch(0)
+    m2.on_requeue(0, 1)
+    with pytest.raises(ProtocolViolation, match='retired dispatch id'):
+        m2.on_message('done', 0, live=True)   # stale treated as live
+    m3 = ProtocolMonitor()
+    m3.on_dispatch(0)
+    with pytest.raises(ProtocolViolation, match='dropped a .* live'):
+        m3.on_message('done', 0, live=False)  # live dropped as stale
+
+
+def test_monitor_rejects_double_completion():
+    m = ProtocolMonitor()
+    m.on_dispatch(0)
+    m.on_complete(0, delivered=True)
+    with pytest.raises(ProtocolViolation, match='not in flight'):
+        m.on_complete(0, delivered=True)
+    # ...even through a requeue chain: the LOGICAL item completed twice
+    m2 = ProtocolMonitor()
+    m2.on_dispatch(0)
+    m2.on_requeue(0, 1)
+    m2.on_dispatch(2)
+    m2.on_complete(1, delivered=True)
+    m2.on_requeue(2, 3)
+    m2.on_complete(3, delivered=True)
+    assert m2.completed == 2
+
+
+def test_monitor_rejects_requeue_after_delivery():
+    """The requeue_published defect at runtime: requeueing an item whose
+    payload already reached the consumer guarantees double delivery."""
+    m = ProtocolMonitor()
+    m.on_dispatch(0)
+    m.on_message('data', 0, live=True)
+    with pytest.raises(ProtocolViolation, match='delivered'):
+        m.on_requeue(0, 1)
+
+
+def test_monitor_rejects_diverged_drain():
+    m = ProtocolMonitor()
+    m.on_dispatch(0)
+    with pytest.raises(ProtocolViolation, match='still in flight'):
+        m.on_drained(1, 1)
+    m2 = ProtocolMonitor()
+    m2.on_dispatch(0)
+    m2.on_complete(0, delivered=True)
+    with pytest.raises(ProtocolViolation, match='diverge'):
+        m2.on_drained(5, 5)
+
+
+# ---------------------------------------------------------------------------
+# randomized schedules: spec traces replayed through the monitor
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize('seed', range(25))
+def test_random_schedules_conform(seed):
+    """Soundness: the monitor accepts every legal schedule. Seeded random
+    walks through the spec (crashes, errors, sweeps, stale straggler drops
+    included) replay through the monitor without a violation, and the walk's
+    final state satisfies every safety invariant."""
+    cfg = S.SpecConfig(workers=2, items=3, crashes=1, errors=1, retries=1,
+                       policy='skip')
+    trace, final = M.random_walk(cfg, seed=seed)
+    assert S.check_state(final, cfg) is None
+    S.replay_into_monitor(trace, ProtocolMonitor(name='walk-{}'.format(seed)))
+
+
+def test_random_schedules_conform_hypothesis():
+    """The same property under hypothesis when available (the container may
+    not ship it; the seeded sweep above always runs)."""
+    hypothesis = pytest.importorskip('hypothesis')
+    from hypothesis import strategies as st
+
+    @hypothesis.given(st.integers(min_value=0, max_value=10_000))
+    @hypothesis.settings(max_examples=50, deadline=None)
+    def prop(seed):
+        cfg = S.SpecConfig(workers=2, items=2, crashes=1, errors=1)
+        trace, final = M.random_walk(cfg, seed=seed, max_steps=300)
+        assert S.check_state(final, cfg) is None
+        S.replay_into_monitor(trace, ProtocolMonitor())
+
+    prop()
+
+
+@pytest.mark.parametrize('mutation', ['requeue_same_id', 'requeue_published',
+                                      'no_stale_drop'])
+def test_mutation_counterexamples_are_rejected_by_monitor(mutation):
+    """Teeth: the event sequence of each mutation's minimized counterexample
+    is rejected by the runtime monitor — what the model checker catches in
+    the spec, the monitor catches in a live pool."""
+    result = _check(mutation=mutation, errors=1)
+    assert result.trace
+    with pytest.raises(ProtocolViolation):
+        S.replay_into_monitor(result.trace, ProtocolMonitor(name=mutation))
+
+
+# ---------------------------------------------------------------------------
+# monitor on real pools (cheap in-process checks; the full crash matrix runs
+# monitor-enabled in tests/test_fault_tolerance.py)
+# ---------------------------------------------------------------------------
+
+def _drain(pool):
+    got = []
+    while True:
+        try:
+            got.append(pool.get_results())
+        except EmptyResultError:
+            return got
+
+
+def test_thread_pool_conforms_under_retry_policy():
+    from petastorm_tpu.test_util.stub_workers import ExceptionEveryNWorker
+    from petastorm_tpu.workers import ThreadPool
+    pool = ThreadPool(2, on_error='skip', max_item_retries=1, protocol_monitor=True)
+    pool.start(ExceptionEveryNWorker, worker_setup_args=3)
+    for i in [1, 2, 3, 4, 5]:
+        pool.ventilate(i)
+    got = _drain(pool)
+    pool.stop(); pool.join()
+    assert sorted(got) == [1, 2, 4, 5]
+    snap = pool.protocol_monitor.snapshot
+    assert snap['ventilated'] == snap['completed'] == 5
+    assert snap['in_flight'] == []
+
+
+def test_dummy_pool_conforms_and_env_var_opt_in(monkeypatch):
+    from petastorm_tpu.test_util.stub_workers import IdentityWorker
+    from petastorm_tpu.workers import DummyPool
+    monkeypatch.setenv('PSTPU_PROTOCOL_MONITOR', '1')
+    pool = DummyPool()
+    assert pool.protocol_monitor is not None, 'env var must arm the monitor'
+    pool.start(IdentityWorker)
+    for i in range(4):
+        pool.ventilate(i)
+    assert sorted(_drain(pool)) == list(range(4))
+    pool.stop(); pool.join()
+    monkeypatch.setenv('PSTPU_PROTOCOL_MONITOR', '0')
+    assert DummyPool().protocol_monitor is None
+
+
+def test_process_pool_protocol_echo_worker():
+    """A spawned worker resolves the SAME canonical protocol module as the
+    supervisor (the single-definition-site property PT801 enforces in
+    source)."""
+    from petastorm_tpu.test_util.stub_workers import ProtocolEchoWorker
+    from petastorm_tpu.workers import ProcessPool
+    from petastorm_tpu.workers import protocol
+    pool = ProcessPool(1, protocol_monitor=True)
+    pool.start(ProtocolEchoWorker)
+    try:
+        pool.ventilate(0)
+        item, kinds, header_len = pool.get_results(timeout_s=60)
+        assert kinds == sorted(protocol.MESSAGE_KINDS.values())
+        assert header_len == protocol.RING_HEADER_LEN
+    finally:
+        pool.stop()
+        pool.join()

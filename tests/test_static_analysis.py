@@ -28,6 +28,7 @@ from petastorm_tpu.analysis.hashability import HashabilityChecker
 from petastorm_tpu.analysis.jax_purity import JaxPurityChecker
 from petastorm_tpu.analysis.lifecycle import ResourceLifecycleChecker
 from petastorm_tpu.analysis.locks import LockDisciplineChecker
+from petastorm_tpu.analysis.protocol_lints import ProtocolLintChecker
 from petastorm_tpu.analysis.telemetry import TelemetrySpanChecker
 
 import petastorm_tpu
@@ -851,6 +852,130 @@ def test_pt700_runs_clean_over_the_observability_subsystem():
     its own rule (every span/timer it opens is context-managed)."""
     obs_dir = os.path.join(PKG_DIR, 'observability')
     findings = run_analysis([obs_dir], select=['PT700'])
+    assert findings == [], '\n'.join(f.format() for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# PT800/PT801 worker-pool protocol discipline
+# ---------------------------------------------------------------------------
+
+def test_pt800_flags_non_exhaustive_kind_dispatch():
+    """The crafted violation of the acceptance criteria: a consumer switch
+    missing a declared kind byte (here MSG_METRICS and friends) with no
+    default silently drops that message class."""
+    code = '''
+        from petastorm_tpu.workers.protocol import MSG_DATA, MSG_DONE
+
+        def consume(kind, payload):
+            if kind == MSG_DATA:
+                return payload
+            elif kind == MSG_DONE:
+                return None
+    '''
+    findings = _findings(ProtocolLintChecker(), code)
+    assert [f.code for f in findings] == ['PT800']
+    assert 'METRICS' in findings[0].message and 'ERROR' in findings[0].message
+
+
+def test_pt800_legacy_underscore_names_recognized():
+    code = '''
+        from petastorm_tpu.workers.protocol import MSG_DATA as _DATA, MSG_DONE as _DONE
+
+        def consume(msg):
+            if msg[0] == _DATA:
+                return msg
+            elif msg[0] == _DONE:
+                return None
+    '''
+    assert _codes(ProtocolLintChecker(), code) == ['PT800']
+
+
+def test_pt800_else_default_passes():
+    code = '''
+        from petastorm_tpu.workers.protocol import MSG_DATA, MSG_DONE
+
+        def consume(kind, payload):
+            if kind == MSG_DATA:
+                return payload
+            elif kind == MSG_DONE:
+                return None
+            else:
+                raise RuntimeError(kind)
+    '''
+    assert _codes(ProtocolLintChecker(), code) == []
+
+
+def test_pt800_full_coverage_passes():
+    code = '''
+        from petastorm_tpu.workers.protocol import (MSG_BLOB, MSG_DATA, MSG_DONE,
+            MSG_ERROR, MSG_HEARTBEAT, MSG_METRICS, MSG_STARTED)
+
+        def consume(kind):
+            if kind == MSG_DATA or kind == MSG_BLOB:
+                return 1
+            elif kind == MSG_DONE:
+                return 2
+            elif kind in (MSG_METRICS, MSG_HEARTBEAT):
+                return 3
+            elif kind == MSG_ERROR:
+                return 4
+            elif kind == MSG_STARTED:
+                return 5
+    '''
+    assert _codes(ProtocolLintChecker(), code) == []
+
+
+def test_pt800_single_comparison_is_a_guard_not_a_dispatch():
+    code = '''
+        from petastorm_tpu.workers.protocol import MSG_STARTED
+
+        def is_handshake(kind):
+            if kind == MSG_STARTED:
+                return True
+            return False
+    '''
+    assert _codes(ProtocolLintChecker(), code) == []
+
+
+def test_pt801_local_constant_definition_flagged():
+    """The crafted violation: a pool module growing its own kind table —
+    exactly the drift the canonical workers/protocol.py exists to end."""
+    findings = _findings(ProtocolLintChecker(), '_DATA, _DONE, _ERROR = 0, 1, 2\n')
+    assert [f.code for f in findings] == ['PT801', 'PT801', 'PT801']
+    assert 'workers.protocol' in findings[0].message
+
+
+def test_pt801_raw_kind_byte_comparison_flagged():
+    code = '''
+        def consume(msg):
+            return msg[0] == b'D'
+    '''
+    assert _codes(ProtocolLintChecker(), code) == ['PT801']
+
+
+def test_pt801_canonical_module_and_imports_exempt():
+    canonical = SourceFile('<fixture>', 'workers/protocol.py',
+                           "MSG_DATA = b'D'\nCONTROL_FINISHED = b'FINISHED'\n")
+    assert [f for f in ProtocolLintChecker().check(canonical)] == []
+    code = '''
+        from petastorm_tpu.workers.protocol import MSG_DATA, ring_header
+
+        def frame(seq):
+            return ring_header(MSG_DATA, seq)
+    '''
+    assert _codes(ProtocolLintChecker(), code) == []
+
+
+def test_pt801_scope_is_workers_only():
+    src = SourceFile('<fixture>', 'observability/metrics.py', "_DATA = 0\n")
+    assert not ProtocolLintChecker().matches(src)
+
+
+def test_pt8xx_run_clean_over_the_workers_package():
+    """The checklist acceptance: the migrated pools themselves satisfy the
+    new rules — every kind dispatch exhaustive, every constant imported from
+    the canonical module."""
+    findings = run_analysis([os.path.join(PKG_DIR, 'workers')], select=['PT8'])
     assert findings == [], '\n'.join(f.format() for f in findings)
 
 

@@ -250,15 +250,18 @@ def test_bench_scaling_smoke(tmp_path, capsys):
 
 
 def test_bench_scaling_chaos_smoke(tmp_path, capsys):
-    """--chaos runs the sweep under seeded fault injection (docs/robustness.md):
-    the run must complete end to end, report a positive rate, carry the
-    recovery counters, and have actually recovered from at least one injected
-    fault — and the hooks must be disarmed afterwards."""
+    """--chaos --protocol-monitor runs the sweep under seeded fault injection
+    (docs/robustness.md) with the protocol conformance monitor attached
+    (docs/protocol.md): the run must complete end to end — i.e. the recovery
+    also CONFORMED to the supervision protocol spec — report a positive rate,
+    carry the recovery counters, and have actually recovered from at least one
+    injected fault; the hooks must be disarmed afterwards."""
     import bench_scaling
     from petastorm_tpu import faults, retry
     bench_scaling.main(['--workers', '1', '--pools', 'thread', '--store', 'raw',
                         '--rows', '64', '--measure-rows', '64',
                         '--warmup-rows', '32', '--reps', '1', '--chaos',
+                        '--protocol-monitor',
                         '--keep-dir', str(tmp_path)])
     recs = _scaling_records(capsys)
     assert len(recs) == 1
