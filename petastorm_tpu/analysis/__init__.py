@@ -20,10 +20,11 @@ Rule families (see ``docs/analysis.md`` for bad/good examples):
   swallow without forwarding or re-raising.
 * **PT400** JAX purity — host-side side effects (``np.random``, ``time.*``,
   ``.item()``/``.tolist()``, argument mutation) inside jitted functions.
-* **PT500/PT501/PT502** native-buffer safety — ``np.frombuffer``/
+* **PT500/PT501/PT502/PT503** native-buffer safety — ``np.frombuffer``/
   ``memoryview`` results escaping without a writability check or ``.copy()``;
   zero-copy page views built without a per-page bound check; unbounded
-  recursion in the native C++ sources.
+  recursion in the native C++ sources; fused batch-buffer ABI descriptors
+  missing their byte-capacity fields or pointing at temporaries.
 * **PT600** hashability — ``__eq__`` without ``__hash__``.
 * **PT700** telemetry span hygiene — spans/stage timers opened in
   instrumented code must close on all paths (``with`` or try/finally), or

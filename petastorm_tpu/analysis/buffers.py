@@ -25,6 +25,19 @@ deeply-nested input into C++ stack exhaustion — a process crash no Python
 ``except`` can catch (the round-5 thrift ``skip_value`` defect class). Every
 function participating in a recursion cycle in ``native/*.cpp`` must mention
 a ``depth`` limit.
+
+**PT503** The fused batch-buffer ABI (``native/fused.py`` ↔
+``pstpu_read_fused``) carries raw pointers with explicit byte capacities.
+Two invariants keep it memory-safe from the Python side:
+
+* *lifetime anchored* — a raw address (``X.ctypes.data``) taken from a
+  TEMPORARY expression (``np.empty(n).ctypes.data``) dies before or at the
+  foreign call; the owning buffer must be bound to a name that outlives the
+  call;
+* *bounds arguments present* — a function that stores a descriptor pointer
+  field (``.out`` / ``.chunk`` / ``.aux_buf``) must store its matching
+  capacity field (``.out_cap`` / ``.chunk_len`` / ``.aux_cap``) in the same
+  function, so the kernel always receives the bound it checks against.
 """
 
 from __future__ import annotations
@@ -147,6 +160,7 @@ class NativeBufferChecker(Checker):
             add_parents(src.tree)
             yield from self._check_views(src)
             yield from self._check_page_bounds(src)
+            yield from self._check_fused_abi(src)
         else:
             yield from self._check_cpp_recursion(src)
 
@@ -225,6 +239,42 @@ class NativeBufferChecker(Checker):
                     continue  # the length side itself
                 return True
         return False
+
+    # -- PT503 ---------------------------------------------------------------
+
+    #: descriptor pointer field -> the capacity field the kernel bounds it by
+    _PTR_BOUND_FIELDS = {'out': 'out_cap', 'chunk': 'chunk_len',
+                         'aux_buf': 'aux_cap'}
+
+    def _check_fused_abi(self, src):
+        for fn, _cls in walk_functions(src.tree):
+            assigned = set()
+            for node in ast.walk(fn):
+                # lifetime: <temporary>.ctypes.data — the array dies at the
+                # end of the expression, before the kernel dereferences it
+                if isinstance(node, ast.Attribute) and node.attr in ('data', 'data_as'):
+                    inner = node.value
+                    if isinstance(inner, ast.Attribute) and inner.attr == 'ctypes' \
+                            and isinstance(inner.value, ast.Call):
+                        yield self.finding(
+                            src, node.lineno,
+                            'raw pointer taken from a temporary expression in {}() '
+                            '— bind the buffer to a name that outlives the native '
+                            'call (the temporary is freed before the kernel '
+                            'dereferences it)'.format(fn.name),
+                            code='PT503')
+                if isinstance(node, ast.Assign):
+                    assigned.update(t.attr for t in node.targets
+                                    if isinstance(t, ast.Attribute))
+            for ptr, bound in self._PTR_BOUND_FIELDS.items():
+                if ptr in assigned and bound not in assigned:
+                    yield self.finding(
+                        src, fn.lineno,
+                        'fused-ABI descriptor pointer .{} is set in {}() without '
+                        'its capacity field .{} — the kernel bounds every write '
+                        'by that capacity, so a descriptor without it is an '
+                        'unbounded native write'.format(ptr, fn.name, bound),
+                        code='PT503')
 
     # -- PT502 ---------------------------------------------------------------
 

@@ -125,17 +125,38 @@ class ArrowBatchWorker(WorkerBase):
         physical = [c for c in column_names
                     if c not in piece.partition_keys and c in schema.fields]
         pf = self._parquet_file(piece.path)
-        with obs.stage('read', cat='worker', piece=piece.path,
-                       row_group=piece.row_group):
-            table = pf.read_row_group(piece.row_group, columns=physical)
-            if shuffle_row_drop_partition is not None:
-                indices = select_row_drop_indices(table.num_rows, shuffle_row_drop_partition)
-                table = table.take(indices)
-        with obs.stage('decode', cat='worker', rows=table.num_rows):
-            batch = {name: _column_to_numpy(table.column(name), name) for name in physical}
+        # full-group reads serve qualifying columns through the fused native
+        # read→decode→collate pass (one GIL-released call; docs/native.md) and
+        # Arrow only for the remainder; row subsets need Arrow's take
+        pre = {}
+        if shuffle_row_drop_partition is None and physical and hasattr(pf, 'read_fused'):
+            try:
+                # schema_fields=None: the batch reader's contract is RAW
+                # columns (no codec decode — encoded images stay bytes), so
+                # only plain fixed-width numeric columns fuse here
+                pre, _rest = pf.read_fused(piece.row_group, physical, None)
+            except Exception:  # noqa: BLE001 - any surprise: Arrow path serves it all
+                pre = {}
+        rest = [c for c in physical if c not in pre]
+        if rest or not pre:
+            with obs.stage('read', cat='worker', piece=piece.path,
+                           row_group=piece.row_group):
+                table = pf.read_row_group(piece.row_group, columns=rest)
+                if shuffle_row_drop_partition is not None:
+                    indices = select_row_drop_indices(table.num_rows,
+                                                      shuffle_row_drop_partition)
+                    table = table.take(indices)
+            num_rows = table.num_rows
+        else:
+            table = None
+            num_rows = len(next(iter(pre.values())))
+        with obs.stage('decode', cat='worker', rows=num_rows):
+            batch = {name: (pre[name] if name in pre
+                            else _column_to_numpy(table.column(name), name))
+                     for name in physical}
         for key, value in piece.partition_keys.items():
             if key in column_names:
-                batch[key] = np.full(table.num_rows, value)
+                batch[key] = np.full(num_rows, value)
         return batch
 
     def _apply_predicate(self, batch, predicate):

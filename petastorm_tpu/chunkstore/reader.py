@@ -78,6 +78,7 @@ class ChunkCachedParquetFile(object):
         self._qual_cache = {}   # (row_group, tuple(names)) -> _qualifying list
         self._pages_cache = {}  # chunk key -> scan_mirrored_chunk plan
         self._disable_scan = bool(os.environ.get('PSTPU_DISABLE_PAGESCAN'))
+        self._fused_plans = {}  # (rg, columns, hints sig) -> FusedPlan | None
 
     # -- remote IO -----------------------------------------------------------
 
@@ -207,6 +208,75 @@ class ChunkCachedParquetFile(object):
                 continue
             out[name] = pa.chunked_array(arrays)
         return out
+
+    # -- fused batch decode over mirrored chunks (docs/native.md) ------------
+
+    def fused_plan(self, i, columns, schema_fields=None, decode_hints=None,
+                   resize_hints=None, include_pagescan=False):
+        """Fused-decode plan for one row group of this REMOTE file — the same
+        qualification the local path runs, judged from the cached footer.
+        Dictionary/RLE/snappy chunks that the view path cannot mirror now
+        become cacheable too: the fused kernel decodes them from the local
+        mirror, so epoch 2+ touches no remote bytes for them either."""
+        if self._lib is None or os.environ.get('PSTPU_DISABLE_FUSED'):
+            return None
+        from petastorm_tpu.native import fused
+        key = (i, tuple(columns), bool(include_pagescan),
+               frozenset(n for n in (decode_hints or {}) if decode_hints[n]),
+               frozenset(n for n in (resize_hints or {}) if resize_hints[n]))
+        if key not in self._fused_plans:
+            plan = fused.plan_row_group(self._meta, self._flat_index, i, columns,
+                                        schema_fields, decode_hints, resize_hints,
+                                        include_pagescan=include_pagescan)
+            if plan is not None:
+                for p in list(plan.columns):
+                    if p.chunk_off + p.chunk_len > self._file_size:
+                        plan.columns.remove(p)
+                        plan.rest.append(p.name)
+                        plan.reasons[p.name] = 'bounds'
+            self._fused_plans[key] = plan
+        return self._fused_plans[key]
+
+    def _fused_chunks(self, plan):
+        """Per-column chunk views served from the content-addressed local
+        mirror (fetched once per chunk; warm reads are pure mmap)."""
+        chunks = []
+        for p in plan.columns:
+            key = self._chunk_key(p.chunk_off, p.chunk_len)
+            try:
+                mm = self._store.mmap_chunk(
+                    key, p.chunk_len, self._range_fetcher(p.chunk_off, p.chunk_len))
+            except Exception as e:  # noqa: BLE001 - fetch surprise: column falls back
+                logger.debug('chunk mirror fetch failed for %s:%s (%s)',
+                             self.path, p.name, e)
+                mm = None
+            chunks.append(mm)
+        return chunks
+
+    def read_fused(self, i, columns, schema_fields=None, decode_hints=None,
+                   resize_hints=None):
+        """Same contract as ``NativeParquetFile.read_fused``, served from
+        mirrored chunks."""
+        from petastorm_tpu.native import fused
+        plan = self.fused_plan(i, columns, schema_fields, decode_hints, resize_hints)
+        if plan is None:
+            return {}, list(columns)
+        if not plan.columns:
+            fused.count_fallbacks(plan.reasons)
+            return {}, list(columns)
+        block, _reasons = fused.read_block(self._lib, self._fused_chunks(plan),
+                                           plan, stage_args={'row_group': i})
+        rest = [c for c in columns if c not in block]
+        return block, rest
+
+    def fused_read_into(self, plan, out_buf, offsets):
+        """In-place (shm-ring slot) variant, mirroring the local reader."""
+        from petastorm_tpu import observability as obs
+        from petastorm_tpu.native import fused
+        with obs.stage('fused_decode', cat='native', rows=plan.expected_rows):
+            return fused.read_into(self._lib, self._fused_chunks(plan),
+                                   plan.columns, plan.expected_rows, out_buf,
+                                   offsets)
 
     def read_row_group(self, i, columns=None):
         """One row group as a ``pyarrow.Table``; qualifying columns are views

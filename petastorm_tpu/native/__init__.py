@@ -72,6 +72,8 @@ def _load_library():
             ctypes.POINTER(ctypes.c_ulonglong), ctypes.POINTER(ctypes.c_longlong),
             ctypes.POINTER(ctypes.c_ulonglong),  # per-page values-region lengths
             ctypes.c_int, ctypes.c_int]
+        from petastorm_tpu.native import fused as _fused
+        _fused.register_abi(lib)
         _lib = lib
         return _lib
 
@@ -135,6 +137,7 @@ class NativeParquetFile(object):
         self._pq_meta = None        # pyarrow FileMetaData | False (unusable)
         self._flat_index = {}
         self._mmaps = _MmapPool()
+        self._fused_plans = {}      # (rg, columns, hints sig) -> FusedPlan | None
 
     def row_group_num_rows(self, i):
         n = self._lib.pstpu_row_group_num_rows(self._handle, i)
@@ -142,13 +145,9 @@ class NativeParquetFile(object):
             raise IndexError(_last_error(self._lib))
         return n
 
-    def _zerocopy_columns(self, i, columns):
-        """``{name: ChunkedArray}`` for the columns servable as views over the
-        mmapped file (first-party page scan — see native/pagescan.py); lazily
-        parses the footer with pyarrow ONCE per file for the chunk metadata
-        the qualification check needs."""
-        if os.environ.get('PSTPU_DISABLE_PAGESCAN'):
-            return {}
+    def _ensure_pq_meta(self):
+        """Parse the footer with pyarrow ONCE per file (the chunk metadata the
+        page-scan/fused qualification checks need); False when unusable."""
         if self._pq_meta is None:
             import pyarrow.parquet as pq
             try:
@@ -161,12 +160,80 @@ class NativeParquetFile(object):
                     self._pq_meta.schema.column(idx).path: idx
                     for idx in range(self._pq_meta.num_columns)
                     if '.' not in self._pq_meta.schema.column(idx).path}
-        if self._pq_meta is False:
+        return self._pq_meta
+
+    def _zerocopy_columns(self, i, columns):
+        """``{name: ChunkedArray}`` for the columns servable as views over the
+        mmapped file (first-party page scan — see native/pagescan.py)."""
+        if os.environ.get('PSTPU_DISABLE_PAGESCAN'):
+            return {}
+        if self._ensure_pq_meta() is False:
             return {}
         from petastorm_tpu.native import pagescan
         return pagescan.read_columns_zerocopy(
             self.path, self._pq_meta, i, columns, self._flat_index,
             self._mmaps, self._lib)
+
+    # -- fused batch decode (native/fused.py; docs/native.md) ---------------
+
+    def fused_plan(self, i, columns, schema_fields=None, decode_hints=None,
+                   resize_hints=None, include_pagescan=False):
+        """:class:`~petastorm_tpu.native.fused.FusedPlan` for one row group's
+        column selection (memoized per file), or None when fused decode is
+        disabled/unusable for this file."""
+        if os.environ.get('PSTPU_DISABLE_FUSED') or self._ensure_pq_meta() is False:
+            return None
+        key = (i, tuple(columns), bool(include_pagescan),
+               frozenset(n for n in (decode_hints or {}) if decode_hints[n]),
+               frozenset(n for n in (resize_hints or {}) if resize_hints[n]))
+        if key not in self._fused_plans:
+            from petastorm_tpu.native import fused
+            self._fused_plans[key] = fused.plan_row_group(
+                self._pq_meta, self._flat_index, i, columns, schema_fields,
+                decode_hints, resize_hints, include_pagescan=include_pagescan)
+        return self._fused_plans[key]
+
+    def _fused_chunks(self, plan):
+        """Per-column chunk byte views over the mmapped file (bounds-checked
+        against the file size; a stale footer fails the read, not the
+        process)."""
+        mm = self._mmaps.get(self.path)
+        chunks = []
+        for p in plan.columns:
+            if p.chunk_off < 0 or p.chunk_off + p.chunk_len > mm.size:
+                chunks.append(None)
+            else:
+                chunks.append(mm[p.chunk_off:p.chunk_off + p.chunk_len])
+        return chunks
+
+    def read_fused(self, i, columns, schema_fields=None, decode_hints=None,
+                   resize_hints=None):
+        """Fused read→decode→collate of one row group: every qualifying column
+        lands as a numpy array backed by ONE fresh contiguous batch buffer,
+        decoded in a single GIL-released native call. Returns ``(block,
+        rest)`` — ``rest`` preserves the requested order of the columns that
+        must ride the Arrow path (with their fallback reasons accounted)."""
+        from petastorm_tpu.native import fused
+        plan = self.fused_plan(i, columns, schema_fields, decode_hints, resize_hints)
+        if plan is None:
+            return {}, list(columns)
+        if not plan.columns:
+            fused.count_fallbacks(plan.reasons)
+            return {}, list(columns)
+        block, _reasons = fused.read_block(self._lib, self._fused_chunks(plan),
+                                           plan, stage_args={'row_group': i})
+        rest = [c for c in columns if c not in block]
+        return block, rest
+
+    def fused_read_into(self, plan, out_buf, offsets):
+        """Run a prepared fused plan writing directly into ``out_buf`` (the
+        shm-ring in-place mode: the buffer is the ring slot the consumer
+        maps). Returns the per-column native results."""
+        from petastorm_tpu.native import fused
+        with obs.stage('fused_decode', cat='native', rows=plan.expected_rows):
+            return fused.read_into(self._lib, self._fused_chunks(plan),
+                                   plan.columns, plan.expected_rows, out_buf,
+                                   offsets)
 
     def read_row_group(self, i, columns=None):
         """Read one row group as a ``pyarrow.Table``. Columns that qualify for

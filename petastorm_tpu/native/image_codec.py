@@ -86,6 +86,19 @@ def is_available():
     return _load_library() is not None
 
 
+def batch_fn_addrs():
+    """Raw C addresses of the batched probe/decode entry points, for the fused
+    row-group kernel (``pstpu_read_fused``) to call THROUGH — image decode then
+    happens inside the same native transition as the page scan and value
+    decode, with no link-time coupling between the two libraries. Returns
+    ``(probe_addr, decode_addr)`` or None when the codec is unavailable."""
+    lib = _load_library()
+    if lib is None:
+        return None
+    return (ctypes.cast(lib.pstpu_img_probe_batch2, ctypes.c_void_p).value,
+            ctypes.cast(lib.pstpu_img_decode_batch2, ctypes.c_void_p).value)
+
+
 def _default_threads():
     """The per-PROCESS native decode thread budget (``PSTPU_IMG_THREADS``).
     Not a per-call fan-out: concurrent callers share it through

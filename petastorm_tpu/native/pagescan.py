@@ -32,9 +32,12 @@ from __future__ import annotations
 
 import ctypes
 import logging
+import threading
 
 import numpy as np
 import pyarrow as pa
+
+from petastorm_tpu import observability as obs
 
 logger = logging.getLogger(__name__)
 
@@ -51,7 +54,12 @@ _MAX_PAGES = 4096
 #: per-thread scratch for the scanner's out-arrays — allocating (and zeroing)
 #: 64KB of ctypes arrays per call measured at 0.33ms on the bench host,
 #: comparable to the scan itself
-_scratch = __import__('threading').local()
+_scratch = threading.local()
+
+#: chunks that overflowed _MAX_PAGES warn once per process (the counter keeps
+#: counting; the log line just must not spam every batch of a pathological
+#: store)
+_page_cap_warned = False
 
 
 def _scratch_arrays():
@@ -62,6 +70,26 @@ def _scratch_arrays():
                   (ctypes.c_ulonglong * _MAX_PAGES)())
         _scratch.arrays = arrays
     return arrays
+
+
+def _note_scan_failure(lib, where):
+    """A scan returned -1: most causes are ordinary qualification gaps the
+    caller already accounts, but overflowing the ``_MAX_PAGES`` cap is a
+    CONFIGURATION edge (a chunk with more pages silently losing the fast path
+    forever) — it gets a labelled counter and a one-time warning instead of a
+    silent fallback."""
+    global _page_cap_warned
+    err = lib.pstpu_last_error().decode('utf-8', 'replace')
+    if 'max_pages' not in err:
+        return
+    obs.count('pagescan_fallback_reason:page-cap')
+    if not _page_cap_warned:
+        _page_cap_warned = True
+        logger.warning(
+            'page scan of %s hit the %d-page-per-chunk cap and fell back to '
+            'Arrow; this store writes unusually small pages — rewrite it with '
+            'a larger data_page_size to recover the zero-copy path', where,
+            _MAX_PAGES)
 
 
 class _MmapPool(object):
@@ -135,6 +163,7 @@ def scan_mirrored_chunk(lib, mm, meta_col, has_def_levels=False):
         mm.ctypes.data_as(ctypes.c_void_p), length, offs, counts, vlens,
         _MAX_PAGES, 1 if has_def_levels else 0)
     if n < 0:
+        _note_scan_failure(lib, 'mirrored chunk')
         return None
     return [(offs[i], counts[i], vlens[i]) for i in range(n)]
 
@@ -175,6 +204,7 @@ def _scan_chunk(lib, mm, meta_col, has_def_levels=False):
         chunk.ctypes.data_as(ctypes.c_void_p), length, offs, counts, vlens,
         _MAX_PAGES, 1 if has_def_levels else 0)
     if n < 0:
+        _note_scan_failure(lib, getattr(meta_col, 'path_in_schema', 'chunk'))
         return None
     return [(start + offs[i], counts[i], vlens[i]) for i in range(n)]
 

@@ -31,10 +31,14 @@
 #include <fcntl.h>
 
 #include <algorithm>
+#include <atomic>
+#include <cstdint>
 #include <cstring>
 #include <memory>
 #include <mutex>
 #include <string>
+#include <thread>
+#include <utility>
 #include <vector>
 
 namespace {
@@ -347,6 +351,8 @@ struct PageInfo {
   int64_t num_values = -1;
   int32_t encoding = -1;           // DataPageHeader.encoding; 0=PLAIN
   int32_t def_level_encoding = -1; // DataPageHeader field 3; 3=RLE
+  int64_t dict_num_values = -1;    // DictionaryPageHeader field 1
+  int32_t dict_encoding = -1;      // DictionaryPageHeader field 2; 0/2=PLAIN
   uint64_t header_len = 0;
 };
 
@@ -383,6 +389,19 @@ bool parse_page_header(TReader& r, PageInfo* info) {
         if (iid == 1 && itype == 5) info->num_values = r.zigzag();
         else if (iid == 2 && itype == 5) info->encoding = int32_t(r.zigzag());
         else if (iid == 3 && itype == 5) info->def_level_encoding = int32_t(r.zigzag());
+        else r.skip_value(itype, 0);
+      }
+    } else if (id == 7 && type == 12) {  // DictionaryPageHeader
+      int16_t inner_last = 0;
+      while (r.ok) {
+        const uint8_t ih = r.byte();
+        if (ih == 0) break;
+        const int itype = ih & 0x0F;
+        int16_t iid = (ih >> 4) == 0 ? int16_t(r.zigzag())
+                                     : int16_t(inner_last + (ih >> 4));
+        inner_last = iid;
+        if (iid == 1 && itype == 5) info->dict_num_values = r.zigzag();
+        else if (iid == 2 && itype == 5) info->dict_encoding = int32_t(r.zigzag());
         else r.skip_value(itype, 0);
       }
     } else {
@@ -467,6 +486,561 @@ long long pstpu_scan_plain_pages(const uint8_t* chunk, unsigned long long chunk_
   return n;
 }
 
-int pstpu_abi_version() { return 2; }
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Fused batch decode — read→decode→collate in ONE native call.
+//
+// The page scan above still hands each column back to Python (one ctypes call
+// + Arrow view + collate per column per batch), and forfeits any dictionary-
+// or RLE-encoded chunk to Arrow. pstpu_read_fused removes that tail: for a
+// whole batch of columns it walks the page headers, decompresses SNAPPY pages
+// first-party, decodes PLAIN *and* dictionary/RLE-bit-packed-hybrid values,
+// and writes every column's rows into a caller-provided contiguous batch
+// buffer — optionally an shm-ring slot the consumer maps — on C++ worker
+// threads with the GIL released. Python touches the result exactly once per
+// batch. Binary columns come in two fused flavors: uniform raw cells (npy
+// payloads, headers stripped) and encoded images, which are decoded through
+// the batched image-codec entry points passed in as function pointers so the
+// whole read→decode→collate chain is one transition.
+//
+// Every parse is bounds-checked against the chunk/page/output regions and
+// every failure is a per-column status code — the caller falls back to the
+// Arrow path for that column and accounts the reason, never crashes.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+// First-party snappy *decompressor* (format_description.txt): varint preamble
+// with the uncompressed length, then literal/copy elements. Decode-only — the
+// write path never emits snappy from here. All reads are bounds-checked; any
+// malformed element returns false and the column falls back to Arrow.
+bool read_uvarint(const uint8_t** pp, const uint8_t* end, uint64_t* out) {
+  uint64_t v = 0;
+  int shift = 0;
+  const uint8_t* p = *pp;
+  while (p < end && shift <= 28) {  // 5 bytes max: 35 bits covers lengths/runs
+    const uint8_t b = *p++;
+    v |= uint64_t(b & 0x7F) << shift;
+    if (!(b & 0x80)) {
+      *pp = p;
+      *out = v;
+      return true;
+    }
+    shift += 7;
+  }
+  return false;
+}
+
+bool snappy_uncompress(const uint8_t* src, uint64_t n, uint8_t* dst, uint64_t dst_len) {
+  const uint8_t* p = src;
+  const uint8_t* end = src + n;
+  uint64_t expect = 0;
+  if (!read_uvarint(&p, end, &expect) || expect != dst_len) return false;
+  uint64_t d = 0;
+  while (p < end) {
+    const uint8_t tag = *p++;
+    if ((tag & 3) == 0) {  // literal
+      uint64_t len = tag >> 2;
+      if (len >= 60) {
+        const int extra = int(len) - 59;  // 1..4 little-endian length bytes
+        if (end - p < extra) return false;
+        len = 0;
+        for (int i = 0; i < extra; i++) len |= uint64_t(p[i]) << (8 * i);
+        p += extra;
+      }
+      len += 1;
+      if (uint64_t(end - p) < len || dst_len - d < len) return false;
+      std::memcpy(dst + d, p, len);
+      p += len;
+      d += len;
+    } else {  // copy
+      uint64_t len, off;
+      if ((tag & 3) == 1) {
+        if (p >= end) return false;
+        len = ((tag >> 2) & 7) + 4;
+        off = (uint64_t(tag & 0xE0) << 3) | *p++;
+      } else if ((tag & 3) == 2) {
+        if (end - p < 2) return false;
+        len = (tag >> 2) + 1;
+        off = uint64_t(p[0]) | (uint64_t(p[1]) << 8);
+        p += 2;
+      } else {
+        if (end - p < 4) return false;
+        len = (tag >> 2) + 1;
+        off = uint64_t(p[0]) | (uint64_t(p[1]) << 8) |
+              (uint64_t(p[2]) << 16) | (uint64_t(p[3]) << 24);
+        p += 4;
+      }
+      if (off == 0 || off > d || dst_len - d < len) return false;
+      const uint8_t* s = dst + (d - off);
+      if (off >= len) {
+        std::memcpy(dst + d, s, len);
+      } else {
+        for (uint64_t i = 0; i < len; i++) dst[d + i] = s[i];  // overlapping run
+      }
+      d += len;
+    }
+  }
+  return d == expect;
+}
+
+// RLE / bit-packed hybrid decoder (<bit-width:1 byte> precedes this stream in
+// dictionary-encoded data pages; def-level blocks carry the width implicitly).
+// Emits exactly `count` values; trailing runs may overhang and are clamped.
+// Zero-length runs/groups are rejected so progress is guaranteed.
+bool decode_hybrid(const uint8_t* p, const uint8_t* end, int bw, int64_t count,
+                   std::vector<uint32_t>* out) {
+  if (bw < 0 || bw > 32 || count < 0) return false;
+  out->clear();
+  out->reserve(size_t(count));
+  if (bw == 0) {
+    out->assign(size_t(count), 0);
+    return true;
+  }
+  const uint32_t mask = (bw == 32) ? 0xFFFFFFFFu : ((1u << bw) - 1);
+  const int vbytes = (bw + 7) / 8;
+  while (int64_t(out->size()) < count) {
+    uint64_t header = 0;
+    if (!read_uvarint(&p, end, &header)) return false;
+    const uint64_t remaining = uint64_t(count) - out->size();
+    if (header & 1) {  // bit-packed: (header>>1) groups of 8 values
+      const uint64_t groups = header >> 1;
+      if (groups == 0) return false;
+      const uint64_t nbytes = groups * uint64_t(bw);
+      if (uint64_t(end - p) < nbytes) return false;
+      const uint64_t take = std::min<uint64_t>(groups * 8, remaining);
+      uint64_t bit = 0;
+      for (uint64_t i = 0; i < take; i++) {
+        const uint64_t byte_idx = bit >> 3;
+        uint64_t word = 0;
+        const uint64_t avail = nbytes - byte_idx;
+        std::memcpy(&word, p + byte_idx, avail < 8 ? size_t(avail) : size_t(8));
+        out->push_back(uint32_t(word >> (bit & 7)) & mask);
+        bit += uint64_t(bw);
+      }
+      p += nbytes;
+    } else {  // RLE run
+      const uint64_t run = header >> 1;
+      if (run == 0) return false;
+      if (end - p < vbytes) return false;
+      uint32_t v = 0;
+      for (int i = 0; i < vbytes; i++) v |= uint32_t(p[i]) << (8 * i);
+      p += vbytes;
+      out->insert(out->end(), size_t(std::min<uint64_t>(run, remaining)), v & mask);
+    }
+  }
+  return true;
+}
+
+// per-column status codes — keep in sync with native/fused.py REASONS
+enum {
+  kColOk = 0,
+  kColParse = 1,       // thrift/page/snappy parse failure
+  kColPageType = 2,    // v2 page or unknown page type
+  kColEncoding = 3,    // unsupported value/level encoding
+  kColCompressed = 4,  // unsupported codec / size mismatch
+  kColDefLevels = 5,   // def-levels block malformed
+  kColPageCap = 6,     // more pages than max_pages
+  kColRows = 7,        // decoded rows != expected_rows
+  kColBounds = 8,      // values/output region bounds violation
+  kColDict = 9,        // dictionary missing/invalid for an indexed page
+  kColNonUniform = 10, // binary cells not uniform (raw mode)
+  kColImgProbe = 11,
+  kColImgDims = 12,
+  kColImgDecode = 13,
+  kColInternal = 14,   // unexpected native failure (e.g. allocation)
+};
+
+enum { kModeFixed = 0, kModeBinaryRaw = 1, kModeBinaryImg = 2 };
+enum { kCodecUncompressed = 0, kCodecSnappy = 1 };
+
+}  // namespace
+
+// one column of the fused batch; mirrored field-for-field by the
+// ctypes.Structure in native/fused.py (the batch-buffer ABI). File scope (not
+// the anonymous namespace): the extern "C" entry point takes it by pointer.
+struct FusedCol {
+  const uint8_t* chunk;   // column chunk bytes (dictionary page first)
+  uint64_t chunk_len;
+  uint8_t* out;           // destination region inside the batch buffer
+  uint64_t out_cap;       // bounds: the native side never writes past this
+  uint8_t* aux_buf;       // small per-column side buffer (npy header copy)
+  uint64_t aux_cap;
+  int64_t expected_rows;
+  int32_t mode;           // kMode*
+  int32_t codec;          // kCodec*
+  int32_t itemsize;       // kModeFixed: value byte width (FLBA width for FLBA)
+  int32_t has_def_levels; // OPTIONAL chunk PROVEN null-free: skip RLE block
+  int32_t strip_npy;      // kModeBinaryRaw: strip identical np.save headers
+  int32_t img_w, img_h, img_c;  // kModeBinaryImg: expected decoded dims
+  int32_t img_threads;
+  int32_t status;         // out: kCol*
+  uint64_t out_used;      // out: bytes written into `out`
+  uint64_t aux0;          // out: raw: per-cell payload len; img: row bytes
+  uint64_t aux1;          // out: raw: npy header len in aux_buf
+};
+
+namespace {
+
+// batched image-codec entry points (image_codec.cpp), passed as pointers so
+// this kernel needs no link-time dependency on the optional image library
+using ImgProbeFn = long long (*)(long long, void**, unsigned long long*,
+                                 int32_t*, int32_t, int32_t);
+using ImgDecodeFn = long long (*)(long long, void**, unsigned long long*,
+                                  void**, int32_t*, int, int32_t, int32_t);
+
+struct PageRec {
+  int32_t encoding;
+  int64_t num_values;
+  uint64_t body_off;   // page body offset within the chunk (possibly compressed)
+  uint64_t body_len;   // compressed size
+  uint64_t plain_len;  // uncompressed size
+  bool is_dict;
+};
+
+int scan_fused_pages(const FusedCol& c, int max_pages, std::vector<PageRec>* pages) {
+  uint64_t pos = 0;
+  while (pos < c.chunk_len) {
+    TReader r{c.chunk + pos, c.chunk + c.chunk_len};
+    PageInfo info;
+    if (!parse_page_header(r, &info)) return kColParse;
+    if (info.compressed_size < 0 || info.uncompressed_size < 0) return kColParse;
+    const uint64_t body_off = pos + info.header_len;
+    const uint64_t page_end = body_off + uint64_t(info.compressed_size);
+    if (page_end > c.chunk_len || page_end <= pos) return kColBounds;
+    if (c.codec == kCodecUncompressed &&
+        info.compressed_size != info.uncompressed_size) {
+      return kColCompressed;
+    }
+    PageRec rec;
+    rec.body_off = body_off;
+    rec.body_len = uint64_t(info.compressed_size);
+    rec.plain_len = uint64_t(info.uncompressed_size);
+    if (info.page_type == 2) {  // dictionary page
+      if (!pages->empty()) return kColParse;  // must precede the data pages
+      if (info.dict_encoding != 0 && info.dict_encoding != 2) return kColEncoding;
+      if (info.dict_num_values < 0) return kColParse;
+      rec.encoding = 0;
+      rec.num_values = info.dict_num_values;
+      rec.is_dict = true;
+    } else if (info.page_type == 0) {  // data page v1
+      if (info.encoding != 0 && info.encoding != 2 && info.encoding != 8) {
+        return kColEncoding;
+      }
+      if (c.has_def_levels && info.def_level_encoding != 3) return kColDefLevels;
+      if (info.num_values < 0) return kColParse;
+      rec.encoding = info.encoding;
+      rec.num_values = info.num_values;
+      rec.is_dict = false;
+    } else {
+      return kColPageType;  // v2 / index / unknown pages: Arrow path
+    }
+    if (int(pages->size()) >= max_pages) return kColPageCap;
+    pages->push_back(rec);
+    pos = page_end;
+  }
+  return kColOk;
+}
+
+// Uncompressed VALUES region of one page: decompresses into `scratch` when the
+// chunk codec is snappy, then skips the RLE def-levels block when present.
+// The returned pointer aliases either the chunk or `scratch` — the caller
+// keeps `scratch` alive while the values are in use.
+int page_values(const FusedCol& c, const PageRec& pg, std::vector<uint8_t>* scratch,
+                const uint8_t** vals, uint64_t* vlen) {
+  const uint8_t* base = c.chunk + pg.body_off;
+  uint64_t len = pg.body_len;
+  if (c.codec == kCodecSnappy) {
+    scratch->resize(size_t(pg.plain_len));
+    if (!snappy_uncompress(base, len, scratch->data(), pg.plain_len)) {
+      return kColParse;
+    }
+    base = scratch->data();
+    len = pg.plain_len;
+  } else if (c.codec != kCodecUncompressed) {
+    return kColCompressed;
+  }
+  if (!pg.is_dict && c.has_def_levels) {
+    if (len < 4) return kColDefLevels;
+    uint32_t def_len = 0;
+    std::memcpy(&def_len, base, 4);  // little-endian host
+    if (uint64_t(def_len) + 4 > len) return kColDefLevels;
+    base += 4 + def_len;
+    len -= 4 + def_len;
+  }
+  *vals = base;
+  *vlen = len;
+  return kColOk;
+}
+
+int decode_fixed(FusedCol* c, const std::vector<PageRec>& pages) {
+  const uint64_t w = uint64_t(c->itemsize);
+  if (w == 0 || w > (64u << 20)) return kColParse;
+  std::vector<uint8_t> dict_store;       // owns decompressed dictionary values
+  const uint8_t* dict_vals = nullptr;
+  uint64_t n_dict = 0;
+  std::vector<uint8_t> scratch;
+  std::vector<uint32_t> idx;
+  uint64_t written = 0;
+  int64_t rows = 0;
+  for (const PageRec& pg : pages) {
+    const uint8_t* vals = nullptr;
+    uint64_t vlen = 0;
+    if (pg.is_dict) {
+      int rc = page_values(*c, pg, &dict_store, &vals, &vlen);
+      if (rc != kColOk) return rc;
+      if (uint64_t(pg.num_values) * w > vlen) return kColDict;
+      if (c->codec == kCodecUncompressed) {
+        // values point into the chunk; keep them there (no copy needed)
+        dict_vals = vals;
+      } else {
+        dict_vals = dict_store.data();  // scratch persists for the column
+      }
+      n_dict = uint64_t(pg.num_values);
+      continue;
+    }
+    int rc = page_values(*c, pg, &scratch, &vals, &vlen);
+    if (rc != kColOk) return rc;
+    const uint64_t need = uint64_t(pg.num_values) * w;
+    if (written + need > c->out_cap) return kColBounds;
+    if (pg.encoding == 0) {  // PLAIN: the values region IS the rows
+      if (need > vlen) return kColBounds;
+      std::memcpy(c->out + written, vals, need);
+    } else {  // PLAIN_DICTIONARY / RLE_DICTIONARY indices
+      if (dict_vals == nullptr) return kColDict;
+      if (vlen < 1) return kColParse;
+      const int bw = vals[0];
+      if (!decode_hybrid(vals + 1, vals + vlen, bw, pg.num_values, &idx)) {
+        return kColParse;
+      }
+      uint8_t* dst = c->out + written;
+      for (int64_t i = 0; i < pg.num_values; i++) {
+        const uint32_t k = idx[size_t(i)];
+        if (k >= n_dict) return kColDict;
+        std::memcpy(dst + uint64_t(i) * w, dict_vals + uint64_t(k) * w, w);
+      }
+    }
+    written += need;
+    rows += pg.num_values;
+  }
+  if (rows != c->expected_rows) return kColRows;
+  c->out_used = written;
+  return kColOk;
+}
+
+// Collect the byte-array cells of a BYTE_ARRAY chunk (PLAIN length-prefixed
+// values, or dictionary indices into length-prefixed dictionary entries).
+// Cell pointers alias the chunk or the scratch vectors pushed onto
+// `scratches` — which the caller must keep alive while the cells are in use.
+int collect_cells(const FusedCol& c, const std::vector<PageRec>& pages,
+                  std::vector<std::pair<const uint8_t*, uint64_t>>* cells,
+                  std::vector<std::vector<uint8_t>>* scratches) {
+  std::vector<std::pair<const uint8_t*, uint64_t>> dict_entries;
+  std::vector<uint32_t> idx;
+  for (const PageRec& pg : pages) {
+    scratches->emplace_back();
+    const uint8_t* vals = nullptr;
+    uint64_t vlen = 0;
+    int rc = page_values(c, pg, &scratches->back(), &vals, &vlen);
+    if (rc != kColOk) return rc;
+    if (pg.is_dict) {
+      dict_entries.clear();
+      dict_entries.reserve(size_t(pg.num_values));
+      uint64_t off = 0;
+      for (int64_t i = 0; i < pg.num_values; i++) {
+        if (off + 4 > vlen) return kColDict;
+        uint32_t n = 0;
+        std::memcpy(&n, vals + off, 4);
+        off += 4;
+        if (uint64_t(n) > vlen - off) return kColDict;
+        dict_entries.emplace_back(vals + off, uint64_t(n));
+        off += n;
+      }
+      continue;
+    }
+    if (pg.encoding == 0) {  // PLAIN: <u32 len><bytes> per value
+      uint64_t off = 0;
+      for (int64_t i = 0; i < pg.num_values; i++) {
+        if (off + 4 > vlen) return kColBounds;
+        uint32_t n = 0;
+        std::memcpy(&n, vals + off, 4);
+        off += 4;
+        if (uint64_t(n) > vlen - off) return kColBounds;
+        cells->emplace_back(vals + off, uint64_t(n));
+        off += n;
+      }
+    } else {  // dictionary indices
+      if (dict_entries.empty() && pg.num_values > 0) return kColDict;
+      if (vlen < 1) return kColParse;
+      if (!decode_hybrid(vals + 1, vals + vlen, vals[0], pg.num_values, &idx)) {
+        return kColParse;
+      }
+      for (int64_t i = 0; i < pg.num_values; i++) {
+        const uint32_t k = idx[size_t(i)];
+        if (k >= dict_entries.size()) return kColDict;
+        cells->push_back(dict_entries[size_t(k)]);
+      }
+    }
+  }
+  if (int64_t(cells->size()) != c.expected_rows) return kColRows;
+  return kColOk;
+}
+
+// np.save header span of one cell: magic + version + 2/4-byte header length.
+// Returns 0 when the cell is not a standard v1/v2 npy payload.
+uint64_t npy_header_len(const uint8_t* p, uint64_t n) {
+  static const uint8_t kMagic[6] = {0x93, 'N', 'U', 'M', 'P', 'Y'};
+  if (n < 12 || std::memcmp(p, kMagic, 6) != 0) return 0;
+  uint64_t data_off;
+  if (p[6] == 1) {
+    data_off = 10 + (uint64_t(p[8]) | (uint64_t(p[9]) << 8));
+  } else if (p[6] == 2) {
+    uint32_t hl = 0;
+    std::memcpy(&hl, p + 8, 4);
+    data_off = 12 + uint64_t(hl);
+  } else {
+    return 0;
+  }
+  return data_off <= n ? data_off : 0;
+}
+
+int decode_binary_raw(FusedCol* c, const std::vector<PageRec>& pages) {
+  std::vector<std::pair<const uint8_t*, uint64_t>> cells;
+  std::vector<std::vector<uint8_t>> scratches;
+  int rc = collect_cells(*c, pages, &cells, &scratches);
+  if (rc != kColOk) return rc;
+  if (cells.empty()) return kColRows;
+  const uint64_t cell_len = cells[0].second;
+  uint64_t prefix = 0;
+  if (c->strip_npy) {
+    prefix = npy_header_len(cells[0].first, cell_len);
+    if (prefix == 0) return kColNonUniform;
+    if (prefix > c->aux_cap || c->aux_buf == nullptr) return kColNonUniform;
+    std::memcpy(c->aux_buf, cells[0].first, prefix);
+    c->aux1 = prefix;
+  }
+  const uint64_t payload = cell_len - prefix;
+  uint64_t written = 0;
+  for (const auto& cell : cells) {
+    if (cell.second != cell_len) return kColNonUniform;
+    if (prefix != 0 && std::memcmp(cell.first, cells[0].first, prefix) != 0) {
+      return kColNonUniform;  // mixed shapes/dtypes within the chunk
+    }
+    if (written + payload > c->out_cap) return kColBounds;
+    std::memcpy(c->out + written, cell.first + prefix, payload);
+    written += payload;
+  }
+  c->aux0 = payload;
+  c->out_used = written;
+  return kColOk;
+}
+
+int decode_binary_img(FusedCol* c, const std::vector<PageRec>& pages,
+                      ImgProbeFn probe, ImgDecodeFn decode) {
+  if (probe == nullptr || decode == nullptr) return kColImgProbe;
+  std::vector<std::pair<const uint8_t*, uint64_t>> cells;
+  std::vector<std::vector<uint8_t>> scratches;
+  int rc = collect_cells(*c, pages, &cells, &scratches);
+  if (rc != kColOk) return rc;
+  const long long n = (long long)cells.size();
+  if (n == 0) return kColRows;
+  const size_t un = size_t(n);
+  std::vector<void*> ptrs(un);
+  std::vector<unsigned long long> lens(un);
+  for (size_t i = 0; i < un; i++) {
+    ptrs[i] = const_cast<uint8_t*>(cells[i].first);
+    lens[i] = cells[i].second;
+  }
+  std::vector<int32_t> infos(un * 4);
+  if (probe(n, ptrs.data(), lens.data(), infos.data(), 0, 0) != -1) {
+    return kColImgProbe;
+  }
+  const uint64_t row_bytes =
+      uint64_t(c->img_h) * uint64_t(c->img_w) * uint64_t(c->img_c);
+  for (long long i = 0; i < n; i++) {
+    const int32_t* info = &infos[size_t(i) * 4];  // (w, h, c, depth)
+    if (info[0] != c->img_w || info[1] != c->img_h || info[2] != c->img_c ||
+        info[3] != 8) {
+      return kColImgDims;
+    }
+  }
+  if (row_bytes == 0 || uint64_t(n) * row_bytes > c->out_cap) return kColBounds;
+  std::vector<void*> outs(un);
+  for (size_t i = 0; i < un; i++) outs[i] = c->out + uint64_t(i) * row_bytes;
+  const int threads = c->img_threads > 0 ? c->img_threads : 1;
+  if (decode(n, ptrs.data(), lens.data(), outs.data(), infos.data(), threads,
+             0, 0) != -1) {
+    return kColImgDecode;
+  }
+  c->aux0 = row_bytes;
+  c->out_used = uint64_t(n) * row_bytes;
+  return kColOk;
+}
+
+void decode_fused_column(FusedCol* c, int max_pages, ImgProbeFn probe,
+                         ImgDecodeFn decode) {
+  try {
+    if (c->chunk == nullptr || c->out == nullptr || c->expected_rows < 0) {
+      c->status = kColInternal;
+      return;
+    }
+    std::vector<PageRec> pages;
+    int rc = scan_fused_pages(*c, max_pages, &pages);
+    if (rc == kColOk) {
+      switch (c->mode) {
+        case kModeFixed: rc = decode_fixed(c, pages); break;
+        case kModeBinaryRaw: rc = decode_binary_raw(c, pages); break;
+        case kModeBinaryImg: rc = decode_binary_img(c, pages, probe, decode); break;
+        default: rc = kColInternal;
+      }
+    }
+    c->status = rc;
+  } catch (...) {  // bad_alloc etc.: fail the column, never the process
+    c->status = kColInternal;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Decode a whole batch of column chunks into their preallocated regions of
+// one contiguous batch buffer. Runs on up to `n_threads` C++ threads (the
+// calling thread participates); the caller holds no GIL (ctypes releases it),
+// so this is the single Python<->C transition of the batch. Returns the
+// number of columns whose status != OK (callers re-read those via Arrow), or
+// -1 on invalid arguments.
+long long pstpu_read_fused(struct FusedCol* cols, int n_cols, int n_threads,
+                           int max_pages, void* img_probe_fn, void* img_decode_fn) {
+  if (cols == nullptr || n_cols < 0 || max_pages < 1) {
+    set_error("pstpu_read_fused: invalid arguments");
+    return -1;
+  }
+  const ImgProbeFn probe = reinterpret_cast<ImgProbeFn>(img_probe_fn);
+  const ImgDecodeFn decode = reinterpret_cast<ImgDecodeFn>(img_decode_fn);
+  std::atomic<int> next{0};
+  auto run = [&]() {
+    while (true) {
+      const int i = next.fetch_add(1);
+      if (i >= n_cols) return;
+      decode_fused_column(&cols[i], max_pages, probe, decode);
+    }
+  };
+  int fanout = n_threads;
+  if (fanout < 1) fanout = 1;
+  if (fanout > n_cols) fanout = n_cols;
+  std::vector<std::thread> pool;
+  for (int t = 1; t < fanout; t++) pool.emplace_back(run);
+  run();
+  for (auto& th : pool) th.join();
+  long long failed = 0;
+  for (int i = 0; i < n_cols; i++) {
+    if (cols[i].status != kColOk) failed++;
+  }
+  return failed;
+}
+
+int pstpu_abi_version() { return 3; }
 
 }  // extern "C"

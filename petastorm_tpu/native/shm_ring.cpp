@@ -39,12 +39,24 @@ struct RingHeader {
 
 constexpr uint64_t kMagic = 0x70737470755F7268ULL;  // "pstpu_rh"
 
+// Length-prefix flag marking a PAD region (no payload): the producer's
+// in-place reservation needs a CONTIGUOUS slot, so when the next message
+// would wrap it first emits an 8-byte pad marker whose low bits hold the
+// number of dead bytes to skip; consumers jump over pads transparently.
+// Real message lengths are < 2^63, so the flag is unambiguous.
+constexpr uint64_t kPadFlag = 1ULL << 63;
+
 struct RingHandle {
   RingHeader* hdr;
   uint8_t* data;
   size_t map_len;
   std::string name;
   bool owner;
+  // producer-side pending in-place reservation (single producer: plain fields)
+  uint64_t pending_tail = 0;
+  uint64_t pending_pad = 0;   // pad marker + dead bytes emitted before the slot
+  uint64_t pending_max = 0;   // reserved payload capacity
+  bool pending = false;
 };
 
 thread_local std::string g_error;
@@ -232,12 +244,93 @@ int pstpu_ring_writev(void* h, const void* const* bufs, const uint64_t* lens, in
   return 1;
 }
 
+// Reserve a CONTIGUOUS writable region of up to max_len payload bytes inside
+// the ring (the in-place channel: a fused batch decode lands its rows
+// directly in the slot the consumer will map — the publish is then a header
+// write, not a copy). When the slot would wrap, a pad marker is staged first
+// so the payload starts at the ring's physical start. Nothing becomes visible
+// to the consumer until pstpu_ring_commit. Exactly one reservation may be
+// pending per ring (single producer). *status: 1 = reserved (returns the
+// payload pointer), 0 = not enough free space right now (retry), -1 = can
+// never fit / a reservation is already pending.
+void* pstpu_ring_reserve(void* h, uint64_t max_len, int32_t* status) {
+  auto* r = static_cast<RingHandle*>(h);
+  const uint64_t cap = r->hdr->capacity;
+  if (r->pending || max_len + 16 > cap) {  // worst case: pad marker + header
+    set_error(r->pending ? "a reservation is already pending"
+                         : "message larger than ring capacity");
+    if (status) *status = -1;
+    return nullptr;
+  }
+  const uint64_t head = r->hdr->head.load(std::memory_order_acquire);
+  const uint64_t tail = r->hdr->tail.load(std::memory_order_relaxed);
+  const uint64_t idx = tail % cap;
+  const uint64_t data_start = (idx + 8) % cap;
+  uint64_t pad = 0;
+  if (data_start + max_len > cap) {
+    // dead bytes from after the pad marker to the physical end; the real
+    // header then sits so its payload begins at index 0
+    pad = 8 + (cap - data_start);
+  }
+  if (cap - (tail - head) < pad + 8 + max_len) {
+    if (status) *status = 0;
+    return nullptr;
+  }
+  if (pad != 0) {
+    uint64_t marker = kPadFlag | (pad - 8);
+    copy_in(r, tail, reinterpret_cast<const uint8_t*>(&marker), 8);
+  }
+  r->pending = true;
+  r->pending_tail = tail;
+  r->pending_pad = pad;
+  r->pending_max = max_len;
+  if (status) *status = 1;
+  return r->data + ((tail + pad + 8) % cap);
+}
+
+// Publish a pending reservation with its actual payload length (<= the
+// reserved max). Returns 0, or -1 when no reservation is pending / the
+// length exceeds the reservation.
+int pstpu_ring_commit(void* h, uint64_t actual_len) {
+  auto* r = static_cast<RingHandle*>(h);
+  if (!r->pending || actual_len > r->pending_max) {
+    set_error(r->pending ? "commit exceeds reservation" : "no pending reservation");
+    return -1;
+  }
+  uint64_t len_le = actual_len;
+  copy_in(r, r->pending_tail + r->pending_pad,
+          reinterpret_cast<const uint8_t*>(&len_le), 8);
+  r->pending = false;
+  r->hdr->tail.store(r->pending_tail + r->pending_pad + 8 + actual_len,
+                     std::memory_order_release);
+  return 0;
+}
+
+// Drop a pending reservation; nothing was ever visible to the consumer.
+void pstpu_ring_abort(void* h) {
+  static_cast<RingHandle*>(h)->pending = false;
+}
+
+// Skip any pad markers at the head; returns the head position of the next
+// real message, or UINT64_MAX when the readable region is empty.
+static uint64_t skip_pads(RingHandle* r) {
+  const uint64_t tail = r->hdr->tail.load(std::memory_order_acquire);
+  uint64_t head = r->hdr->head.load(std::memory_order_relaxed);
+  while (head != tail) {
+    uint64_t len_le = 0;
+    copy_out(r, head, reinterpret_cast<uint8_t*>(&len_le), 8);
+    if (!(len_le & kPadFlag)) return head;
+    head += 8 + (len_le & ~kPadFlag);
+    r->hdr->head.store(head, std::memory_order_release);
+  }
+  return UINT64_MAX;
+}
+
 // Length of the next unread message, or -1 when the ring is empty.
 int64_t pstpu_ring_next_len(void* h) {
   auto* r = static_cast<RingHandle*>(h);
-  const uint64_t tail = r->hdr->tail.load(std::memory_order_acquire);
-  const uint64_t head = r->hdr->head.load(std::memory_order_relaxed);
-  if (tail == head) return -1;
+  const uint64_t head = skip_pads(r);
+  if (head == UINT64_MAX) return -1;
   uint64_t len_le = 0;
   copy_out(r, head, reinterpret_cast<uint8_t*>(&len_le), 8);
   return static_cast<int64_t>(len_le);
@@ -247,9 +340,8 @@ int64_t pstpu_ring_next_len(void* h) {
 // is too small (message left in place; call pstpu_ring_next_len first).
 int64_t pstpu_ring_read(void* h, void* buf, uint64_t buf_cap) {
   auto* r = static_cast<RingHandle*>(h);
-  const uint64_t tail = r->hdr->tail.load(std::memory_order_acquire);
-  const uint64_t head = r->hdr->head.load(std::memory_order_relaxed);
-  if (tail == head) return -1;
+  const uint64_t head = skip_pads(r);
+  if (head == UINT64_MAX) return -1;
   uint64_t len_le = 0;
   copy_out(r, head, reinterpret_cast<uint8_t*>(&len_le), 8);
   if (len_le > buf_cap) return -2;

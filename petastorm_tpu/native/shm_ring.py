@@ -55,6 +55,12 @@ def _load_library():
                                           ctypes.POINTER(ctypes.c_void_p),
                                           ctypes.POINTER(ctypes.c_uint64),
                                           ctypes.c_int32]
+        lib.pstpu_ring_reserve.restype = ctypes.c_void_p
+        lib.pstpu_ring_reserve.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                                           ctypes.POINTER(ctypes.c_int32)]
+        lib.pstpu_ring_commit.restype = ctypes.c_int
+        lib.pstpu_ring_commit.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.pstpu_ring_abort.argtypes = [ctypes.c_void_p]
         lib.pstpu_ring_next_len.restype = ctypes.c_int64
         lib.pstpu_ring_next_len.argtypes = [ctypes.c_void_p]
         lib.pstpu_ring_read.restype = ctypes.c_int64
@@ -182,6 +188,51 @@ class ShmRing(object):
             if stop_check is not None and stop_check():
                 return False
             time.sleep(poll_s)
+
+    def try_reserve(self, max_len):
+        """Reserve a CONTIGUOUS writable in-ring region of up to ``max_len``
+        payload bytes — the in-place publish channel: a fused batch decode
+        assembles its rows directly in the slot the consumer maps, and
+        :meth:`commit` makes it visible with a header write instead of a copy.
+        Returns a writable memoryview of exactly ``max_len`` bytes, or None
+        when the ring currently lacks space (retry); raises ValueError when a
+        message of that size can never fit (callers use the copy channel).
+        Exactly one reservation may be pending; :meth:`commit` or
+        :meth:`abort` resolves it before any other write."""
+        status = ctypes.c_int32(0)
+        ptr = self._lib.pstpu_ring_reserve(self._handle, max_len,
+                                           ctypes.byref(status))
+        if status.value < 0:
+            raise ValueError(
+                'reservation of {} bytes cannot fit ring capacity {} — increase the '
+                'process pool ring_bytes (or shrink row groups)'.format(
+                    max_len, self.capacity))
+        if not ptr:
+            return None
+        # the view aliases ring shared memory; the ring handle (held by the
+        # worker for the pool's lifetime) anchors the mapping
+        return memoryview((ctypes.c_char * max_len).from_address(ptr)).cast('B')  # noqa: PT500 - producer-side slot, ring outlives it
+
+    def reserve(self, max_len, stop_check=None, poll_s=0.0002):
+        """Blocking :meth:`try_reserve` with a stop-aware poll loop (the same
+        contract as :meth:`write`); returns None when stopped."""
+        while True:
+            mv = self.try_reserve(max_len)
+            if mv is not None:
+                return mv
+            if stop_check is not None and stop_check():
+                return None
+            time.sleep(poll_s)
+
+    def commit(self, actual_len):
+        """Publish the pending reservation with its actual message length."""
+        if self._lib.pstpu_ring_commit(self._handle, actual_len) != 0:
+            raise ValueError('ring commit failed: {}'.format(
+                self._lib.pstpu_ring_last_error().decode()))
+
+    def abort(self):
+        """Drop the pending reservation (nothing became visible)."""
+        self._lib.pstpu_ring_abort(self._handle)
 
     def has_message(self):
         """True when a committed message is waiting. NON-consuming probe

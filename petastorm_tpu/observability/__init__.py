@@ -40,7 +40,8 @@ from petastorm_tpu.observability.exporters import (JsonlExporter,  # noqa: F401
                                                    to_prometheus_text, write_prometheus)
 from petastorm_tpu.observability.metrics import (counters_on, flatten_snapshot,  # noqa: F401
                                                  get_registry, merge_snapshots, spans_on)
-from petastorm_tpu.observability.report import format_stall_report, stall_report  # noqa: F401
+from petastorm_tpu.observability.report import (decode_collate_share,  # noqa: F401
+                                                format_stall_report, stall_report)
 from petastorm_tpu.observability.trace import (chrome_trace, export_chrome_trace,  # noqa: F401
                                                get_ring, instant, span)
 
@@ -188,7 +189,8 @@ def absorb_trace_events(events):
 __all__ = [
     'JsonlExporter', 'TelemetryConfig', 'absorb_trace_events', 'add_seconds',
     'chrome_trace', 'configure', 'count', 'counters_on', 'current_config',
-    'drain_trace_events', 'export_chrome_trace', 'flatten_snapshot',
+    'decode_collate_share', 'drain_trace_events', 'export_chrome_trace',
+    'flatten_snapshot',
     'format_stall_report', 'gauge_set', 'get_registry', 'get_ring', 'instant',
     'merge_snapshots', 'observe', 'resolve_telemetry', 'snapshot', 'span',
     'spans_on', 'stage', 'stall_report', 'to_prometheus_text',

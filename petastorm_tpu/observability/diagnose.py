@@ -46,6 +46,39 @@ def diagnose(dataset_url, batch_size=64, batches=50, pool_type='thread',
         return obs.stall_report(diag), diag
 
 
+def fused_fallback_table(diagnostics):
+    """``{column: {reason: count}}`` parsed from the labelled
+    ``fused_fallback_column:<col>:<reason>`` counters — the per-column answer
+    to "why is this column still on the Arrow path" (docs/native.md lists the
+    reason catalog). Empty when every requested column fused (or the store
+    predates the counters)."""
+    table = {}
+    for key, value in diagnostics.items():
+        if not key.startswith('fused_fallback_column:'):
+            continue
+        try:
+            _prefix, column, reason = key.split(':', 2)
+        except ValueError:
+            continue
+        table.setdefault(column, {})[reason] = int(value)
+    return table
+
+
+def format_fused_fallbacks(diagnostics):
+    """Human-readable per-column fallback section (empty string when every
+    column rode the fused/zero-copy native path)."""
+    table = fused_fallback_table(diagnostics)
+    if not table:
+        return ''
+    lines = ['fused-decode fallbacks (column -> reason x count; see '
+             'docs/native.md for the reason catalog):']
+    for column in sorted(table):
+        reasons = ', '.join('{} x{}'.format(r, c)
+                            for r, c in sorted(table[column].items()))
+        lines.append('  {:<24s} {}'.format(column, reasons))
+    return '\n'.join(lines)
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(
         prog='petastorm-tpu-diagnose',
@@ -75,9 +108,13 @@ def main(argv=None):
                             use_batch_reader=args.batch_reader)
     if args.as_json:
         print(json.dumps({'stall_report': report,
+                          'fused_fallbacks': fused_fallback_table(diag),
                           'diagnostics': {k: v for k, v in sorted(diag.items())}}))
     else:
         print(obs.format_stall_report(report))
+        fallbacks = format_fused_fallbacks(diag)
+        if fallbacks:
+            print(fallbacks)
         print('diagnostics:')
         for key in sorted(diag):
             print('  {} = {}'.format(key, diag[key]))

@@ -28,14 +28,18 @@ from __future__ import annotations
 
 #: worker-side stage timers split proportionally under the pool wait, in
 #: display order. 'read_io' is derived: stage_read_s minus the nested
-#: stage_chunk_fetch_s.
-_WORKER_STAGES = ('read_io', 'chunk_fetch', 'decode', 'transform')
+#: stage_chunk_fetch_s. 'fused_decode' is the single-transition native
+#: read→decode→collate pass (docs/native.md) — its seconds INCLUDE the page
+#: faults of cold chunks, so on cold storage it partially overlaps what
+#: read_io would have shown.
+_WORKER_STAGES = ('read_io', 'chunk_fetch', 'fused_decode', 'decode', 'transform')
 
 #: stage -> one-line remedy, surfaced next to the named bottleneck
 _HINTS = {
     'worker.read_io': 'storage-bound: enable chunk_cache for remote stores, or add IO parallelism (workers_count)',
     'worker.chunk_fetch': 'cold chunk mirror: warm the cache (epoch 2+ reads locally) or raise prefetch_budget',
-    'worker.decode': 'decode-bound: more workers/cores, batched TransformSpec, image_decode_hints, or a RawTensorCodec store',
+    'worker.fused_decode': 'fused native decode dominates: add cores/workers — the pass is already one GIL-released call per batch (docs/native.md)',
+    'worker.decode': 'decode-bound: more workers/cores, batched TransformSpec, image_decode_hints, or a RawTensorCodec store; check fused_fallback_reason:* counters for columns off the fused path',
     'worker.transform': 'transform-bound: vectorize with TransformSpec(batched=True)',
     'consumer.assembly': 'consumer-side slicing/rebatch: prefer output=columnar and larger batches',
     'pool.unattributed': 'workers idle or untimed: check ventilator starvation (items_in_flight) and results_queue_depth',
@@ -63,6 +67,7 @@ def stall_report(diagnostics):
     busy = {
         'read_io': max(read - chunk_fetch, 0.0),
         'chunk_fetch': chunk_fetch,
+        'fused_decode': float(diagnostics.get('stage_fused_decode_s', 0.0) or 0.0),
         'decode': float(diagnostics.get('stage_decode_s', 0.0) or 0.0),
         'transform': float(diagnostics.get('stage_transform_s', 0.0) or 0.0),
     }
@@ -102,6 +107,24 @@ def stall_report(diagnostics):
         'worker_busy_s': {k: round(v, 4) for k, v in busy.items()},
         'recovery': recovery,
     }
+
+
+def decode_collate_share(diagnostics):
+    """The tentpole metric of the fused native path, machine-checkable from a
+    diagnostics/flattened-snapshot mapping: Python decode + collate busy
+    seconds as a fraction of pool wait (``None`` when nothing was timed).
+    The fused pass itself is reported alongside (``fused_decode_share``) —
+    it is GIL-released native work that replaces read+decode together, not a
+    Python tail — so the pair shows WHERE the decode seconds went, not just
+    that they left."""
+    pool_wait = float(diagnostics.get('stage_pool_wait_s', 0.0) or 0.0)
+    if pool_wait <= 0:
+        return None
+    tail = (float(diagnostics.get('stage_decode_s', 0.0) or 0.0) +
+            float(diagnostics.get('stage_collate_s', 0.0) or 0.0))
+    fused = float(diagnostics.get('stage_fused_decode_s', 0.0) or 0.0)
+    return {'decode_collate_share': round(tail / pool_wait, 4),
+            'fused_decode_share': round(fused / pool_wait, 4)}
 
 
 def format_stall_report(report):

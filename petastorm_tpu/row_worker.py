@@ -20,16 +20,20 @@ row transforms and NGram window assembly.
 from __future__ import annotations
 
 import hashlib
+import logging
 from collections import deque
 
 import numpy as np
 
 from petastorm_tpu import observability as obs
+from petastorm_tpu.cache import NullCache
 from petastorm_tpu.columnar import (BlockResultsReaderBase, block_num_rows, block_to_rows,
                                     column_cells, rows_to_block, stack_cells, take_block)
 from petastorm_tpu.native import open_parquet
 from petastorm_tpu.predicates import evaluate_predicate_mask
 from petastorm_tpu.workers.worker_base import WorkerBase
+
+logger = logging.getLogger(__name__)
 
 
 def _cache_key(dataset_path, piece, column_names, decode_hints=None, resize_hints=None):
@@ -113,6 +117,12 @@ class RowGroupDecoderWorker(WorkerBase):
 
         cache = args['cache']
         if worker_predicate is None and shuffle_row_drop_partition is None:
+            if (args['transform_spec'] is None and ngram is None
+                    and isinstance(cache, NullCache)
+                    and self._publish_fused_inplace(piece, needed)):
+                # the whole batch was decoded straight into the shm-ring slot
+                # the consumer maps; the publish was a header write
+                return
             key = _cache_key(args['dataset_path'], piece, needed,
                              getattr(args['transform_spec'], 'image_decode_hints', None),
                              getattr(args['transform_spec'], 'image_resize', None))
@@ -182,38 +192,51 @@ class RowGroupDecoderWorker(WorkerBase):
                 table = table.take(row_indices)
         return table, num_rows
 
-    def _decode_table(self, table, column_names, piece):
-        """Arrow table -> column block. Per column: the codec's whole-column
-        fast path when it has one, else per-cell decode + stack. Partition-key
-        columns are materialized from the piece's path."""
+    def _decode_table(self, table, column_names, piece, pre=None):
+        """Arrow table -> column block. Columns already decoded by the fused
+        native pass (``pre``) are adopted as-is; the rest go through the
+        codec's whole-column fast path when it has one, else per-cell decode +
+        stack. Partition-key columns are materialized from the piece's path.
+        ``table`` may be None when ``pre`` covers every physical column."""
         schema = self.args['schema']
         transform = self.args.get('transform_spec')
         decode_hints = getattr(transform, 'image_decode_hints', None) or {}
         resize_hints = getattr(transform, 'image_resize', None) or {}
-        n = table.num_rows
+        pre = pre or {}
+        n = table.num_rows if table is not None else block_num_rows(pre)
         block = {}
         with obs.stage('decode', cat='worker', rows=n):
             self._decode_columns(table, column_names, piece, block,
-                                 schema, decode_hints, resize_hints, transform, n)
+                                 schema, decode_hints, resize_hints, transform, n,
+                                 pre)
         return block
 
+    def _partition_column(self, field, value, n):
+        """One partition-key column materialized from the piece's path value.
+        np.full types the column from the decoded scalar (int64/str/bool...)
+        so partition labels stage to device like any other column
+        (batch_worker.py does the same for plain stores)."""
+        if field is not None and field.codec is not None:
+            value = field.codec.decode(field, value)
+        try:
+            return np.full(n, value)
+        except (ValueError, TypeError):
+            col = np.empty(n, dtype=object)
+            col[:] = value
+            return col
+
     def _decode_columns(self, table, column_names, piece, block, schema,
-                        decode_hints, resize_hints, transform, n):
+                        decode_hints, resize_hints, transform, n, pre=None):
+        pre = pre or {}
         for name in column_names:
+            if name in pre:
+                # fused-decoded columns are fresh writable batch-buffer views:
+                # the decode()'s writable-array contract holds with no copy
+                block[name] = pre[name]
+                continue
             if name in piece.partition_keys:
-                field = schema.fields.get(name)
-                value = piece.partition_keys[name]
-                if field is not None and field.codec is not None:
-                    value = field.codec.decode(field, value)
-                # np.full types the column from the decoded scalar (int64/str/
-                # bool...) so partition labels stage to device like any other
-                # column (batch_worker.py does the same for plain stores)
-                try:
-                    block[name] = np.full(n, value)
-                except (ValueError, TypeError):
-                    col = np.empty(n, dtype=object)
-                    col[:] = value
-                    block[name] = col
+                block[name] = self._partition_column(
+                    schema.fields.get(name), piece.partition_keys[name], n)
                 continue
             field = schema.fields[name]
             codec = field.codec
@@ -246,6 +269,111 @@ class RowGroupDecoderWorker(WorkerBase):
             block[name] = decoded
         return block
 
+    def _fused_columns(self, piece, column_names):
+        """``{name: decoded numpy column}`` for the subset served by the fused
+        native read→decode→collate pass (one GIL-released call for the whole
+        subset — ``docs/native.md``); ``{}`` when nothing qualifies. Columns
+        the zero-copy view path already serves stay with it."""
+        pf = self._parquet_file(piece.path)
+        if not hasattr(pf, 'read_fused'):
+            return {}
+        schema = self.args['schema']
+        transform = self.args.get('transform_spec')
+        physical = [c for c in column_names if c not in piece.partition_keys
+                    and c in schema.fields]
+        if not physical:
+            return {}
+        try:
+            block, _rest = pf.read_fused(
+                piece.row_group, physical, schema.fields,
+                getattr(transform, 'image_decode_hints', None),
+                getattr(transform, 'image_resize', None))
+        except Exception as e:  # noqa: BLE001 - any surprise: Arrow path serves it all
+            logger.debug('fused read of %s rg=%s failed (%s); Arrow path',
+                         piece.path, piece.row_group, e)
+            return {}
+        return block
+
+    def _publish_fused_inplace(self, piece, column_names):
+        """shm-ring in-place mode: reserve the ring slot the consumer will
+        map, frame the serializer header first (every fused column's size is
+        known ahead), run the fused decode WRITING DIRECTLY INTO THE SLOT,
+        and publish with a header write — no batch copy anywhere between the
+        Parquet pages and the consumer's numpy views. Returns False (leaving
+        no observable effect) whenever any precondition fails; the caller
+        then takes the ordinary load-and-publish path."""
+        reserve = getattr(self.publish_func, 'reserve_block', None)
+        pf = self._parquet_file(piece.path) if reserve is not None else None
+        if pf is None or not hasattr(pf, 'fused_plan'):
+            return False
+        schema = self.args['schema']
+        transform = self.args.get('transform_spec')
+        physical = [c for c in column_names if c not in piece.partition_keys
+                    and c in schema.fields]
+        if not physical:
+            return False
+        plan = pf.fused_plan(piece.row_group, physical, schema.fields,
+                             getattr(transform, 'image_decode_hints', None),
+                             getattr(transform, 'image_resize', None),
+                             include_pagescan=True)
+        if plan is None or plan.rest or not plan.columns or not plan.inplace_ok:
+            return False
+        if any(p.field_dtype is not None and p.field_dtype != p.out_dtype
+               for p in plan.columns):
+            return False  # a post-decode astype would need a second buffer
+        n = plan.expected_rows
+        if n <= 0:
+            return False
+        part_cols = []
+        for name in column_names:
+            if name not in piece.partition_keys:
+                continue
+            col = self._partition_column(schema.fields.get(name),
+                                         piece.partition_keys[name], n)
+            if col.dtype == object or col.dtype.hasobject:
+                return False  # object columns cannot frame as raw buffers
+            part_cols.append((name, np.ascontiguousarray(col)))
+        meta, offsets, total = [], [], 0
+        for p in plan.columns:
+            meta.append((p.name, p.out_dtype.str, p.out_shape, None))
+            offsets.append(total)
+            total += p.out_bound
+        for name, col in part_cols:
+            meta.append((name, col.dtype.str, col.shape, None))
+        payload = total + sum(col.nbytes for _, col in part_cols)
+        reserved = reserve(meta, payload)
+        if reserved is None:
+            return False
+        view, commit, abort = reserved
+        try:
+            results = pf.fused_read_into(plan, view, offsets)
+        except Exception as e:  # noqa: BLE001 - kernel refusal: copy path serves it
+            logger.debug('in-place fused read failed (%s); copy path', e)
+            abort()
+            return False
+        from petastorm_tpu.native import fused
+        failed = {plan.columns[i].name: fused.REASON_BY_STATUS.get(r[0], 'internal')
+                  for i, r in enumerate(results)
+                  if r[0] != 0 or r[1] != plan.columns[i].out_bound}
+        if failed:
+            abort()
+            fused.count_fallbacks(failed)
+            return False
+        out = np.frombuffer(view, dtype=np.uint8)  # noqa: PT500 - writable ring slot owned by this reservation
+        off = total
+        for _name, col in part_cols:
+            out[off:off + col.nbytes] = np.frombuffer(
+                col.tobytes() if col.dtype.kind in 'Mm' else memoryview(col).cast('B'),
+                dtype=np.uint8)
+            off += col.nbytes
+        commit(payload)
+        obs.count('fused_columns_total', len(plan.columns))
+        obs.count('fused_batches_total')
+        obs.count('fused_inplace_batches_total')
+        obs.count('worker_rows_decoded_total', n)
+        fused.count_fallbacks(plan.reasons)
+        return True
+
     def _load_block(self, piece, column_names, shuffle_row_drop_partition=None):
         indices = None
         if shuffle_row_drop_partition is not None:
@@ -253,8 +381,17 @@ class RowGroupDecoderWorker(WorkerBase):
             num_rows = piece.num_rows or pf.metadata.row_group(piece.row_group).num_rows
             indices = select_row_drop_indices(num_rows, shuffle_row_drop_partition,
                                               self.args['ngram'])
-        table, _ = self._read_table(piece, column_names, indices)
-        return self._decode_table(table, column_names, piece)
+        # row subsets (shuffle-row-drop) need Arrow's take; the full-group read
+        # serves fused columns first and Arrow only for the remainder
+        pre = self._fused_columns(piece, column_names) if indices is None else {}
+        rest = [c for c in column_names if c not in pre]
+        schema = self.args['schema']
+        if pre and not any(c not in piece.partition_keys and c in schema.fields
+                           for c in rest):
+            table = None  # every physical column came out of the fused pass
+        else:
+            table, _ = self._read_table(piece, rest, indices)
+        return self._decode_table(table, column_names, piece, pre=pre)
 
     def _load_block_with_predicate(self, piece, column_names, predicate,
                                    shuffle_row_drop_partition):

@@ -143,6 +143,22 @@ class NumpyBlockSerializer(object):
         return [b''.join((self._BLOCK, struct.pack('<I', len(header)), header))] + buffers
 
     @classmethod
+    def frame_for_layout(cls, meta):
+        """Framing prefix (marker + header) for a block whose column layout is
+        known AHEAD of decode — the in-place ring channel writes this before
+        the payload bytes exist, then the fused native decode lands the rows
+        directly after it. ``meta`` entries are the ``(name, dtype_str, shape,
+        ragged_shapes)`` tuples of :meth:`_split_block`; the resulting message
+        bytes are identical to :meth:`serialize` output for the same block, so
+        :meth:`deserialize` cannot tell the channels apart. Returns None for
+        layouts the raw-buffer framing cannot carry."""
+        try:
+            header = pickle.dumps((list(meta), {}), protocol=pickle.HIGHEST_PROTOCOL)
+        except Exception:  # noqa: BLE001 - unpicklable layout: copy path
+            return None
+        return b''.join((cls._BLOCK, struct.pack('<I', len(header)), header))
+
+    @classmethod
     def parts_size(cls, parts):
         return sum(p.nbytes if isinstance(p, np.ndarray) else len(p) for p in parts)
 

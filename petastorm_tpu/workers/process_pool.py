@@ -1184,6 +1184,36 @@ def _worker_bootstrap(worker_id, main_pid, setup_blob, vent_addr, result_addr, c
         send(MSG_BLOB, current['seq'], path.encode())
         return True
 
+    def reserve_block(meta_entries, payload_max):
+        """In-place publish channel (docs/native.md): reserve a CONTIGUOUS
+        ring slot, frame the serializer header for a column layout known
+        AHEAD of decode, and hand the payload region back so the fused
+        native decode assembles the batch directly in the memory the
+        consumer maps — the publish is then a header write, not a copy.
+        Returns ``(payload_view, commit, abort)`` or None when the transport
+        or serializer cannot serve it (callers use the copy path)."""
+        if ring is None or not hasattr(serializer, 'frame_for_layout'):
+            return None
+        prefix = serializer.frame_for_layout(meta_entries)
+        if prefix is None:
+            return None
+        header = ring_header(MSG_DATA, current['seq'])
+        total = len(header) + len(prefix) + payload_max
+        try:
+            mv = ring.reserve(total, stop_check=check_finished)
+        except ValueError:
+            return None  # can never fit this ring: blob/in-band path instead
+        if mv is None:
+            return None  # shutdown while waiting for space
+        base = len(header) + len(prefix)
+        mv[:len(header)] = header
+        mv[len(header):base] = prefix
+
+        def commit(actual_payload=payload_max):
+            ring.commit(base + actual_payload)
+
+        return mv[base:], commit, ring.abort
+
     def publish(data):
         # The payload is classified/framed ONCE (serialize_parts); every
         # channel consumes the same parts list. Routing: sub-blob-threshold
@@ -1208,6 +1238,10 @@ def _worker_bootstrap(worker_id, main_pid, setup_blob, vent_addr, result_addr, c
             send(MSG_DATA, current['seq'], serializer.join_parts(parts))
             return
         send(MSG_DATA, current['seq'], serializer.serialize(data))
+
+    # workers probe this attribute for the fused in-place mode; non-ring
+    # transports simply leave it returning None from the ring check above
+    publish.reserve_block = reserve_block
 
     def flush_telemetry():
         """Ship this process's cumulative metrics snapshot (and drained trace

@@ -1,0 +1,581 @@
+"""Fused native read→decode→collate (native/fused.py + pstpu_read_fused).
+
+Pins the tentpole contracts of the fused batch path:
+
+* **bit-exact parity** with the Arrow path across every supported physical
+  type (INT32/INT64/FLOAT/DOUBLE/FLBA), PLAIN and dictionary/RLE encodings,
+  UNCOMPRESSED and SNAPPY chunks, proven-null-free nullable chunks, np.save
+  (NdarrayCodec) cells and image-codec columns;
+* **one GIL transition per batch** on the fully-fused path (counted via an
+  instrumented stub around the single ctypes entry point);
+* **loud, labelled fallbacks** — every disqualified column gets a reason
+  counter (incl. the ``_MAX_PAGES`` page-cap edge, which used to fall back
+  silently);
+* **robustness** — seeded (and hypothesis-gated, when available) fuzz of the
+  page-header/RLE/snappy parsers: truncated/malformed/adversarial bytes must
+  return the error sentinel, never crash or over-read;
+* the **shm-ring in-place mode**: reserve/commit/abort semantics, pad-marker
+  wrapping, and an end-to-end process-pool read whose batches are assembled
+  directly in the ring slots.
+"""
+
+import ctypes
+import os
+import struct
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from petastorm_tpu import make_batch_reader, make_reader
+from petastorm_tpu import observability as obs
+from petastorm_tpu.codecs import (CompressedImageCodec, NdarrayCodec, RawTensorCodec,
+                                  ScalarCodec)
+from petastorm_tpu.etl.dataset_metadata import write_petastorm_dataset
+from petastorm_tpu.unischema import Unischema, UnischemaField
+
+native = pytest.importorskip('petastorm_tpu.native')
+from petastorm_tpu.native import fused, pagescan  # noqa: E402
+
+pytestmark = pytest.mark.skipif(not native.is_available(),
+                                reason='native kernel unavailable')
+
+
+def _counters():
+    return obs.snapshot().get('counters', {})
+
+
+def _parquet_path(root):
+    return str(next(p for p in root.iterdir() if p.suffix == '.parquet'))
+
+
+# ---------------------------------------------------------------------------
+# parity: fixed-width scalars, every physical type, PLAIN + dictionary,
+# UNCOMPRESSED + SNAPPY
+# ---------------------------------------------------------------------------
+
+_SCALAR_DTYPES = (np.int32, np.int64, np.float32, np.float64)
+
+
+def _scalar_schema():
+    return Unischema('S', [
+        UnischemaField('c_{}'.format(np.dtype(dt).name), dt, (), ScalarCodec(dt), False)
+        for dt in _SCALAR_DTYPES])
+
+
+def _write_scalar_store(tmp_path, compression, repeated):
+    """``repeated`` makes values low-cardinality so the dictionary encoder
+    keeps the chunk dict-encoded with long RLE runs; unique-ish values give
+    bit-packed index groups — both hybrid flavors get exercised."""
+    schema = _scalar_schema()
+    url = 'file://' + str(tmp_path / 'store')
+    rows = []
+    for i in range(64):
+        v = (i % 4) if repeated else i * 7 + 1
+        rows.append({'c_{}'.format(np.dtype(dt).name): np.dtype(dt).type(v)
+                     for dt in _SCALAR_DTYPES})
+    write_petastorm_dataset(url, schema, iter(rows), rows_per_row_group=16,
+                            compression=compression)
+    return url, schema, rows
+
+
+@pytest.mark.parametrize('compression', ['snappy', 'none'])
+@pytest.mark.parametrize('repeated', [True, False], ids=['rle-runs', 'bit-packed'])
+def test_scalar_parity_all_types(tmp_path, compression, repeated):
+    url, schema, rows = _write_scalar_store(tmp_path, compression, repeated)
+    path = _parquet_path(tmp_path / 'store')
+    md = pq.read_metadata(path)
+    # the writer dictionary-encodes scalar columns of non-raw stores
+    assert md.row_group(0).column(0).has_dictionary_page
+    pf = native.NativeParquetFile(path)
+    cols = list(schema.fields)
+    for rg in range(md.num_row_groups):
+        block, rest = pf.read_fused(rg, cols, schema.fields)
+        if compression == 'none' and not md.row_group(rg).column(0).has_dictionary_page:
+            continue  # plain uncompressed chunks stay with the view path
+        assert rest == [], rest
+        table = pf.read_row_group(rg, columns=cols)
+        for name in cols:
+            ref = table.column(name).to_numpy()
+            assert block[name].dtype == np.dtype(schema.fields[name].numpy_dtype)
+            np.testing.assert_array_equal(block[name], ref)
+
+
+def test_flba_snappy_parity(tmp_path):
+    """RawTensorCodec FLBA chunks ride the fused path when snappy-compressed
+    (uncompressed PLAIN chunks keep the zero-copy view path)."""
+    schema = Unischema('R', [
+        UnischemaField('t', np.float32, (3, 4), RawTensorCodec(), False),
+        UnischemaField('i', np.int64, (), ScalarCodec(np.int64), False),
+    ])
+    url = 'file://' + str(tmp_path / 'store')
+    rng = np.random.default_rng(1)
+    rows = [{'t': rng.random((3, 4)).astype(np.float32), 'i': i} for i in range(20)]
+    # explicit per-column dict: the writer would otherwise honor the codec's
+    # 'none' preference and keep the FLBA chunk on the zero-copy view path
+    write_petastorm_dataset(url, schema, iter(rows), rows_per_row_group=5,
+                            compression={'t': 'snappy', 'i': 'snappy'})
+    path = _parquet_path(tmp_path / 'store')
+    assert pq.read_metadata(path).row_group(0).column(0).compression == 'SNAPPY'
+    pf = native.NativeParquetFile(path)
+    block, rest = pf.read_fused(0, ['t', 'i'], schema.fields)
+    assert 't' in block
+    for r, got in zip(rows[:5], block['t']):
+        np.testing.assert_array_equal(got, r['t'])
+    assert block['t'].flags.writeable
+
+
+def test_nullable_proven_null_free_fused(tmp_path):
+    """OPTIONAL chunks whose statistics PROVE null_count == 0 fuse (the RLE
+    def-levels block is skipped natively); chunks with a real null fall back
+    with reason 'nullable'."""
+    schema = Unischema('N', [
+        UnischemaField('x', np.float32, (4,), RawTensorCodec(), True),
+        UnischemaField('id', np.int64, (), ScalarCodec(np.int64), False),
+    ])
+    url = 'file://' + str(tmp_path / 'store')
+    rows = [{'x': np.arange(4, dtype=np.float32) + i, 'id': i} for i in range(6)]
+    write_petastorm_dataset(url, schema, iter(rows), rows_per_row_group=3,
+                            compression={'x': 'snappy', 'id': 'snappy'})
+    path = _parquet_path(tmp_path / 'store')
+    pf = native.NativeParquetFile(path)
+    block, rest = pf.read_fused(0, ['x', 'id'], schema.fields)
+    assert 'x' in block
+    np.testing.assert_array_equal(block['x'][2], rows[2]['x'])
+
+    url2 = 'file://' + str(tmp_path / 'nulls')
+    rows2 = [{'x': None if i == 1 else np.arange(4, dtype=np.float32), 'id': i}
+             for i in range(6)]
+    write_petastorm_dataset(url2, schema, iter(rows2), rows_per_row_group=3,
+                            compression={'x': 'snappy', 'id': 'snappy'})
+    pf2 = native.NativeParquetFile(_parquet_path(tmp_path / 'nulls'))
+    plan = pf2.fused_plan(0, ['x'], schema.fields)
+    assert plan.reasons.get('x') == 'nullable'
+
+
+def test_ndarray_npy_cells_parity(tmp_path):
+    url = 'file://' + str(tmp_path / 'store')
+    schema = Unischema('A', [
+        UnischemaField('a', np.uint8, (None, 6), NdarrayCodec(), False),
+        UnischemaField('id', np.int64, (), ScalarCodec(np.int64), False),
+    ])
+    rng = np.random.default_rng(2)
+    rows = [{'a': rng.integers(0, 255, (5, 6), np.uint8), 'id': i} for i in range(12)]
+    write_petastorm_dataset(url, schema, iter(rows), rows_per_row_group=4)
+    pf = native.NativeParquetFile(_parquet_path(tmp_path / 'store'))
+    block, rest = pf.read_fused(0, ['a', 'id'], schema.fields)
+    assert 'a' in block and block['a'].shape == (4, 5, 6)
+    for r, got in zip(rows[:4], block['a']):
+        np.testing.assert_array_equal(got, r['a'])
+    assert block['a'].flags.writeable  # NdarrayCodec's writable-decode contract
+
+
+def test_ragged_npy_cells_fall_back_correctly(tmp_path):
+    """Cells with differing shapes inside one row group are non-uniform: the
+    fused pass must refuse (status 'nonuniform') and the reader must still
+    produce correct rows through the Arrow path."""
+    url = 'file://' + str(tmp_path / 'store')
+    schema = Unischema('A', [
+        UnischemaField('a', np.uint8, (None, 2), NdarrayCodec(), False),
+        UnischemaField('id', np.int64, (), ScalarCodec(np.int64), False),
+    ])
+    rows = [{'a': np.full((1 + i % 3, 2), i, np.uint8), 'id': i} for i in range(6)]
+    write_petastorm_dataset(url, schema, iter(rows), rows_per_row_group=6)
+    with make_reader(url, reader_pool_type='dummy', shuffle_row_groups=False) as r:
+        got = {int(row.id): row.a for row in r}
+    for row in rows:
+        np.testing.assert_array_equal(got[row['id']], row['a'])
+
+
+def test_image_column_fused_parity(tmp_path):
+    pytest.importorskip('cv2')
+    from petastorm_tpu.native import image_codec
+    if not image_codec.is_available():
+        pytest.skip('native image codec unavailable')
+    schema = Unischema('I', [
+        UnischemaField('img', np.uint8, (8, 10, 3), CompressedImageCodec('png'), False),
+        UnischemaField('id', np.int32, (), ScalarCodec(), False),
+    ])
+    url = 'file://' + str(tmp_path / 'store')
+    rng = np.random.default_rng(3)
+    rows = [{'img': rng.integers(0, 255, (8, 10, 3), np.uint8), 'id': i}
+            for i in range(10)]
+    write_petastorm_dataset(url, schema, iter(rows), rows_per_row_group=5)
+    pf = native.NativeParquetFile(_parquet_path(tmp_path / 'store'))
+    block, rest = pf.read_fused(0, ['img', 'id'], schema.fields)
+    assert 'img' in block and block['img'].shape == (5, 8, 10, 3)
+    for r, got in zip(rows[:5], block['img']):
+        np.testing.assert_array_equal(got, r['img'])  # png is lossless
+
+
+def test_batch_reader_numeric_fused_respects_logical_types(tmp_path):
+    """Plain-store fusing is codec-agnostic: numerics fuse with their logical
+    dtype recovered (narrow/unsigned INT annotations), annotated flavors
+    (timestamps) stay on the Arrow path."""
+    path = tmp_path / 'plain'
+    path.mkdir()
+    table = pa.table({
+        'i64': pa.array(np.arange(40, dtype=np.int64)),
+        'u8': pa.array(np.arange(40, dtype=np.uint8)),
+        'ts': pa.array(np.arange(40, dtype=np.int64), pa.timestamp('us')),
+    })
+    pq.write_table(table, str(path / 'f.parquet'), compression='snappy',
+                   use_dictionary=['i64', 'u8', 'ts'])
+    with make_batch_reader('file://' + str(path), reader_pool_type='dummy',
+                           shuffle_row_groups=False) as reader:
+        batch = next(reader)
+    assert batch.i64.dtype == np.int64 and batch.i64.tolist() == list(range(40))
+    assert batch.u8.dtype == np.uint8 and batch.u8.tolist() == list(range(40))
+    assert np.issubdtype(batch.ts.dtype, np.datetime64)
+
+
+# ---------------------------------------------------------------------------
+# one GIL transition per batch
+# ---------------------------------------------------------------------------
+
+def test_one_gil_transition_per_fused_batch(tmp_path, monkeypatch):
+    url, schema, rows = _write_scalar_store(tmp_path, 'snappy', repeated=True)
+    pf = native.NativeParquetFile(_parquet_path(tmp_path / 'store'))
+    cols = list(schema.fields)
+    calls = []
+    real = fused._invoke_read_fused
+
+    def counting(*a):
+        calls.append(a)
+        return real(*a)
+
+    monkeypatch.setattr(fused, '_invoke_read_fused', counting)
+    scans = []
+    monkeypatch.setattr(pagescan, '_scan_chunk',
+                        lambda *a, **k: (scans.append(1), None)[1])
+    block, rest = pf.read_fused(0, cols, schema.fields)
+    assert rest == [] and set(block) == set(cols)
+    assert len(calls) == 1  # ONE native transition for the whole batch
+    assert not scans        # and no per-column page-scan calls on the side
+
+
+# ---------------------------------------------------------------------------
+# fallback attribution
+# ---------------------------------------------------------------------------
+
+def test_unsupported_compression_reason_counted(tmp_path):
+    path = tmp_path / 'gz'
+    path.mkdir()
+    table = pa.table({'x': pa.array(np.arange(10, dtype=np.int64))})
+    pq.write_table(table, str(path / 'f.parquet'), compression='gzip',
+                   use_dictionary=['x'])
+    obs.get_registry().reset()
+    pf = native.NativeParquetFile(str(path / 'f.parquet'))
+    block, rest = pf.read_fused(0, ['x'], None)
+    assert block == {} and rest == ['x']
+    counters = _counters()
+    assert counters.get('fused_fallback_reason:compression', 0) >= 1
+    assert counters.get('fused_fallback_column:x:compression', 0) >= 1
+
+
+def test_fused_fallback_table_rendering():
+    from petastorm_tpu.observability.diagnose import (format_fused_fallbacks,
+                                                      fused_fallback_table)
+    diag = {'fused_fallback_column:a:compression': 3,
+            'fused_fallback_column:b:nullable': 1,
+            'unrelated': 7}
+    table = fused_fallback_table(diag)
+    assert table == {'a': {'compression': 3}, 'b': {'nullable': 1}}
+    text = format_fused_fallbacks(diag)
+    assert 'compression x3' in text and 'b' in text
+    assert format_fused_fallbacks({'other': 1}) == ''
+
+
+def test_decode_collate_share_helper():
+    share = obs.decode_collate_share({'stage_pool_wait_s': 10.0,
+                                      'stage_decode_s': 0.5,
+                                      'stage_collate_s': 0.3,
+                                      'stage_fused_decode_s': 2.0})
+    assert share == {'decode_collate_share': 0.08, 'fused_decode_share': 0.2}
+    assert obs.decode_collate_share({}) is None
+
+
+# ---------------------------------------------------------------------------
+# _MAX_PAGES: loud fallback
+# ---------------------------------------------------------------------------
+
+def _tvarint(v):
+    out = bytearray()
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _tzigzag(v):
+    return _tvarint((v << 1) ^ (v >> 63))
+
+
+def _plain_page(num_values, itemsize=8, value=0):
+    """One handwritten v1 PLAIN data page (thrift compact header + values)."""
+    values = struct.pack('<q', value)[:itemsize] * num_values
+    dph = (bytes([0x15]) + _tzigzag(num_values)   # 1: num_values
+           + bytes([0x15]) + _tzigzag(0)          # 2: encoding PLAIN
+           + bytes([0x15]) + _tzigzag(3)          # 3: def-levels RLE
+           + bytes([0x15]) + _tzigzag(3)          # 4: rep-levels RLE
+           + b'\x00')
+    header = (bytes([0x15]) + _tzigzag(0)                  # 1: type DATA_PAGE
+              + bytes([0x15]) + _tzigzag(len(values))      # 2: uncompressed
+              + bytes([0x15]) + _tzigzag(len(values))      # 3: compressed
+              + bytes([0x2C]) + dph                        # 5: DataPageHeader
+              + b'\x00')
+    return header + values
+
+
+def test_page_cap_overflow_is_loud(monkeypatch):
+    import types
+    chunk = np.frombuffer(_plain_page(2) * 3, dtype=np.uint8)
+    meta = types.SimpleNamespace(data_page_offset=0,
+                                 total_compressed_size=chunk.size,
+                                 path_in_schema='x')
+    lib = native._load_library()
+    obs.get_registry().reset()
+    monkeypatch.setattr(pagescan, '_MAX_PAGES', 2)
+    monkeypatch.setattr(pagescan, '_page_cap_warned', False)
+    assert pagescan._scan_chunk(lib, chunk, meta) is None
+    assert _counters().get('pagescan_fallback_reason:page-cap', 0) == 1
+    # a 2-page chunk under the same cap still scans
+    ok = np.frombuffer(_plain_page(2) * 2, dtype=np.uint8)
+    meta.total_compressed_size = ok.size
+    assert pagescan._scan_chunk(lib, ok, meta) is not None
+
+
+def test_handwritten_pages_decode_through_fused():
+    """The thrift builder used by the fuzzers must itself be valid input."""
+    chunk = np.frombuffer(_plain_page(3, value=7) * 2, dtype=np.uint8)
+    plan = fused.ColumnPlan('x')
+    plan.itemsize = 8
+    plan.phys_dtype = np.dtype(np.int64)
+    plan.out_dtype = np.dtype(np.int64)
+    plan.out_shape = (6,)
+    plan.chunk_len = chunk.size
+    plan.out_bound = 6 * 8
+    out = np.empty(48, np.uint8)
+    lib = native._load_library()
+    (res,) = fused.read_into(lib, [chunk], [plan], 6, out, [0])
+    assert res[0] == 0
+    np.testing.assert_array_equal(np.frombuffer(out, np.int64), np.full(6, 7))
+
+
+# ---------------------------------------------------------------------------
+# robustness / fuzz: malformed bytes must return the sentinel, never crash
+# ---------------------------------------------------------------------------
+
+def _fuzz_one(lib, data):
+    chunk = np.frombuffer(bytes(data), dtype=np.uint8) if len(data) else \
+        np.zeros(1, np.uint8)[:0]
+    # page scanner
+    offs = (ctypes.c_ulonglong * 16)()
+    counts = (ctypes.c_longlong * 16)()
+    vlens = (ctypes.c_ulonglong * 16)()
+    for has_def in (0, 1):
+        n = lib.pstpu_scan_plain_pages(
+            chunk.ctypes.data_as(ctypes.c_void_p), chunk.size, offs, counts,
+            vlens, 16, has_def)
+        assert -1 <= n <= 16
+    # fused kernel, every mode x codec
+    for mode, codec in ((0, 0), (0, 1), (1, 0), (1, 1)):
+        plan = fused.ColumnPlan('f')
+        plan.mode = mode
+        plan.codec = codec
+        plan.itemsize = 8
+        plan.strip_npy = mode == 1
+        plan.out_dtype = np.dtype(np.int64)
+        plan.out_shape = (4,)
+        plan.chunk_len = chunk.size
+        plan.out_bound = 64
+        out = np.zeros(64, np.uint8)
+        if chunk.size == 0:
+            continue
+        (res,) = fused.read_into(lib, [chunk], [plan], 4, out, [0])
+        assert res[0] in fused.REASON_BY_STATUS or res[0] == 0
+
+
+def test_fuzz_page_parsers_seeded():
+    lib = native._load_library()
+    rng = np.random.default_rng(0xF05ED)
+    valid = bytearray(_plain_page(4) * 2)
+    for _ in range(150):
+        data = bytearray(valid)
+        for _ in range(rng.integers(1, 8)):
+            op = rng.integers(0, 3)
+            if op == 0 and len(data) > 1:           # mutate
+                data[rng.integers(0, len(data))] = rng.integers(0, 256)
+            elif op == 1 and len(data) > 2:         # truncate
+                del data[int(rng.integers(1, len(data))):]
+            else:                                    # splice random bytes
+                data += bytes(rng.integers(0, 256, rng.integers(1, 32),
+                                           dtype=np.uint8))
+        _fuzz_one(lib, data)
+    for _ in range(60):  # pure garbage
+        _fuzz_one(lib, bytes(rng.integers(0, 256, rng.integers(0, 96),
+                                          dtype=np.uint8)))
+
+
+def test_fuzz_snappy_and_hybrid_hypothesis():
+    hypothesis = pytest.importorskip('hypothesis')
+    from hypothesis import strategies as st
+    lib = native._load_library()
+
+    @hypothesis.settings(max_examples=120, deadline=None)
+    @hypothesis.given(st.binary(max_size=160))
+    def run(data):
+        _fuzz_one(lib, data)
+
+    run()
+
+
+# ---------------------------------------------------------------------------
+# shm-ring reserve/commit (the in-place channel)
+# ---------------------------------------------------------------------------
+
+def _ring(name, capacity=4096):
+    from petastorm_tpu.native import shm_ring
+    if not shm_ring.is_available():
+        pytest.skip('shm ring unavailable')
+    return shm_ring.ShmRing.create('/pstpu_test_{}_{}'.format(name, os.getpid()),
+                                   capacity)
+
+
+def test_ring_reserve_commit_roundtrip_with_wraps():
+    r = _ring('rsv')
+    try:
+        for i in range(60):
+            payload = bytes([i % 251]) * (i * 37 % 900 + 10)
+            mv = r.try_reserve(len(payload))
+            assert mv is not None
+            mv[:len(payload)] = payload
+            r.commit(len(payload))
+            assert r.try_read() == payload
+    finally:
+        r.close()
+
+
+def test_ring_reserve_interleaves_with_writev():
+    r = _ring('mix')
+    try:
+        for i in range(60):
+            if i % 2:
+                assert r.try_write(b'x' * ((i * 53) % 1000 + 5))
+                assert r.try_read() is not None
+            else:
+                n = (i * 91) % 1000 + 5
+                mv = r.try_reserve(n)
+                mv[:n] = bytes([7]) * n
+                r.commit(n)
+                assert r.try_read() == bytes([7]) * n
+    finally:
+        r.close()
+
+
+def test_ring_reserve_abort_and_short_commit():
+    r = _ring('abort')
+    try:
+        r.try_reserve(100)
+        r.abort()
+        assert r.try_read() is None and not r.has_message()
+        mv = r.try_reserve(500)
+        mv[:10] = b'ABCDEFGHIJ'
+        r.commit(10)  # commit fewer bytes than reserved
+        assert r.try_read() == b'ABCDEFGHIJ'
+        with pytest.raises(ValueError):
+            r.try_reserve(5000)  # can never fit
+    finally:
+        r.close()
+
+
+def test_serializer_frame_for_layout_matches_serialize():
+    from petastorm_tpu.serializers import NumpyBlockSerializer
+    s = NumpyBlockSerializer()
+    block = {'a': np.arange(12, dtype=np.int64).reshape(3, 4),
+             'b': np.arange(3, dtype=np.float32)}
+    meta = [('a', block['a'].dtype.str, block['a'].shape, None),
+            ('b', block['b'].dtype.str, block['b'].shape, None)]
+    prefix = s.frame_for_layout(meta)
+    wire = prefix + memoryview(block['a']).cast('B') + memoryview(block['b']).cast('B')
+    assert bytes(wire) == bytes(s.serialize(block))
+    out = s.deserialize(bytearray(wire))
+    np.testing.assert_array_equal(out['a'], block['a'])
+    np.testing.assert_array_equal(out['b'], block['b'])
+
+
+def test_process_pool_inplace_fused_publish(tmp_path):
+    """End-to-end: a fixed-layout store through the process pool assembles
+    its batches IN the ring slots (fused_inplace_batches_total > 0) and the
+    consumer sees bit-exact writable blocks."""
+    from petastorm_tpu.native import shm_ring
+    if not shm_ring.is_available():
+        pytest.skip('shm ring unavailable')
+    schema = Unischema('R', [
+        UnischemaField('image', np.uint8, (16, 16, 3), RawTensorCodec(), False),
+        UnischemaField('label', np.int64, (), ScalarCodec(np.int64), False),
+    ])
+    url = 'file://' + str(tmp_path / 'raw')
+    rng = np.random.default_rng(0)
+    data = [{'image': rng.integers(0, 255, (16, 16, 3), np.uint8), 'label': i}
+            for i in range(40)]
+    write_petastorm_dataset(url, schema, iter(data), rows_per_row_group=8,
+                            compression='none')
+    obs.configure('counters')
+    with make_reader(url, reader_pool_type='process', workers_count=1,
+                     output='columnar', shuffle_row_groups=False,
+                     num_epochs=1, telemetry='counters') as reader:
+        blocks = list(reader)
+        diag = reader.diagnostics
+    assert diag.get('fused_inplace_batches_total', 0) >= 1
+    labels = [int(v) for b in blocks for v in np.asarray(b.label)]
+    assert labels == list(range(40))
+    for b in blocks:
+        img = np.asarray(b.image)
+        assert img.flags.writeable
+        for row_img, lab in zip(img, np.asarray(b.label)):
+            np.testing.assert_array_equal(row_img, data[int(lab)]['image'])
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: the bench-shaped store rides fully fused with zero fallbacks
+# ---------------------------------------------------------------------------
+
+def test_hello_world_shaped_store_fully_fused(tmp_path):
+    pytest.importorskip('cv2')
+    from petastorm_tpu.native import image_codec
+    if not image_codec.is_available():
+        pytest.skip('native image codec unavailable')
+    schema = Unischema('H', [
+        UnischemaField('id', np.int32, (), ScalarCodec(), False),
+        UnischemaField('image1', np.uint8, (16, 24, 3), CompressedImageCodec('png'), False),
+        UnischemaField('array_4d', np.uint8, (None, 4, 5, None), NdarrayCodec(), False),
+    ])
+    url = 'file://' + str(tmp_path / 'hw')
+    rng = np.random.default_rng(42)
+    rows = [{'id': i,
+             'image1': rng.integers(0, 255, (16, 24, 3), np.uint8),
+             'array_4d': rng.integers(0, 255, (2, 4, 5, 3), np.uint8)}
+            for i in range(30)]
+    write_petastorm_dataset(url, schema, iter(rows), rows_per_row_group=10)
+    obs.get_registry().reset()
+    obs.configure('counters')
+    with make_reader(url, reader_pool_type='thread', workers_count=2,
+                     shuffle_row_groups=False, num_epochs=1) as reader:
+        got = {int(r.id): r for r in reader}
+    assert len(got) == 30
+    for r in rows:
+        np.testing.assert_array_equal(got[r['id']].image1, r['image1'])
+        np.testing.assert_array_equal(got[r['id']].array_4d, r['array_4d'])
+    counters = _counters()
+    # the acceptance contract: previously Arrow-only encodings (the
+    # dictionary-encoded id column, the snappy npy cells) ride the native
+    # path with their fallback counters at ZERO
+    assert counters.get('fused_batches_total', 0) >= 3
+    assert counters.get('fused_columns_total', 0) >= 9
+    assert not any(k.startswith('fused_fallback') for k in counters), counters

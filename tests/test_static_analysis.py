@@ -689,6 +689,42 @@ int caller(int x) { return helper(x); }
     assert list(NativeBufferChecker().check(src)) == []
 
 
+def test_pt503_pointer_from_temporary_flagged():
+    # the fused-ABI lifetime defect: np.empty(...).ctypes.data dies before
+    # the kernel dereferences it
+    code = '''
+    import numpy as np
+
+    def call(lib, n):
+        lib.pstpu_read_fused(np.empty(n).ctypes.data, n)
+    '''
+    assert _codes(NativeBufferChecker(), code,
+                  relpath='native/fused.py') == ['PT503']
+
+
+def test_pt503_descriptor_pointer_without_capacity_flagged():
+    code = '''
+    def fill(desc, buf):
+        desc.out = buf.ctypes.data
+        desc.chunk = buf.ctypes.data
+        desc.chunk_len = buf.nbytes
+    '''
+    # .out set without .out_cap -> one finding; .chunk has its .chunk_len
+    assert _codes(NativeBufferChecker(), code,
+                  relpath='native/fused.py') == ['PT503']
+
+
+def test_pt503_anchored_pointer_with_bounds_passes():
+    code = '''
+    def fill(desc, buf):
+        desc.out = buf.ctypes.data
+        desc.out_cap = buf.nbytes
+        desc.chunk = buf.ctypes.data
+        desc.chunk_len = buf.nbytes
+    '''
+    assert _codes(NativeBufferChecker(), code, relpath='native/fused.py') == []
+
+
 # ---------------------------------------------------------------------------
 # PT600 hashability
 # ---------------------------------------------------------------------------

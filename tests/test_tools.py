@@ -312,5 +312,6 @@ def test_reader_throughput_jax_method_columnar(synthetic_dataset):
     report = res.extra['stall_report']
     assert report['coverage'] >= 0.9
     assert set(report['stages']) <= {'worker.read_io', 'worker.chunk_fetch',
-                                     'worker.decode', 'worker.transform',
-                                     'consumer.assembly', 'pool.unattributed'}
+                                     'worker.fused_decode', 'worker.decode',
+                                     'worker.transform', 'consumer.assembly',
+                                     'pool.unattributed'}
