@@ -431,6 +431,10 @@ def read_into(lib, chunks, plans, expected_rows, out_buf, offsets):
         probe_addr, decode_addr = addrs
     for i, p in enumerate(plans):
         d = descs[i]
+        # always appended, even for prechecked-out columns, so aux_bufs stays
+        # index-aligned with descs when results are gathered below
+        aux = np.zeros(_AUX_BYTES, dtype=np.uint8)
+        aux_bufs.append(aux)
         chunk = chunks[i]
         if chunk is None or chunk.nbytes != p.chunk_len \
                 or offsets[i] + p.out_bound > total:
@@ -441,8 +445,6 @@ def read_into(lib, chunks, plans, expected_rows, out_buf, offsets):
         d.chunk_len = p.chunk_len
         d.out = base.ctypes.data + offsets[i]
         d.out_cap = p.out_bound
-        aux = np.zeros(_AUX_BYTES, dtype=np.uint8)
-        aux_bufs.append(aux)
         d.aux_buf = aux.ctypes.data
         d.aux_cap = aux.nbytes
         d.expected_rows = expected_rows

@@ -607,8 +607,10 @@ bool decode_hybrid(const uint8_t* p, const uint8_t* end, int bw, int64_t count,
     if (header & 1) {  // bit-packed: (header>>1) groups of 8 values
       const uint64_t groups = header >> 1;
       if (groups == 0) return false;
+      // division form: groups * bw would wrap for a corrupt huge group count,
+      // sneaking a tiny nbytes past the bounds check below
+      if (groups > uint64_t(end - p) / uint64_t(bw)) return false;
       const uint64_t nbytes = groups * uint64_t(bw);
-      if (uint64_t(end - p) < nbytes) return false;
       const uint64_t take = std::min<uint64_t>(groups * 8, remaining);
       uint64_t bit = 0;
       for (uint64_t i = 0; i < take; i++) {
@@ -790,7 +792,8 @@ int decode_fixed(FusedCol* c, const std::vector<PageRec>& pages) {
     if (pg.is_dict) {
       int rc = page_values(*c, pg, &dict_store, &vals, &vlen);
       if (rc != kColOk) return rc;
-      if (uint64_t(pg.num_values) * w > vlen) return kColDict;
+      // division form: num_values * w would wrap for a corrupt huge count
+      if (uint64_t(pg.num_values) > vlen / w) return kColDict;
       if (c->codec == kCodecUncompressed) {
         // values point into the chunk; keep them there (no copy needed)
         dict_vals = vals;
@@ -802,6 +805,7 @@ int decode_fixed(FusedCol* c, const std::vector<PageRec>& pages) {
     }
     int rc = page_values(*c, pg, &scratch, &vals, &vlen);
     if (rc != kColOk) return rc;
+    if (uint64_t(pg.num_values) > c->out_cap / w) return kColBounds;
     const uint64_t need = uint64_t(pg.num_values) * w;
     if (written + need > c->out_cap) return kColBounds;
     if (pg.encoding == 0) {  // PLAIN: the values region IS the rows

@@ -272,6 +272,14 @@ void* pstpu_ring_reserve(void* h, uint64_t max_len, int32_t* status) {
     // header then sits so its payload begins at index 0
     pad = 8 + (cap - data_start);
   }
+  if (pad + 8 + max_len > cap) {
+    // wrapping at this tail position costs more than the ring holds: a drained
+    // ring would still never fit it, so retrying is a livelock — fail so the
+    // caller takes the copy channel (single producer: tail can't move under us)
+    set_error("message larger than ring capacity");
+    if (status) *status = -1;
+    return nullptr;
+  }
   if (cap - (tail - head) < pad + 8 + max_len) {
     if (status) *status = 0;
     return nullptr;

@@ -316,11 +316,12 @@ def _tzigzag(v):
     return _tvarint((v << 1) ^ (v >> 63))
 
 
-def _plain_page(num_values, itemsize=8, value=0):
-    """One handwritten v1 PLAIN data page (thrift compact header + values)."""
-    values = struct.pack('<q', value)[:itemsize] * num_values
+def _plain_page(num_values, itemsize=8, value=0, values=None, encoding=0):
+    """One handwritten v1 data page (thrift compact header + values)."""
+    if values is None:
+        values = struct.pack('<q', value)[:itemsize] * num_values
     dph = (bytes([0x15]) + _tzigzag(num_values)   # 1: num_values
-           + bytes([0x15]) + _tzigzag(0)          # 2: encoding PLAIN
+           + bytes([0x15]) + _tzigzag(encoding)   # 2: encoding
            + bytes([0x15]) + _tzigzag(3)          # 3: def-levels RLE
            + bytes([0x15]) + _tzigzag(3)          # 4: rep-levels RLE
            + b'\x00')
@@ -328,6 +329,19 @@ def _plain_page(num_values, itemsize=8, value=0):
               + bytes([0x15]) + _tzigzag(len(values))      # 2: uncompressed
               + bytes([0x15]) + _tzigzag(len(values))      # 3: compressed
               + bytes([0x2C]) + dph                        # 5: DataPageHeader
+              + b'\x00')
+    return header + values
+
+
+def _dict_page(num_values, values):
+    """One handwritten v1 DICTIONARY page declaring ``num_values`` entries."""
+    header = (bytes([0x15]) + _tzigzag(2)              # 1: type DICTIONARY_PAGE
+              + bytes([0x15]) + _tzigzag(len(values))  # 2: uncompressed
+              + bytes([0x15]) + _tzigzag(len(values))  # 3: compressed
+              + bytes([0x4C])                          # 7: DictionaryPageHeader
+              + bytes([0x15]) + _tzigzag(num_values)   #   1: num_values
+              + bytes([0x15]) + _tzigzag(0)            #   2: encoding PLAIN
+              + b'\x00'
               + b'\x00')
     return header + values
 
@@ -365,6 +379,63 @@ def test_handwritten_pages_decode_through_fused():
     (res,) = fused.read_into(lib, [chunk], [plan], 6, out, [0])
     assert res[0] == 0
     np.testing.assert_array_equal(np.frombuffer(out, np.int64), np.full(6, 7))
+
+
+def test_dict_declared_count_overflow_rejected():
+    """A corrupt dictionary page declaring 2**61 entries used to wrap the
+    ``num_values * itemsize`` bounds product to 0, so any 32-bit index passed
+    the ``k < n_dict`` guard and the per-row copy read far outside the real
+    8-byte dictionary (regression: the check is division-based now)."""
+    dict_vals = struct.pack('<q', 42)                    # ONE real entry
+    idx = bytes([8]) + _tvarint(4 << 1) + bytes([200])   # RLE run: 4 × index 200
+    chunk = np.frombuffer(_dict_page(1 << 61, dict_vals)
+                          + _plain_page(4, values=idx, encoding=2),
+                          dtype=np.uint8)
+    plan = fused.ColumnPlan('x')
+    plan.itemsize = 8
+    plan.phys_dtype = np.dtype(np.int64)
+    plan.out_dtype = np.dtype(np.int64)
+    plan.out_shape = (4,)
+    plan.chunk_len = chunk.size
+    plan.out_bound = 4 * 8
+    out = np.zeros(32, np.uint8)
+    lib = native._load_library()
+    (res,) = fused.read_into(lib, [chunk], [plan], 4, out, [0])
+    assert res[0] == 9  # kColDict: rejected, never dereferenced
+
+
+def test_precheck_failed_column_keeps_aux_alignment():
+    """A column failing the read_into precheck (stale metadata) must not shift
+    later columns' aux buffers: the npy header of a strip-npy column was read
+    at the wrong index (silent wrong dtype) or raised IndexError, which
+    upstream turned into discarding the whole fused batch."""
+    import io
+    cells = []
+    for i in range(2):
+        buf = io.BytesIO()
+        np.save(buf, np.arange(3, dtype=np.int64) + i)
+        cells.append(buf.getvalue())
+    values = b''.join(struct.pack('<I', len(c)) + c for c in cells)
+    chunk = np.frombuffer(_plain_page(2, values=values), dtype=np.uint8)
+    payload = 3 * 8
+    bad = fused.ColumnPlan('bad')
+    bad.chunk_len = chunk.size + 1   # precheck: stale metadata, never decoded
+    bad.out_bound = 16
+    good = fused.ColumnPlan('good')
+    good.mode = fused.MODE_BINARY_RAW
+    good.strip_npy = True
+    good.chunk_len = chunk.size
+    good.out_bound = 2 * payload
+    out = np.zeros(16 + 2 * payload, np.uint8)
+    lib = native._load_library()
+    res = fused.read_into(lib, [chunk, chunk], [bad, good], 2, out, [0, 16])
+    assert res[0][0] != 0
+    status, out_used, _aux0, aux1, header = res[1]
+    assert status == 0 and out_used == 2 * payload
+    assert aux1 > 0 and header == cells[0][:aux1]  # col 1's OWN npy header
+    np.testing.assert_array_equal(
+        np.frombuffer(out[16:16 + 2 * payload].tobytes(), np.int64),
+        np.concatenate([np.arange(3), np.arange(3) + 1]))
 
 
 # ---------------------------------------------------------------------------
@@ -490,6 +561,29 @@ def test_ring_reserve_abort_and_short_commit():
         assert r.try_read() == b'ABCDEFGHIJ'
         with pytest.raises(ValueError):
             r.try_reserve(5000)  # can never fit
+    finally:
+        r.close()
+
+
+def test_ring_reserve_wrap_never_fits_raises():
+    """max_len alone fits the ring, but at a tail position where wrapping is
+    required, pad + header + payload exceeds capacity — even a fully drained
+    ring can never satisfy it. reserve must fail loudly (callers fall back to
+    the copy channel) instead of returning retry and polling forever."""
+    r = _ring('nofit')  # capacity 4096
+    try:
+        # advance the tail to 2000 and drain: the region before the physical
+        # end is too small for the payload, and the wrap pad (~2096 bytes)
+        # plus header plus payload overflows capacity
+        assert r.try_write(b'x' * 1992)
+        assert r.try_read() is not None
+        with pytest.raises(ValueError):
+            r.try_reserve(3000)
+        # no pad marker leaked; smaller reservations still work at this tail
+        mv = r.try_reserve(100)
+        mv[:3] = b'abc'
+        r.commit(3)
+        assert r.try_read() == b'abc'
     finally:
         r.close()
 
