@@ -403,6 +403,12 @@ def main(argv=None):
                              'measured run (docs/robustness.md): the headline rate '
                              'then includes recovery overhead, and the output '
                              'carries the recovery counters')
+    parser.add_argument('--autotune', action='store_true',
+                        help='additionally run the closed-loop convergence probe '
+                             '(docs/autotune.md): a deliberately mis-configured '
+                             'reader (1 worker) once as-is and once under '
+                             'autotune=True; the output records both rates and '
+                             'the decision trajectory')
     parser.add_argument('--protocol-monitor', action='store_true',
                         help='attach the worker-pool protocol conformance monitor '
                              '(docs/protocol.md) to every measured reader: a chaos '
@@ -484,6 +490,8 @@ def main(argv=None):
 
     decode_shares = _decode_collate_section()
 
+    autotune = _autotune_section(url, headline_rate=value) if args.autotune else None
+
     duty = _duty_section(tpu_seen_early=tpu_seen_early)
 
     if args.trace_out:
@@ -514,8 +522,63 @@ def main(argv=None):
         'decode_collate_share': (decode_shares or {}).get('decode_collate_share'),
         'fused_decode_share': (decode_shares or {}).get('fused_decode_share'),
         'duty': duty,
+        'autotune': autotune,
         'chaos': _chaos_section() if args.chaos else None,
     }))
+
+
+def _autotune_section(url, headline_rate):
+    """The closed-loop convergence probe: the hello-world bench with a
+    deliberately mis-configured reader (1 worker instead of the hand-tuned 3),
+    measured once as-is and once under autotune=True — the controller must
+    claw back most of the hand-tuned rate, and the decision trajectory that
+    did it is recorded (docs/autotune.md)."""
+    import functools
+
+    from petastorm_tpu import make_reader
+    from petastorm_tpu.autotune import AutotuneConfig
+    from petastorm_tpu.tools.throughput import reader_throughput
+
+    def one(autotune):
+        readers = []
+
+        def mk(*a, **k):
+            reader = make_reader(*a, seed=0, autotune=autotune, **k)
+            readers.append(reader)
+            return reader
+
+        rate = reader_throughput(url, warmup_cycles=100, measure_cycles=8000,
+                                 pool_type='thread', workers_count=1,
+                                 shuffle_row_groups=True, read_method='python',
+                                 make_reader_fn=mk).samples_per_second
+        return rate, readers
+
+    try:
+        mis_rate, _ = one(None)
+        cfg = AutotuneConfig(interval_s=0.4, cooldown_s=0.5, stall_threshold=0.1,
+                             max_workers=3)
+        tuned_rate, readers = one(cfg)
+        tuner = readers[-1].autotuner
+        decisions = tuner.decision_records() if tuner is not None else []
+        workers_final = tuner.proposal().get('workers_count') if tuner else None
+    except Exception as e:  # noqa: BLE001 - the probe must never sink the headline capture
+        section = {'metric': 'autotune_convergence', 'error': str(e)}
+        print(json.dumps(section), flush=True)
+        return {'error': str(e)}
+    section = {
+        'metric': 'autotune_convergence',
+        'misconfigured_rate': round(mis_rate, 2),
+        'autotuned_rate': round(tuned_rate, 2),
+        'recovered_fraction_of_headline': round(tuned_rate / headline_rate, 3)
+        if headline_rate else None,
+        'speedup_over_misconfigured': round(tuned_rate / mis_rate, 3)
+        if mis_rate else None,
+        'workers_start': 1,
+        'workers_final': workers_final,
+        'decisions': decisions,
+    }
+    print(json.dumps(section), flush=True)
+    return {k: v for k, v in section.items() if k != 'metric'}
 
 
 def _decode_collate_section():

@@ -9,6 +9,7 @@ Top-level API mirrors the reference (petastorm/__init__.py:15-19):
 ``make_reader``, ``make_batch_reader``, ``TransformSpec``, ``NoDataAvailableError``.
 """
 
+from petastorm_tpu.autotune import AutotuneConfig  # noqa: F401
 from petastorm_tpu.errors import NoDataAvailableError  # noqa: F401
 from petastorm_tpu.transform import TransformSpec  # noqa: F401
 

@@ -33,6 +33,11 @@ Rule families (see ``docs/analysis.md`` for bad/good examples):
   ``BaseException``/``KeyboardInterrupt`` without re-raising, forwarding the
   exception object, or exiting the process: eaten cancellation wedges the
   pool in ways supervision cannot detect.
+* **PT702** autotune action discipline — knob actuations in
+  ``petastorm_tpu/autotune/`` must sit inside a ``decision_span`` (every
+  change leaves an explainable ``autotune.decision`` event) and pass their
+  values through ``clamp()`` (no knob write can escape the config's
+  explicit bounds).
 * **PT800/PT801** worker-pool protocol discipline — consumer switches over
   results-channel message kinds must cover every kind declared in
   ``workers/protocol.MESSAGE_KINDS`` (or carry an else); protocol
@@ -48,6 +53,7 @@ line; absorb pre-existing findings with an ``analysis_baseline.json`` (see
 
 from __future__ import annotations
 
+from petastorm_tpu.analysis.autotune_lints import AutotuneActionChecker
 from petastorm_tpu.analysis.buffers import NativeBufferChecker
 from petastorm_tpu.analysis.core import (Baseline, Checker, Finding, SourceFile,
                                          collect_sources, load_baseline, run_checkers)
@@ -70,6 +76,7 @@ ALL_CHECKERS = (
     HashabilityChecker,
     TelemetrySpanChecker,
     BaseExceptionContainmentChecker,
+    AutotuneActionChecker,
     ProtocolLintChecker,
 )
 
@@ -98,7 +105,8 @@ def run_analysis(paths, baseline=None, select=None, ignore=None):
 
 
 __all__ = [
-    'ALL_CHECKERS', 'Baseline', 'BaseExceptionContainmentChecker', 'Checker',
+    'ALL_CHECKERS', 'AutotuneActionChecker', 'Baseline',
+    'BaseExceptionContainmentChecker', 'Checker',
     'ExceptionHygieneChecker', 'Finding',
     'HashabilityChecker', 'JaxPurityChecker', 'LockDisciplineChecker',
     'NativeBufferChecker', 'ProtocolLintChecker', 'ResourceLifecycleChecker',

@@ -1,0 +1,40 @@
+"""Closed-loop autotuning: the stall report turns the knobs itself.
+
+PR 3 gave the pipeline eyes — per-stage metrics and an input-stall report
+that names the bottleneck — but a human still read the report and re-ran
+with different ``workers_count`` / prefetch / shuffle settings. This package
+closes the loop:
+
+* :class:`~petastorm_tpu.autotune.controller.Autotuner` — a feedback
+  controller that watches **windowed** telemetry history
+  (``observability/history.py``) and adjusts, at runtime: the supervised
+  worker pool (grow a fresh slot / retire an idle one through the existing
+  supervision machinery), the chunk-store prefetch in-flight byte budget,
+  and the loader's shuffle-buffer capacity;
+* :class:`~petastorm_tpu.autotune.controller.AutotuneConfig` — explicit
+  per-knob ``[min, max]`` bounds, cadence, and the hysteresis stack
+  (cooldown / reverse-cooldown / reversal freeze) that keeps alternating
+  bottlenecks from thrashing a knob;
+* every change is **explainable**: an ``autotune.decision`` span in the
+  trace ring plus a structured JSONL decision-log record carrying the
+  evidence window (lint rule PT702 statically rejects an unwrapped or
+  unclamped knob write in this package);
+* ``petastorm-tpu-autotune`` (:mod:`petastorm_tpu.autotune.cli`) — offline
+  mode: replay a recorded history (or Chrome trace) through the identical
+  decision path against simulated knobs and print a proposed config without
+  running the pipeline.
+
+Enable with ``make_reader(..., autotune=True)`` (or an
+:class:`AutotuneConfig`); ``JaxDataLoader`` attaches itself automatically so
+the controller sees the consumer-side wait signal. The default is OFF and
+costs nothing: no recorder, no thread, no snapshots. See ``docs/autotune.md``.
+"""
+
+from __future__ import annotations
+
+from petastorm_tpu.autotune.controller import (AutotuneConfig, Autotuner,  # noqa: F401
+                                               DecisionLog, clamp, decision_span,
+                                               resolve_autotune)
+
+__all__ = ['AutotuneConfig', 'Autotuner', 'DecisionLog', 'clamp',
+           'decision_span', 'resolve_autotune']

@@ -160,14 +160,32 @@ class ChunkCachedParquetFile(object):
         return out
 
     def chunk_plan(self, row_group, column_names=None):
-        """[(key, length, fetch_fn)] for the qualifying chunks of a row group —
-        the prefetcher's work list."""
+        """[(key, length, fetch_fn)] for the cacheable chunks of a row group —
+        the prefetcher's work list. Covers BOTH mirror-served decode paths:
+        view-qualified chunks (zero-copy page scan) and fused-qualified
+        chunks (dictionary/RLE/snappy decoded by ``pstpu_read_fused`` from
+        the same mirror, docs/native.md) — since PR 6 made fused chunks
+        cacheable, a prefetcher that walked only the view-qualified set left
+        exactly the dict/snappy columns to demand-fetch in front of decode.
+        Fetches land through the store's ``for_prefetch`` path, so they count
+        under the existing ``chunk_cache_prefetch_*`` counters the autotuner's
+        prefetch knob watches."""
         names = column_names if column_names is not None else list(self._flat_index)
-        plan = []
+        plan, seen = [], set()
         for _name, _col, _schema_col, _qual, start, length in \
                 self._qualifying(row_group, names):
-            plan.append((self._chunk_key(start, length), length,
-                         self._range_fetcher(start, length)))
+            key = self._chunk_key(start, length)
+            seen.add(key)
+            plan.append((key, length, self._range_fetcher(start, length)))
+        fused = self.fused_plan(row_group, tuple(names))
+        if fused is not None:
+            for col in fused.columns:
+                key = self._chunk_key(col.chunk_off, col.chunk_len)
+                if key in seen:
+                    continue
+                seen.add(key)
+                plan.append((key, col.chunk_len,
+                             self._range_fetcher(col.chunk_off, col.chunk_len)))
         return plan
 
     def _range_fetcher(self, offset, length):

@@ -103,6 +103,16 @@ class ChunkCacheConfig(object):
         self.prefetch_budget_bytes = prefetch_budget_bytes
         self.prefetch_lookahead = prefetch_lookahead
 
+    def set_prefetch_budget(self, n):
+        """Retarget the prefetcher's in-flight byte budget at runtime — the
+        autotuner's chunk-fetch knob (``docs/autotune.md``). The prefetcher
+        re-reads ``prefetch_budget_bytes`` on every budget wait, so the new
+        bound takes effect on its next fetch decision."""
+        n = int(n)
+        if n < 1:
+            raise ValueError('prefetch budget must be >= 1 byte')
+        self.prefetch_budget_bytes = n
+
     def _key(self):
         return (self.root, self.size_limit_bytes, self.prefetch_budget_bytes,
                 self.prefetch_lookahead)

@@ -330,6 +330,16 @@ class ShuffledColumnarBuffer(object):
     def rng_state(self, state):
         self._rng.bit_generator.state = state
 
+    def resize(self, capacity, min_after):
+        """Retarget capacity/decorrelation floor at runtime (the autotuner's
+        shuffle knob). Buffered rows are kept; ``can_emit`` reflects the new
+        bounds from the next call."""
+        if min_after >= capacity:
+            raise ValueError('min_after ({}) must be smaller than capacity ({})'.format(
+                min_after, capacity))
+        self._capacity = capacity
+        self._min_after = min_after
+
     def add_block(self, block):
         n = block_num_rows(block)
         if not n:

@@ -25,7 +25,7 @@ import numpy as np
 from petastorm_tpu import observability as obs
 from petastorm_tpu.errors import PetastormTpuError
 from petastorm_tpu.jax.infeed import stage_batch
-from petastorm_tpu.shuffling_buffer import make_shuffling_buffer_factory
+from petastorm_tpu.shuffling_buffer import default_min_after, make_shuffling_buffer_factory
 
 logger = logging.getLogger(__name__)
 
@@ -179,19 +179,12 @@ class JaxDataLoader(object):
         # nested window blocks, buffered under flat (offset, field) keys.
         self._columnar = bool(reader.batched_output)
         self._columnar_ngram = self._columnar and self._ngram is not None
-        if self._columnar:
-            from petastorm_tpu.columnar import FifoColumnarBuffer, ShuffledColumnarBuffer
-            from petastorm_tpu.shuffling_buffer import default_min_after
-            if shuffling_queue_capacity > 0:
-                floor = default_min_after(shuffling_queue_capacity, min_after_retrieve)
-                self._make_buffer = lambda: ShuffledColumnarBuffer(
-                    shuffling_queue_capacity, floor, seed)
-            else:
-                self._make_buffer = FifoColumnarBuffer
-        else:
-            self._make_buffer = make_shuffling_buffer_factory(
-                shuffling_queue_capacity, min_after_retrieve, seed, batch_size,
-                batched_reader=reader.batched_output)
+        # shuffle knob state: _make_buffer reads these LIVE, so a runtime
+        # set_shuffle_capacity (the autotuner's shuffle knob) applies to the
+        # current buffer and to every buffer built for later epochs
+        self._shuffle_capacity = shuffling_queue_capacity
+        self._min_after_retrieve = min_after_retrieve
+        self._shuffle_seed = seed
         self._buffer = None
         self._pending = []
         # diagnostics state exists from construction: the full key set is
@@ -210,6 +203,57 @@ class JaxDataLoader(object):
         else:
             self._resume_rows = None
             self._resume_rng = None
+        # closed-loop autotuning (docs/autotune.md): an autotuned reader's
+        # controller rebinds its evidence source to THIS loader (whose
+        # diagnostics carry the consumer-side reader_wait signal) and gains
+        # the shuffle-capacity knob
+        tuner = getattr(reader, 'autotuner', None)
+        if tuner is not None and hasattr(tuner, 'attach_loader'):
+            tuner.attach_loader(self)
+
+    def _make_buffer(self):
+        """Build the client-side buffer from the CURRENT shuffle knob values
+        (one construction site for first iteration and every later epoch)."""
+        capacity = self._shuffle_capacity
+        if self._columnar:
+            from petastorm_tpu.columnar import FifoColumnarBuffer, ShuffledColumnarBuffer
+            if capacity > 0:
+                floor = default_min_after(capacity, self._min_after_retrieve)
+                return ShuffledColumnarBuffer(capacity, floor, self._shuffle_seed)
+            return FifoColumnarBuffer()
+        return make_shuffling_buffer_factory(
+            capacity, self._min_after_retrieve, self._shuffle_seed,
+            self.batch_size, batched_reader=self.reader.batched_output)()
+
+    @property
+    def shuffle_capacity(self):
+        """The live shuffle-buffer capacity (0 = no shuffling buffer)."""
+        return self._shuffle_capacity
+
+    def set_shuffle_capacity(self, capacity):
+        """Resize the client-side shuffling buffer at runtime (the autotuner's
+        shuffle knob; ``docs/autotune.md``). Applies to the live buffer —
+        buffered rows are kept — and to buffers built for later epochs. Only
+        valid when the loader was constructed WITH a shuffling buffer
+        (``shuffling_queue_capacity > 0``): switching shuffling on/off
+        mid-iteration would change delivery semantics, not just performance."""
+        capacity = int(capacity)
+        if capacity < 2:
+            raise ValueError('shuffle capacity must be >= 2 (the decorrelation '
+                             'floor must stay below it)')
+        if self._shuffle_capacity <= 0:
+            raise RuntimeError('loader has no shuffling buffer (constructed with '
+                               'shuffling_queue_capacity=0); the shuffle knob is '
+                               'unavailable')
+        with self._state_lock:
+            self._shuffle_capacity = capacity
+            # an explicit min_after_retrieve may exceed the new capacity:
+            # re-derive the floor from the one shared definition
+            self._min_after_retrieve = None
+            buffer = self._buffer
+            if buffer is not None and hasattr(buffer, 'resize'):
+                buffer.resize(capacity, default_min_after(capacity))
+        return capacity
 
     # -- iteration ----------------------------------------------------------
 

@@ -38,6 +38,10 @@ from petastorm_tpu.observability import metrics as _metrics
 from petastorm_tpu.observability import trace as _trace
 from petastorm_tpu.observability.exporters import (JsonlExporter,  # noqa: F401
                                                    to_prometheus_text, write_prometheus)
+from petastorm_tpu.observability.history import (HistoryRecorder,  # noqa: F401
+                                                 detect_regression, history_windows,
+                                                 load_history, window_delta,
+                                                 windowed_stall_report)
 from petastorm_tpu.observability.metrics import (counters_on, flatten_snapshot,  # noqa: F401
                                                  get_registry, merge_snapshots, spans_on)
 from petastorm_tpu.observability.report import (decode_collate_share,  # noqa: F401
@@ -187,12 +191,14 @@ def absorb_trace_events(events):
 
 
 __all__ = [
+    'HistoryRecorder',
     'JsonlExporter', 'TelemetryConfig', 'absorb_trace_events', 'add_seconds',
     'chrome_trace', 'configure', 'count', 'counters_on', 'current_config',
-    'decode_collate_share', 'drain_trace_events', 'export_chrome_trace',
-    'flatten_snapshot',
-    'format_stall_report', 'gauge_set', 'get_registry', 'get_ring', 'instant',
+    'decode_collate_share', 'detect_regression', 'drain_trace_events',
+    'export_chrome_trace', 'flatten_snapshot',
+    'format_stall_report', 'gauge_set', 'get_registry', 'get_ring',
+    'history_windows', 'instant', 'load_history',
     'merge_snapshots', 'observe', 'resolve_telemetry', 'snapshot', 'span',
-    'spans_on', 'stage', 'stall_report', 'to_prometheus_text',
-    'write_prometheus',
+    'spans_on', 'stage', 'stall_report', 'to_prometheus_text', 'window_delta',
+    'windowed_stall_report', 'write_prometheus',
 ]

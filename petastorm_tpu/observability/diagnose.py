@@ -7,7 +7,15 @@ and prints the input-stall attribution report, the key pipeline counters, and
     petastorm-tpu-diagnose file:///data/train --batches 50 \\
         --trace-out /tmp/pipeline.json --prom-out /tmp/metrics.prom
 
-Open the trace in https://ui.perfetto.dev (or chrome://tracing). See
+``--watch SECONDS`` switches to live mode: the read keeps running and the
+stall report + fused-fallback table re-render every interval from **windowed
+history** (``observability/history.py``) — each tick attributes the last
+interval's wait, not the cumulative totals, and regressions between windows
+are called out. ``--json`` stays machine-readable per tick (one JSON line
+each), which also makes the output a replayable history for
+``petastorm-tpu-autotune``.
+
+Open traces in https://ui.perfetto.dev (or chrome://tracing). See
 ``docs/observability.md`` for how to read the output and
 ``docs/troubleshooting.md`` ("reading a stall report") for the remedies.
 """
@@ -16,7 +24,10 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
+import threading
+import time
 
 from petastorm_tpu import observability as obs
 
@@ -79,6 +90,94 @@ def format_fused_fallbacks(diagnostics):
     return '\n'.join(lines)
 
 
+def watch(dataset_url, interval_s=2.0, ticks=None, batch_size=64,
+          pool_type='thread', workers_count=3, telemetry='counters',
+          use_batch_reader=False, reader_kwargs=None, as_json=False,
+          stream=None):
+    """Live mode: pump the loader on a background thread and re-render the
+    WINDOWED stall report + fused-fallback table every ``interval_s``. Each
+    tick covers only the last window (``observability/history.py``), so a
+    bottleneck that appears mid-run shows up within one interval instead of
+    being diluted by the cumulative totals. ``ticks`` bounds the run (None =
+    until interrupted). Returns the number of ticks rendered."""
+    from petastorm_tpu.jax.loader import JaxDataLoader
+    from petastorm_tpu.observability import history as _history
+
+    stream = stream if stream is not None else sys.stdout
+    obs.configure(telemetry)
+    if use_batch_reader:
+        from petastorm_tpu.reader import make_batch_reader as factory
+        extra = {}
+    else:
+        from petastorm_tpu.reader import make_reader as factory
+        extra = {'output': 'columnar'}
+    reader = factory(dataset_url, reader_pool_type=pool_type,
+                     workers_count=workers_count, num_epochs=None,
+                     telemetry=telemetry, **dict(extra, **(reader_kwargs or {})))
+    stop = threading.Event()
+    rendered = 0
+    with JaxDataLoader(reader, batch_size=batch_size, drop_last=False) as loader:
+
+        def pump():
+            try:
+                for _ in loader:
+                    if stop.is_set():
+                        return
+            except Exception:  # noqa: BLE001 - shutdown race on stop(): the watch loop already ended
+                pass
+
+        pump_thread = threading.Thread(target=pump, daemon=True,
+                                       name='pstpu-watch-pump')
+        pump_thread.start()
+        recorder = _history.HistoryRecorder(lambda: loader.diagnostics,
+                                            interval_s=interval_s)
+        recorder.record_now()
+        try:
+            while ticks is None or rendered < ticks:
+                time.sleep(interval_s)
+                recorder.record_now()
+                window = recorder.window_last()
+                if window is None:
+                    continue
+                rendered += 1
+                report = _history.windowed_stall_report(window)
+                regression = recorder.regression()
+                fallbacks = fused_fallback_table(
+                    {k: v for k, v in window.items()
+                     if not (k.startswith('fused_fallback_column:') and not v)})
+                if as_json:
+                    print(json.dumps({'tick': rendered, 'ts': round(time.time(), 3),
+                                      'window': report,
+                                      'fused_fallbacks': fallbacks,
+                                      'regression': regression}),
+                          file=stream, flush=True)
+                    continue
+                print('--- watch tick {} (window {:.1f}s, {} rows/s) ---'.format(
+                    rendered, window['window_s'],
+                    window['rows_per_s'] if window['rows_per_s'] is not None else '?'),
+                    file=stream)
+                print(obs.format_stall_report(report), file=stream)
+                if fallbacks:
+                    lines = ['fused-decode fallbacks this window:']
+                    for column in sorted(fallbacks):
+                        lines.append('  {:<24s} {}'.format(column, ', '.join(
+                            '{} x{}'.format(r, c)
+                            for r, c in sorted(fallbacks[column].items()))))
+                    print('\n'.join(lines), file=stream)
+                if regression is not None:
+                    print('  REGRESSION between windows: {}'.format(regression),
+                          file=stream)
+                stream.flush()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            stop.set()
+    # the loader context has stopped the reader: the pump's next() unblocks
+    # with StopIteration; join it so no thread outlives this call mid-teardown
+    pump_thread.join(timeout=10)
+    return rendered
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(
         prog='petastorm-tpu-diagnose',
@@ -98,8 +197,24 @@ def main(argv=None):
     parser.add_argument('--prom-out', default=None,
                         help='write a Prometheus text exposition snapshot here')
     parser.add_argument('--json', action='store_true', dest='as_json',
-                        help='print the report as JSON instead of text')
+                        help='print the report as JSON instead of text (in '
+                             '--watch mode: one JSON line per tick)')
+    parser.add_argument('--watch', type=float, default=None, metavar='SECONDS',
+                        help='live mode: re-render the stall report from '
+                             'windowed history every SECONDS instead of one '
+                             'cumulative snapshot')
+    parser.add_argument('--ticks', type=int, default=0,
+                        help='with --watch: stop after this many rendered '
+                             'ticks (0 = run until interrupted)')
     args = parser.parse_args(argv)
+
+    if args.watch is not None:
+        watch(args.dataset_url, interval_s=args.watch,
+              ticks=args.ticks or None, batch_size=args.batch_size,
+              pool_type=args.pool_type, workers_count=args.workers_count,
+              telemetry=args.telemetry, use_batch_reader=args.batch_reader,
+              as_json=args.as_json)
+        return 0
 
     telemetry = 'spans' if args.trace_out else args.telemetry
     report, diag = diagnose(args.dataset_url, batch_size=args.batch_size,
@@ -129,4 +244,14 @@ def main(argv=None):
 
 
 if __name__ == '__main__':
-    sys.exit(main())
+    _rc = main()
+    # Hard exit after flushing: on images whose sitecustomize loads an
+    # accelerator runtime plugin, interpreter finalization can race the
+    # runtime's background threads and segfault AFTER all output is written
+    # (observed intermittently in --watch mode), turning a successful run
+    # into rc=-11 for scripts checking the exit code. The CLI's work is done
+    # and flushed; skip teardown. In-process callers (tests, the Python API)
+    # use main()/watch() directly and are unaffected.
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(_rc)

@@ -130,6 +130,7 @@ def make_reader(dataset_url,
                 storage_retry_policy=None,
                 chunk_cache=None, chunk_cache_size_limit=None,
                 telemetry=None,
+                autotune=None,
                 on_error='raise', max_item_retries=None,
                 protocol_monitor=None):
     """Reader for datasets written by :func:`materialize_dataset` — rows decoded
@@ -185,6 +186,17 @@ def make_reader(dataset_url,
         :class:`petastorm_tpu.observability.TelemetryConfig`. ``None`` keeps
         the process's current configuration. Applied process-wide and carried
         into worker processes. See ``docs/observability.md``.
+    :param autotune: closed-loop autotuning (``docs/autotune.md``): ``True``
+        (defaults) or a :class:`petastorm_tpu.autotune.AutotuneConfig` starts
+        a feedback controller that watches windowed telemetry history and
+        adjusts, at runtime and within explicit bounds: the worker pool size
+        (grow/retire supervised slots), the chunk-prefetch in-flight byte
+        budget, and (once a :class:`~petastorm_tpu.jax.loader.JaxDataLoader`
+        attaches) the shuffle-buffer capacity. Every change is recorded as an
+        ``autotune.decision`` trace span and a structured decision-log
+        record carrying the evidence window. Default ``None``/``False``:
+        off, with zero overhead (no recorder, no thread). The controller is
+        exposed as :attr:`Reader.autotuner`.
     :param on_error: item-failure policy, identical across pool types
         (``docs/robustness.md``): ``'raise'`` (default) surfaces the first
         worker error to the iterating thread with the worker-side traceback
@@ -253,7 +265,8 @@ def make_reader(dataset_url,
                   storage_retry_policy=storage_retry_policy,
                   chunk_cache=chunk_cache,
                   chunk_cache_size_limit=chunk_cache_size_limit,
-                  telemetry=telemetry)
+                  telemetry=telemetry,
+                  autotune=autotune)
 
 
 def make_batch_reader(dataset_url,
@@ -272,6 +285,7 @@ def make_batch_reader(dataset_url,
                       storage_retry_policy=None,
                       chunk_cache=None, chunk_cache_size_limit=None,
                       telemetry=None,
+                      autotune=None,
                       on_error='raise', max_item_retries=None,
                       protocol_monitor=None):
     """Columnar reader for ANY Parquet store (reference reader.py:177-289):
@@ -290,6 +304,9 @@ def make_batch_reader(dataset_url,
 
     ``telemetry``: pipeline telemetry level ('off' | 'counters' | 'spans' |
     TelemetryConfig) — identical semantics to :func:`make_reader`.
+
+    ``autotune``: closed-loop autotuning (True | AutotuneConfig,
+    docs/autotune.md) — identical semantics to :func:`make_reader`.
 
     ``on_error``/``max_item_retries``: item-failure policy ('raise' | 'skip' |
     'retry', docs/robustness.md) — identical semantics to :func:`make_reader`.
@@ -319,7 +336,8 @@ def make_batch_reader(dataset_url,
                   storage_retry_policy=storage_retry_policy,
                   chunk_cache=chunk_cache,
                   chunk_cache_size_limit=chunk_cache_size_limit,
-                  telemetry=telemetry)
+                  telemetry=telemetry,
+                  autotune=autotune)
 
 
 class Reader(object):
@@ -332,7 +350,7 @@ class Reader(object):
                  num_epochs=1, cur_shard=None, shard_count=None, cache=None,
                  transform_spec=None, ngram=None, columnar_ngram=False, resume_state=None,
                  storage_retry_policy=None, chunk_cache=None, chunk_cache_size_limit=None,
-                 telemetry=None):
+                 telemetry=None, autotune=None):
         if (cur_shard is None) != (shard_count is None):
             raise ValueError('cur_shard and shard_count must be specified together')
         if cur_shard is not None and not 0 <= cur_shard < shard_count:
@@ -448,6 +466,20 @@ class Reader(object):
         self._stopped = False
         pool.start(worker_class, worker_args, ventilator=self._ventilator)
 
+        # closed-loop autotuning (docs/autotune.md): started AFTER the pool so
+        # the first evidence window observes a running pipeline. Default off —
+        # no recorder, no thread, no snapshot work.
+        self.autotuner = None
+        from petastorm_tpu.autotune import Autotuner, resolve_autotune
+        autotune_config = resolve_autotune(autotune)
+        if autotune_config is not None:
+            self.autotuner = Autotuner(
+                autotune_config, pool=pool,
+                chunk_cache=self._chunk_cache_config,
+                ventilator=self._ventilator,
+                diagnostics_fn=lambda: self.diagnostics)
+            self.autotuner.start()
+
     # -- piece filtering ----------------------------------------------------
 
     @staticmethod
@@ -553,6 +585,8 @@ class Reader(object):
         self.last_row_consumed = False
 
     def stop(self):
+        if self.autotuner is not None:
+            self.autotuner.stop()
         if self._chunk_prefetcher is not None:
             self._chunk_prefetcher.stop()
         self._pool.stop()

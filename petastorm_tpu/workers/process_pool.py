@@ -237,6 +237,7 @@ class ProcessPool(object):
         self._worker_state = {}     # worker_id -> liveness/ownership view
         self._heartbeats_received = 0  # overhead accounting (tests assert the bound)
         self._dying = {}            # worker_id -> {'proc', 'ring', 'at'} awaiting drain
+        self._retiring = set()      # worker_ids deliberately retired: shed, not respawned
         self._respawn_failures = {}
         self._deaths_seen = False
         self._idle_sweep_since = None
@@ -413,6 +414,64 @@ class ProcessPool(object):
         if ventilator is not None:
             self._ventilator = ventilator
             self._ventilator.start()
+
+    # -- runtime slot grow/retire (the autotuner's worker knob) --------------
+
+    def add_worker_slot(self):
+        """Spawn one additional supervised worker slot at runtime (fresh ring
+        on the shm transport). The new worker joins the same supervision
+        protocol as the originals — heartbeats, claims, respawn — so every
+        exactly-once guarantee holds unchanged. Returns the new
+        ``workers_count``. Slot ids are never reused (retired/shed slots stay
+        as None entries), so ring names stay unique."""
+        if self._spawn_info is None or self._stopped:
+            raise RuntimeError('Pool not started (or already stopped)')
+        worker_id = len(self._processes)
+        ring_name = None
+        if self._transport == 'shm':
+            from petastorm_tpu.native.shm_ring import ShmRing
+            ring_name = self._ring_name(worker_id, 0)
+            ring = ShmRing.create(ring_name, self._ring_bytes)
+            with self._ring_lock:
+                self._rings.append(ring)
+        else:
+            with self._ring_lock:
+                self._rings.append(None)
+        self._processes.append(self._spawn_worker(worker_id, ring_name))
+        self._worker_state[worker_id] = {'pid': self._processes[worker_id].pid,
+                                         'busy': None, 'last_hb': time.monotonic(),
+                                         'claimed_since_spawn': False}
+        with self._state_lock:
+            self._workers_count += 1
+        logger.info('process pool grew to %d workers (slot %d)',
+                    self._workers_count, worker_id)
+        return self._workers_count
+
+    def retire_worker_slot(self):
+        """Retire one IDLE worker slot at runtime (never below 1 live). The
+        slot is marked retiring and terminated; the regular two-stage death
+        handling drains its final messages, and the retiring mark sheds the
+        slot instead of respawning it — so even a race with a just-claimed
+        item is safe (the claim requeues exactly once, like any crash).
+        Returns the new target ``workers_count`` (unchanged when every live
+        slot was busy this tick)."""
+        if self.workers_alive() <= 1:
+            return self._workers_count
+        for worker_id in reversed(range(len(self._processes))):
+            p = self._processes[worker_id]
+            if p is None or not p.is_alive() or worker_id in self._retiring:
+                continue
+            state = self._worker_state.get(worker_id, {})
+            if state.get('busy') is not None:
+                continue
+            self._retiring.add(worker_id)
+            p.terminate()
+            with self._state_lock:
+                self._workers_count -= 1
+            logger.info('process pool retiring idle worker slot %d (target %d '
+                        'workers)', worker_id, self._workers_count)
+            return self._workers_count
+        return self._workers_count
 
     def _poll_message(self, timeout_ms):
         """Next (kind, seq, payload_bytes) from the results transport, or None
@@ -701,8 +760,12 @@ class ProcessPool(object):
         worker's final claim beacon still sits in its ring would misattribute
         the crash."""
         p.join()  # reap the zombie
-        logger.warning('Worker %d (pid %s) died with exitcode %s; draining its results',
-                       worker_id, p.pid, p.exitcode)
+        if worker_id in self._retiring:
+            logger.info('Retiring worker %d (pid %s) exited; draining its results',
+                        worker_id, p.pid)
+        else:
+            logger.warning('Worker %d (pid %s) died with exitcode %s; draining its results',
+                           worker_id, p.pid, p.exitcode)
         self._deaths_seen = True
         old_ring = self._rings[worker_id] if worker_id < len(self._rings) else None
         if old_ring is not None:
@@ -733,6 +796,15 @@ class ProcessPool(object):
             logger.warning('Dead worker %d owned item dispatch=%s; scheduling requeue',
                            worker_id, owned)
             self._orphans.setdefault(owned, now)
+        if worker_id in self._retiring:
+            # deliberate retire (autotune shrink): the slot sheds cleanly —
+            # no respawn, no respawn-failure accounting, no restart counter
+            self._retiring.discard(worker_id)
+            self._processes[worker_id] = None
+            self._worker_state.pop(worker_id, None)
+            logger.info('Worker slot %d retired; pool at %d live workers',
+                        worker_id, self.workers_alive())
+            return
         # startup death (never claimed an item since this spawn) counts toward
         # the slot's respawn-failure budget; a death while working is
         # item-/environment-attributed and resets it

@@ -40,6 +40,10 @@ logger = logging.getLogger(__name__)
 
 DEFAULT_RESULTS_QUEUE_SIZE = 50
 
+#: task-queue sentinel consumed by exactly one worker thread, which then
+#: exits its loop (the retire half of the autotuner's worker knob)
+_RETIRE = object()
+
 
 class ThreadPool(object):
     def __init__(self, workers_count, results_queue_size=DEFAULT_RESULTS_QUEUE_SIZE,
@@ -61,6 +65,7 @@ class ThreadPool(object):
                         else ErrorPolicy(on_error, **({} if max_item_retries is None
                                                       else {'max_item_retries': max_item_retries})))
         self._counter_lock = threading.Lock()
+        self._next_worker_id = workers_count  # ids for runtime-grown slots
         self._dispatch_ids = DispatchIds()
         self._tls = threading.local()  # per-worker-thread current item seq
         # opt-in protocol conformance monitor (docs/protocol.md; lazy import so
@@ -83,6 +88,9 @@ class ThreadPool(object):
     def start(self, worker_class, worker_setup_args=None, ventilator=None):
         if self._threads:
             raise RuntimeError('Pool already started')
+        # kept for runtime slot growth (add_worker_slot spawns identical workers)
+        self._worker_class = worker_class
+        self._worker_setup_args = worker_setup_args
         for worker_id in range(self._workers_count):
             worker = worker_class(worker_id, self._publish, worker_setup_args)
             thread = threading.Thread(target=self._worker_loop, args=(worker,), daemon=True)
@@ -91,6 +99,38 @@ class ThreadPool(object):
         if ventilator is not None:
             self._ventilator = ventilator
             self._ventilator.start()
+
+    # -- runtime slot grow/retire (the autotuner's worker knob) --------------
+
+    def add_worker_slot(self):
+        """Start one additional worker thread at runtime. Returns the new
+        ``workers_count``. Safe at any point: the new worker pulls from the
+        shared task queue exactly like the original ones."""
+        if not self._threads:
+            raise RuntimeError('Pool not started')
+        with self._counter_lock:
+            worker_id = self._next_worker_id
+            self._next_worker_id += 1
+            self._workers_count += 1
+        worker = self._worker_class(worker_id, self._publish, self._worker_setup_args)
+        thread = threading.Thread(target=self._worker_loop, args=(worker,), daemon=True)
+        thread.start()
+        self._threads.append(thread)
+        logger.info('thread pool grew to %d workers', self._workers_count)
+        return self._workers_count
+
+    def retire_worker_slot(self):
+        """Retire one worker thread at runtime (never below 1). The retire
+        rides the task queue as a sentinel, so the exiting thread finishes
+        its current item first — no item is ever abandoned. Returns the new
+        ``workers_count``."""
+        with self._counter_lock:
+            if self._workers_count <= 1:
+                return self._workers_count
+            self._workers_count -= 1
+        self._task_queue.put(_RETIRE)
+        logger.info('thread pool retiring one worker (target %d)', self._workers_count)
+        return self._workers_count
 
     def ventilate(self, *args, **kwargs):
         seq = kwargs.pop('_seq', None)
@@ -295,9 +335,12 @@ class ThreadPool(object):
         try:
             while not self._stop_event.is_set():
                 try:
-                    d, seq, args, kwargs, attempts = self._task_queue.get(timeout=0.05)
+                    task = self._task_queue.get(timeout=0.05)
                 except queue.Empty:
                     continue
+                if task is _RETIRE:
+                    return  # deliberate slot retire (worker.shutdown in finally)
+                d, seq, args, kwargs, attempts = task
                 self._tls.seq = seq
                 self._tls.dispatch = d
                 self._tls.published = False

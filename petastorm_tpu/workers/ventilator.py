@@ -162,6 +162,16 @@ class ConcurrentVentilator(VentilatorBase):
                 'rng_state': self._rng.bit_generator.state,
             }
 
+    def set_max_queue_size(self, n):
+        """Retarget the in-flight item budget at runtime. Used by the
+        autotuner when the worker pool grows/shrinks (the budget tracks
+        ``workers_count + extra`` exactly as at construction); shrinking
+        never cancels already-ventilated items — the feeding thread simply
+        waits until completions bring in-flight under the new bound."""
+        with self._in_flight_cv:
+            self._max_ventilation_queue_size = max(1, int(n))
+            self._in_flight_cv.notify_all()
+
     def upcoming_items(self, max_items):
         """Read-only peek at the next (up to ``max_items``) work items this
         ventilator will emit — the unventilated head of the current epoch, in

@@ -1,0 +1,585 @@
+"""Closed-loop autotuning tests: windowed history, the feedback controller
+(convergence, bounds, hysteresis/oscillation guards), pool slot grow/retire,
+the shuffle/prefetch knobs, decision spans + JSONL log, the offline replay
+CLI, and the zero-overhead-when-off guarantee."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from petastorm_tpu import make_reader
+from petastorm_tpu import observability as obs
+from petastorm_tpu.autotune import AutotuneConfig, Autotuner, resolve_autotune
+from petastorm_tpu.autotune.cli import (_SimChunkCache, _SimLoader, _SimPool,
+                                        main as autotune_cli_main, replay,
+                                        windows_from_trace)
+from petastorm_tpu.jax.loader import JaxDataLoader
+from petastorm_tpu.observability import history as hist
+
+
+@pytest.fixture(autouse=True)
+def _isolated_telemetry():
+    """Telemetry state is process-global (same stance as
+    tests/test_observability.py): save/restore level, clear registry + ring."""
+    saved = obs.current_config()
+    obs.get_registry().reset()
+    obs.get_ring().clear()
+    yield
+    obs.configure(saved)
+    obs.get_registry().reset()
+    obs.get_ring().clear()
+
+
+# ---------------------------------------------------------------------------
+# windowed history
+# ---------------------------------------------------------------------------
+
+def _snap(ts, **diag):
+    return {'ts': ts, 'diag': diag}
+
+
+def test_window_delta_counters_subtract_gauges_latest():
+    older = _snap(100.0, stage_decode_s=5.0, rows_emitted=100,
+                  reader_wait_s=2.0, shuffle_buffer_occupancy=50,
+                  workers_count=2, results_queue_depth=7)
+    newer = _snap(102.0, stage_decode_s=5.5, rows_emitted=300,
+                  reader_wait_s=2.4, shuffle_buffer_occupancy=10,
+                  workers_count=3, results_queue_depth=1)
+    win = hist.window_delta(older, newer)
+    assert win['stage_decode_s'] == pytest.approx(0.5)
+    assert win['rows_emitted'] == 200
+    assert win['rows_per_s'] == pytest.approx(100.0)
+    # gauges carry the NEWER reading, not a meaningless difference
+    assert win['shuffle_buffer_occupancy'] == 10
+    assert win['workers_count'] == 3
+    assert win['results_queue_depth'] == 1
+    # the wait fraction is recomputed over the window span
+    assert win['reader_wait_fraction'] == pytest.approx(0.4 / 2.0)
+    assert win['wait_proxy'] is None
+
+
+def test_window_delta_pool_wait_proxy_without_loader():
+    """A bare Reader records no reader_wait_s: the window falls back to the
+    pool-wait stage and says so, instead of reporting an un-attributable 0."""
+    older = _snap(10.0, stage_pool_wait_s=1.0, stage_decode_s=0.5)
+    newer = _snap(12.0, stage_pool_wait_s=2.6, stage_decode_s=1.2)
+    win = hist.window_delta(older, newer)
+    assert win['wait_proxy'] == 'pool_wait'
+    assert win['reader_wait_s'] == pytest.approx(1.6)
+    assert win['reader_wait_fraction'] == pytest.approx(0.8)
+
+
+def test_windowed_report_names_recent_not_cumulative_bottleneck():
+    """THE point of the time dimension: the run-cumulative report blames
+    decode, but the last window is transform-bound — windowed attribution
+    must name transform."""
+    older = _snap(0.0, reader_wait_s=100.0, stage_pool_wait_s=100.0,
+                  stage_decode_s=95.0, stage_transform_s=0.0)
+    newer = _snap(10.0, reader_wait_s=108.0, stage_pool_wait_s=108.0,
+                  stage_decode_s=95.5, stage_transform_s=7.0)
+    cumulative = obs.stall_report(newer['diag'])
+    assert cumulative['bottleneck'] == 'worker.decode'
+    windowed = hist.windowed_stall_report(hist.window_delta(older, newer))
+    assert windowed['bottleneck'] == 'worker.transform'
+    assert windowed['window_s'] == pytest.approx(10.0)
+
+
+def test_detect_regression_throughput_and_stall():
+    base = {'rows_per_s': 1000.0, 'reader_wait_fraction': 0.1}
+    assert hist.detect_regression(base, {'rows_per_s': 900.0,
+                                         'reader_wait_fraction': 0.1}) is None
+    drop = hist.detect_regression(base, {'rows_per_s': 500.0,
+                                         'reader_wait_fraction': 0.1})
+    assert drop['kind'] == 'throughput_drop' and drop['ratio'] == pytest.approx(0.5)
+    rise = hist.detect_regression(base, {'rows_per_s': 990.0,
+                                         'reader_wait_fraction': 0.5})
+    assert rise['kind'] == 'stall_rise'
+
+
+def test_history_recorder_bounded_save_load(tmp_path):
+    ticks = {'n': 0}
+
+    def diag():
+        ticks['n'] += 1
+        return {'rows_emitted': ticks['n'] * 10, 'reader_wait_s': 0.0}
+
+    rec = hist.HistoryRecorder(diag, interval_s=0.5, capacity=4)
+    for _ in range(10):
+        rec.record_now()
+    assert len(rec) == 4  # bounded: oldest rotated out
+    path = tmp_path / 'history.jsonl'
+    assert rec.save(str(path)) == 4
+    snaps = hist.load_history(str(path))
+    assert len(snaps) == 4 and snaps[-1]['diag']['rows_emitted'] == 100
+    assert len(hist.history_windows(snaps)) == 3
+    # JsonlExporter format ({'ts','metrics'}) loads too
+    path2 = tmp_path / 'exporter.jsonl'
+    path2.write_text('{"ts": 1.0, "metrics": {"a": 1}}\n'
+                     'garbage line\n'
+                     '{"ts": 2.0, "metrics": {"a": 5}}\n')
+    snaps2 = hist.load_history(str(path2))
+    assert [s['diag']['a'] for s in snaps2] == [1, 5]
+
+
+def test_history_recorder_overhead_guard(synthetic_dataset):
+    """<1% at the default cadence: one snapshot must cost well under 1% of
+    the 1s default interval, measured over a live reader's diagnostics."""
+    reader = make_reader(synthetic_dataset.url, schema_fields=['id'],
+                         reader_pool_type='dummy', output='columnar',
+                         telemetry='counters')
+    with JaxDataLoader(reader, batch_size=20, drop_last=False) as loader:
+        for _ in loader:
+            pass
+        rec = hist.HistoryRecorder(lambda: loader.diagnostics)
+        rec.record_now()  # warm the path
+        t0 = time.perf_counter()
+        n = 20
+        for _ in range(n):
+            rec.record_now()
+        per_snapshot = (time.perf_counter() - t0) / n
+    assert per_snapshot < 0.01 * hist.DEFAULT_INTERVAL_S, per_snapshot
+
+
+def test_autotune_off_is_structurally_free(synthetic_dataset):
+    """autotune=False (the default) builds NO recorder and NO thread — the
+    overhead guarantee is structural, not a timing measurement."""
+    reader = make_reader(synthetic_dataset.url, schema_fields=['id'],
+                         reader_pool_type='dummy', output='columnar')
+    try:
+        assert reader.autotuner is None
+        names = {t.name for t in threading.enumerate()}
+        assert not any(n.startswith(('pstpu-autotune', 'pstpu-history'))
+                       for n in names)
+    finally:
+        reader.stop()
+        reader.join()
+
+
+# ---------------------------------------------------------------------------
+# controller decisions (simulated knobs: the identical path the CLI replays)
+# ---------------------------------------------------------------------------
+
+def _stalled_window(bottleneck_stage='stage_decode_s', wait=0.9, span=1.0,
+                    **extra):
+    win = {'window_s': span, 'reader_wait_s': wait,
+           'reader_wait_fraction': wait / span, 'stage_pool_wait_s': wait,
+           'rows_per_s': 100.0, 'wait_proxy': None,
+           bottleneck_stage: wait * 0.9}
+    win.update(extra)
+    return win
+
+
+def _calm_window(span=1.0):
+    return {'window_s': span, 'reader_wait_s': 0.0,
+            'reader_wait_fraction': 0.0, 'stage_pool_wait_s': 0.0,
+            'rows_per_s': 100.0, 'wait_proxy': None}
+
+
+def _tuner(config=None, workers=1, prefetch=64 << 20, shuffle=0):
+    pool = _SimPool(workers)
+    cache = _SimChunkCache(prefetch)
+    loader = _SimLoader(shuffle) if shuffle else None
+    cfg = config or AutotuneConfig(interval_s=1.0)
+    return Autotuner(cfg, pool=pool, chunk_cache=cache, loader=loader), pool, cache, loader
+
+
+def test_controller_grows_workers_and_respects_max():
+    tuner, pool, _, _ = _tuner(AutotuneConfig(interval_s=1.0, max_workers=3,
+                                              cooldown_s=1.0))
+    now = 0.0
+    for _ in range(10):
+        now += 10.0  # far past every cooldown
+        tuner.evaluate(_stalled_window(), now=now)
+    assert pool.workers_count == 3  # clamped at max, never beyond
+    grows = [d for d in tuner.decision_records() if d['action'] == 'grow']
+    assert len(grows) == 2
+    for d in grows:
+        assert d['knob'] == 'workers'
+        assert d['window']['bottleneck'] == 'worker.decode'
+        assert d['window']['span_s'] == pytest.approx(1.0)
+        assert d['window']['stages']  # evidence attached
+
+
+def test_controller_raises_prefetch_on_chunk_fetch_bound():
+    cfg = AutotuneConfig(interval_s=1.0, max_prefetch_bytes=256 << 20)
+    tuner, pool, cache, _ = _tuner(cfg, prefetch=64 << 20)
+    d = tuner.evaluate(_stalled_window('stage_chunk_fetch_s',
+                                       stage_read_s=0.81), now=100.0)
+    assert d['knob'] == 'prefetch_bytes'
+    assert cache.prefetch_budget_bytes == 128 << 20
+    # once the budget is capped, the fallback is more IO parallelism
+    cache.prefetch_budget_bytes = 256 << 20
+    d2 = tuner.evaluate(_stalled_window('stage_chunk_fetch_s',
+                                        stage_read_s=0.81), now=200.0)
+    assert d2['knob'] == 'workers' and pool.workers_count == 2
+
+
+def test_controller_shrinks_shuffle_on_assembly_bound():
+    cfg = AutotuneConfig(interval_s=1.0, min_shuffle_capacity=4)
+    tuner, _, _, loader = _tuner(cfg, shuffle=64)
+    win = _calm_window()
+    win.update(reader_wait_s=0.9, reader_wait_fraction=0.9,
+               stage_pool_wait_s=0.0)  # all wait is consumer-side assembly
+    d = tuner.evaluate(win, now=50.0)
+    assert d['knob'] == 'shuffle_capacity' and d['action'] == 'shrink'
+    assert loader.shuffle_capacity == 32
+    # clamp floor: repeated shrinks stop at min_shuffle_capacity
+    now = 50.0
+    for _ in range(10):
+        now += 100.0
+        tuner.evaluate(win, now=now)
+    assert loader.shuffle_capacity == 4
+
+
+def test_controller_shrinks_only_slots_it_grew():
+    """Calm windows retire a controller-grown slot, but never shrink the pool
+    below what the user configured."""
+    cfg = AutotuneConfig(interval_s=1.0, shrink_after_windows=2,
+                         cooldown_s=1.0, reverse_cooldown_s=2.0, max_workers=8)
+    tuner, pool, _, _ = _tuner(cfg, workers=2)
+    now = 100.0
+    for _ in range(10):  # calm forever, but nothing was grown: no shrink
+        now += 10.0
+        assert tuner.evaluate(_calm_window(), now=now) is None
+    assert pool.workers_count == 2
+    tuner.evaluate(_stalled_window(), now=now + 10)
+    assert pool.workers_count == 3
+    d = None
+    for _ in range(4):
+        now += 100.0
+        d = d or tuner.evaluate(_calm_window(), now=now)
+    assert d is not None and d['action'] == 'shrink'
+    assert pool.workers_count == 2
+
+
+def test_oscillation_guard_alternating_bottlenecks_do_not_thrash():
+    """Alternating stalled/calm phases flip the workers knob's direction;
+    after the reversal budget is spent the knob freezes instead of
+    oscillating, so the total number of moves stays small and no A/B/A/B
+    thrash pattern develops."""
+    cfg = AutotuneConfig(interval_s=1.0, cooldown_s=1.0, reverse_cooldown_s=1.5,
+                         freeze_s=1000.0, shrink_after_windows=1,
+                         max_workers=8)
+    tuner, pool, _, _ = _tuner(cfg, workers=1)
+    now = 0.0
+    tuner.evaluate(_stalled_window(), now=now)  # net grow: shrink is armed
+    for _ in range(40):
+        now += 10.0
+        tuner.evaluate(_stalled_window(), now=now)
+        now += 10.0
+        tuner.evaluate(_calm_window(), now=now)
+    actions = [d['action'] for d in tuner.decision_records()
+               if d['knob'] == 'workers']
+    # without the guard this would be ~40 grow/shrink pairs
+    assert len(actions) <= 5, actions
+    state = tuner._knobs['workers']
+    assert state.frozen_until > now - 1000.0  # the freeze engaged
+    assert 1 <= pool.workers_count <= 3
+
+
+def test_decision_span_records_at_counters_level():
+    """Every knob change must land in the trace ring as an autotune.decision
+    event even when per-stage spans are off — decisions are rare and must
+    stay explainable in any exported trace."""
+    obs.configure('counters')
+    tuner, _, _, _ = _tuner(AutotuneConfig(interval_s=1.0))
+    tuner.evaluate(_stalled_window(), now=100.0)
+    events = [e for e in obs.get_ring().snapshot()
+              if e['name'] == 'autotune.decision']
+    assert len(events) == 1
+    assert events[0]['args']['knob'] == 'workers'
+    assert events[0]['args']['action'] == 'grow'
+    assert events[0]['args']['after'] == 2
+
+
+def test_decision_log_jsonl(tmp_path):
+    log_path = tmp_path / 'decisions.jsonl'
+    cfg = AutotuneConfig(interval_s=1.0, decision_log=str(log_path))
+    tuner, _, _, _ = _tuner(cfg)
+    tuner.evaluate(_stalled_window(), now=10.0)
+    lines = [json.loads(line) for line in log_path.read_text().splitlines()]
+    assert len(lines) == 1
+    rec = lines[0]
+    assert rec['knob'] == 'workers' and rec['action'] == 'grow'
+    assert rec['from'] == 1 and rec['to'] == 2 and rec['clamped'] is False
+    assert rec['window']['bottleneck'] == 'worker.decode'
+    assert rec['window']['span_s'] > 0
+
+
+def test_resolve_autotune_and_config_validation():
+    assert resolve_autotune(None) is None
+    assert resolve_autotune(False) is None
+    assert isinstance(resolve_autotune(True), AutotuneConfig)
+    cfg = AutotuneConfig(interval_s=0.5)
+    assert resolve_autotune(cfg) is cfg
+    with pytest.raises(ValueError):
+        resolve_autotune('yes')
+    with pytest.raises(ValueError):
+        AutotuneConfig(interval_s=0)
+    with pytest.raises(ValueError):
+        AutotuneConfig(stall_threshold=0.1, low_water=0.2)
+    with pytest.raises(ValueError):
+        AutotuneConfig(min_workers=3, max_workers=2)
+
+
+# ---------------------------------------------------------------------------
+# knob actuators
+# ---------------------------------------------------------------------------
+
+def test_thread_pool_grow_and_retire_mid_epoch(synthetic_dataset):
+    reader = make_reader(synthetic_dataset.url, schema_fields=['id'],
+                         reader_pool_type='thread', workers_count=1,
+                         output='columnar', num_epochs=2,
+                         shuffle_row_groups=False)
+    pool = reader._pool
+    try:
+        it = iter(reader)
+        blocks = [next(it)]
+        assert pool.add_worker_slot() == 2
+        assert pool.retire_worker_slot() == 1
+        assert pool.retire_worker_slot() == 1  # never below 1
+        blocks.extend(it)
+        assert sum(len(b.id) for b in blocks) == 200  # nothing lost or doubled
+    finally:
+        reader.stop()
+        reader.join()
+
+
+def test_ventilator_max_queue_size_resize():
+    from petastorm_tpu.workers.ventilator import ConcurrentVentilator
+    seen = []
+    vent = ConcurrentVentilator(lambda **kw: seen.append(kw),
+                                [{'i': i} for i in range(6)],
+                                max_ventilation_queue_size=1)
+    vent.start()
+    time.sleep(0.2)
+    assert len(seen) == 1  # budget of 1: one in flight
+    vent.set_max_queue_size(6)
+    deadline = time.monotonic() + 5
+    while len(seen) < 6 and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert len(seen) == 6  # raised budget released the feeding thread
+    for _ in range(6):
+        vent.processed_item()
+    vent.stop()
+
+
+def test_prefetch_budget_setter_validates():
+    from petastorm_tpu.chunkstore import ChunkCacheConfig
+    cfg = ChunkCacheConfig('/tmp/x')
+    cfg.set_prefetch_budget(123456)
+    assert cfg.prefetch_budget_bytes == 123456
+    with pytest.raises(ValueError):
+        cfg.set_prefetch_budget(0)
+
+
+def test_loader_shuffle_capacity_resize(synthetic_dataset):
+    reader = make_reader(synthetic_dataset.url, schema_fields=['id'],
+                         reader_pool_type='dummy', output='columnar',
+                         seed=7)
+    with JaxDataLoader(reader, batch_size=10, drop_last=False,
+                       shuffling_queue_capacity=40, seed=7) as loader:
+        it = iter(loader)
+        ids = list(next(it)['id'])
+        assert loader.set_shuffle_capacity(4) == 4
+        assert loader.shuffle_capacity == 4
+        with pytest.raises(ValueError):
+            loader.set_shuffle_capacity(1)
+        for batch in it:
+            ids.extend(batch['id'])
+        assert sorted(ids) == list(range(100))  # exactly-once through resize
+
+
+def test_loader_without_buffer_rejects_shuffle_knob(synthetic_dataset):
+    reader = make_reader(synthetic_dataset.url, schema_fields=['id'],
+                         reader_pool_type='dummy', output='columnar')
+    with JaxDataLoader(reader, batch_size=10) as loader:
+        assert loader.shuffle_capacity == 0
+        with pytest.raises(RuntimeError):
+            loader.set_shuffle_capacity(16)
+
+
+@pytest.mark.slow
+def test_process_pool_grow_and_retire(synthetic_dataset):
+    reader = make_reader(synthetic_dataset.url, schema_fields=['id'],
+                         reader_pool_type='process', workers_count=1,
+                         output='columnar', num_epochs=2,
+                         shuffle_row_groups=False)
+    pool = reader._pool
+    try:
+        it = iter(reader)
+        blocks = [next(it)]
+        assert pool.add_worker_slot() == 2
+        blocks.extend(it)
+        assert sum(len(b.id) for b in blocks) == 200
+        assert pool.workers_alive() == 2
+        assert pool.retire_worker_slot() == 1
+        deadline = time.monotonic() + 15
+        while pool.workers_alive() > 1 and time.monotonic() < deadline:
+            pool._supervise(idle=True)
+            time.sleep(0.05)
+        assert pool.workers_alive() == 1
+    finally:
+        reader.stop()
+        reader.join()
+
+
+# ---------------------------------------------------------------------------
+# the closed loop end to end: mis-configured reader converges
+# ---------------------------------------------------------------------------
+
+def _slow_batched_transform(batch):
+    time.sleep(0.015)
+    return batch
+
+
+def test_autotune_converges_on_synthetic_slow_decode(synthetic_dataset, tmp_path):
+    """The acceptance loop: a deliberately under-provisioned reader (1
+    worker) with a synthetic slow decode-side stage must grow its pool —
+    within max_workers — and every change must carry its evidence window in
+    both the decision log and an autotune.decision trace event."""
+    from petastorm_tpu.transform import TransformSpec
+    log_path = tmp_path / 'decisions.jsonl'
+    cfg = AutotuneConfig(interval_s=0.15, cooldown_s=0.2, stall_threshold=0.1,
+                         max_workers=3, decision_log=str(log_path))
+    spec = TransformSpec(_slow_batched_transform, batched=True)
+    reader = make_reader(synthetic_dataset.url, schema_fields=['id'],
+                         reader_pool_type='thread', workers_count=1,
+                         output='columnar', transform_spec=spec,
+                         num_epochs=None, telemetry='counters', autotune=cfg)
+    pool = reader._pool
+    try:
+        assert reader.autotuner is not None
+        with JaxDataLoader(reader, batch_size=20, drop_last=False) as loader:
+            it = iter(loader)
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                next(it)
+                if pool.workers_count >= 2:
+                    break
+            assert pool.workers_count >= 2, 'controller never grew the pool'
+            assert pool.workers_count <= 3
+            decisions = reader.autotuner.decision_records()
+            assert decisions, 'no decision recorded'
+            for d in decisions:
+                assert d['knob'] == 'workers' and d['action'] == 'grow'
+                assert d['window']['span_s'] > 0
+                assert d['window']['bottleneck'] in (
+                    'worker.transform', 'worker.decode', 'worker.fused_decode',
+                    'worker.read_io', 'pool.unattributed')
+                assert d['window']['stages']
+            logged = [json.loads(line)
+                      for line in log_path.read_text().splitlines()]
+            assert len(logged) == len(decisions)
+            span_events = [e for e in obs.get_ring().snapshot()
+                           if e['name'] == 'autotune.decision']
+            assert len(span_events) >= len(decisions)
+    finally:
+        # loader context already stopped the reader
+        pass
+
+
+# ---------------------------------------------------------------------------
+# offline replay CLI
+# ---------------------------------------------------------------------------
+
+def _write_history(path, windows=6, stage='stage_decode_s'):
+    """Synthesize a stalled-run history: each 1s window accumulates 0.9s of
+    pool wait dominated by ``stage``."""
+    with open(path, 'w') as f:
+        wait = 0.0
+        busy = 0.0
+        for i in range(windows + 1):
+            f.write(json.dumps({'ts': 1000.0 + i, 'diag': {
+                'stage_pool_wait_s': wait, stage: busy,
+                'rows_emitted': i * 100}}) + '\n')
+            wait += 0.9
+            busy += 0.85
+
+
+def test_offline_replay_proposes_growth(tmp_path):
+    path = tmp_path / 'history.jsonl'
+    _write_history(str(path))
+    proposal, decisions, _ = replay(
+        hist.history_windows(hist.load_history(str(path))),
+        config=AutotuneConfig(interval_s=1.0, cooldown_s=1.0, max_workers=4),
+        workers=1)
+    assert proposal['workers_count'] > 1
+    assert proposal['workers_count'] <= 4
+    assert all(d['knob'] == 'workers' for d in decisions)
+
+
+def test_offline_cli_json_and_text(tmp_path, capsys):
+    path = tmp_path / 'history.jsonl'
+    _write_history(str(path))
+    rc = autotune_cli_main([str(path), '--workers', '1', '--interval-s', '1.0',
+                            '--json'])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc['proposal']['workers_count'] > 1
+    assert doc['windows'] == 6
+    rc = autotune_cli_main([str(path), '--workers', '1', '--interval-s', '1.0'])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert 'proposed configuration' in out and 'workers_count' in out
+
+
+def test_offline_cli_trace_replay(tmp_path, capsys):
+    """A Chrome trace (e.g. bench.py --trace-out) replays too: stage spans
+    bucket into windows, pool_wait doubles as the wait signal."""
+    events = []
+    for second in range(5):
+        base = int((1000 + second) * 1e6)
+        events.append({'name': 'pool_wait', 'cat': 'pool', 'ph': 'X',
+                       'ts': base, 'dur': int(0.9e6), 'pid': 1, 'tid': 1})
+        events.append({'name': 'decode', 'cat': 'worker', 'ph': 'X',
+                       'ts': base, 'dur': int(0.85e6), 'pid': 1, 'tid': 2})
+    trace = tmp_path / 'trace.json'
+    trace.write_text(json.dumps({'traceEvents': events}))
+    windows = windows_from_trace(str(trace), interval_s=1.0)
+    assert len(windows) == 5
+    assert windows[0]['wait_proxy'] == 'pool_wait'
+    rc = autotune_cli_main(['--trace', str(trace), '--interval-s', '1.0',
+                            '--workers', '1', '--json'])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc['proposal']['workers_count'] > 1
+
+
+def test_offline_cli_usage_errors(tmp_path, capsys):
+    with pytest.raises(SystemExit):
+        autotune_cli_main([])  # neither history nor --trace
+    empty = tmp_path / 'empty.jsonl'
+    empty.write_text('')
+    assert autotune_cli_main([str(empty)]) == 1
+
+
+# ---------------------------------------------------------------------------
+# diagnose --watch (windowed live mode)
+# ---------------------------------------------------------------------------
+
+def test_diagnose_watch_json_ticks(synthetic_dataset, capsys):
+    from petastorm_tpu.observability.diagnose import main as diagnose_main
+    rc = diagnose_main([synthetic_dataset.url, '--watch', '0.3', '--ticks', '2',
+                        '--batch-size', '10', '-p', 'dummy', '-w', '1',
+                        '--json'])
+    assert rc == 0
+    lines = [json.loads(line)
+             for line in capsys.readouterr().out.strip().splitlines()]
+    assert len(lines) == 2
+    for i, rec in enumerate(lines, start=1):
+        assert rec['tick'] == i
+        assert 'window' in rec and 'fused_fallbacks' in rec
+        assert rec['window']['window_s'] == pytest.approx(0.3, abs=0.25)
+
+
+def test_diagnose_watch_text(synthetic_dataset, capsys):
+    from petastorm_tpu.observability.diagnose import watch
+    n = watch(synthetic_dataset.url, interval_s=0.3, ticks=2, batch_size=10,
+              pool_type='dummy', workers_count=1)
+    out = capsys.readouterr().out
+    assert n == 2
+    assert 'watch tick 1' in out and 'watch tick 2' in out
+    assert 'stall report' in out

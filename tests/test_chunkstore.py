@@ -412,3 +412,42 @@ def test_prefetcher_respects_inflight_byte_budget(tmp_path):
     finally:
         pf.stop()
         pf.join()
+
+
+def test_chunk_plan_covers_fused_dict_snappy_chunks(tmp_path):
+    """ROADMAP PR 6 follow-up: the prefetcher's work list must include chunks
+    only the FUSED kernel can decode from the mirror (dictionary/snappy), not
+    just view-qualified ones — and their fetches ride the store's prefetch
+    path, so they count under the existing ``chunk_cache_prefetch_*``
+    counters the autotuner's prefetch knob watches."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    from petastorm_tpu.chunkstore.reader import ChunkCachedParquetFile
+    from petastorm_tpu.chunkstore.store import open_store
+    path = tmp_path / 'dict_store'
+    path.mkdir()
+    table = pa.table({'x': pa.array((np.arange(64) % 8).astype(np.int64)),
+                      'y': pa.array(np.linspace(0, 1, 64).astype(np.float64))})
+    pq.write_table(table, str(path / 'f.parquet'), compression='snappy',
+                   use_dictionary=True, row_group_size=32)
+    config = ChunkCacheConfig(str(tmp_path / 'chunks'))
+    fs = _mock_remote_fs_factory()
+    pf = ChunkCachedParquetFile(str(path / 'f.parquet'), fs, config)
+    # neither column view-qualifies (snappy + dictionary encoding) ...
+    assert pf._qualifying(0, ['x', 'y']) == []
+    # ... yet BOTH must be in the prefetcher's work list via the fused plan
+    plan = pf.chunk_plan(0, ['x', 'y'])
+    assert len(plan) == 2
+    store = open_store(config)
+    for key, length, fetch_fn in plan:
+        _, _, fetched = store.ensure(key, length, fetch_fn, for_prefetch=True)
+        assert fetched
+    diag = cache_diagnostics(config)
+    assert diag['chunk_cache_prefetch_chunks'] >= 2
+    assert diag['chunk_cache_prefetch_bytes'] > 0
+    # and the fused kernel decodes the warm mirror bit-exact
+    block, rest = pf.read_fused(0, ['x', 'y'])
+    assert rest == []
+    np.testing.assert_array_equal(block['x'], (np.arange(32) % 8).astype(np.int64))
+    np.testing.assert_array_equal(block['y'],
+                                  np.linspace(0, 1, 64).astype(np.float64)[:32])

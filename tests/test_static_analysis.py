@@ -892,6 +892,66 @@ def test_pt700_runs_clean_over_the_observability_subsystem():
 
 
 # ---------------------------------------------------------------------------
+# PT702 autotune action discipline
+# ---------------------------------------------------------------------------
+
+def test_pt702_unwrapped_actuator_flagged():
+    from petastorm_tpu.analysis.autotune_lints import AutotuneActionChecker
+    code = '''
+        def grow(self):
+            self._pool.add_worker_slot()
+    '''
+    codes = _codes(AutotuneActionChecker(), code, relpath='autotune/controller.py')
+    assert codes == ['PT702']
+
+
+def test_pt702_unclamped_value_flagged():
+    from petastorm_tpu.analysis.autotune_lints import AutotuneActionChecker
+    code = '''
+        def raise_budget(self):
+            with decision_span(knob='prefetch_bytes'):
+                self._cache.set_prefetch_budget(self._cache.prefetch_budget_bytes * 2)
+    '''
+    codes = _codes(AutotuneActionChecker(), code, relpath='autotune/controller.py')
+    assert codes == ['PT702']
+
+
+def test_pt702_span_wrapped_and_clamped_passes():
+    from petastorm_tpu.analysis.autotune_lints import AutotuneActionChecker
+    code = '''
+        def raise_budget(self):
+            with decision_span(knob='prefetch_bytes'):
+                target = clamp(self._before * 2, lo, hi)
+                self._cache.set_prefetch_budget(target)
+
+        def grow(self):
+            with decision_span(knob='workers'):
+                self._pool.add_worker_slot()
+
+        def direct(self):
+            with obs.span('autotune.decision'):
+                self._loader.set_shuffle_capacity(clamp(8, 2, 64))
+    '''
+    assert _codes(AutotuneActionChecker(), code,
+                  relpath='autotune/controller.py') == []
+
+
+def test_pt702_scope_is_autotune_only():
+    from petastorm_tpu.analysis.autotune_lints import AutotuneActionChecker
+    src = SourceFile('<fixture>', 'workers/thread_pool.py',
+                     'def f(pool):\n    pool.add_worker_slot()\n')
+    assert not AutotuneActionChecker().matches(src)
+
+
+def test_pt702_runs_clean_over_the_autotune_package():
+    """The checklist acceptance: the controller itself obeys its own rule —
+    every knob actuation is decision_span-wrapped and clamp-bounded."""
+    autotune_dir = os.path.join(PKG_DIR, 'autotune')
+    findings = run_analysis([autotune_dir], select=['PT702'])
+    assert findings == [], '\n'.join(f.format() for f in findings)
+
+
+# ---------------------------------------------------------------------------
 # PT800/PT801 worker-pool protocol discipline
 # ---------------------------------------------------------------------------
 
