@@ -44,6 +44,18 @@ Rule families (see ``docs/analysis.md`` for bad/good examples):
   constants/bytes may only be defined in the canonical
   ``workers/protocol.py``. The static complement of the protocol verifier
   (``petastorm_tpu/analysis/protocol/``, ``docs/protocol.md``).
+* **PT900/PT901/PT902** cross-language ABI conformance — every ctypes
+  ``Structure`` declaring itself a mirror of a C struct is proven
+  field-for-field identical under C layout rules (offsets, sizes, kinds,
+  plus the ``pstpu_abi_version`` ↔ ``EXPECTED_ABI`` literal sync); every
+  ``argtypes``/``restype`` declaration is checked against the ``extern "C"``
+  definition it binds; every exported pointer parameter must travel with a
+  capacity bound. The ABI is checked, not trusted (``analysis/abi.py``).
+* **PT903/PT904** C++ overflow/bounds discipline — bounds comparisons may
+  not be multiplication-form (``n * w <= cap`` wraps for corrupt ``n``;
+  division-form or an explicit guard required); ``memcpy``/pointer-advance
+  code must be dominated by a check naming the destination's capacity
+  (``analysis/cpp_safety.py`` — the PR 6 review-bug classes, mechanized).
 
 Suppress a single finding with ``# noqa: PT###`` (reason encouraged) on its
 line; absorb pre-existing findings with an ``analysis_baseline.json`` (see
@@ -53,10 +65,12 @@ line; absorb pre-existing findings with an ``analysis_baseline.json`` (see
 
 from __future__ import annotations
 
+from petastorm_tpu.analysis.abi import AbiConformanceChecker
 from petastorm_tpu.analysis.autotune_lints import AutotuneActionChecker
 from petastorm_tpu.analysis.buffers import NativeBufferChecker
 from petastorm_tpu.analysis.core import (Baseline, Checker, Finding, SourceFile,
                                          collect_sources, load_baseline, run_checkers)
+from petastorm_tpu.analysis.cpp_safety import CppSafetyChecker
 from petastorm_tpu.analysis.exceptions import (BaseExceptionContainmentChecker,
                                                ExceptionHygieneChecker)
 from petastorm_tpu.analysis.hashability import HashabilityChecker
@@ -78,10 +92,18 @@ ALL_CHECKERS = (
     BaseExceptionContainmentChecker,
     AutotuneActionChecker,
     ProtocolLintChecker,
+    AbiConformanceChecker,
+    CppSafetyChecker,
 )
 
+#: every individual rule id the registered checkers can emit — the linter
+#: meta-test (tests/test_static_analysis.py) demands a committed fixture
+#: pair per id, so registering a toothless rule fails tier-1
+ALL_RULE_CODES = tuple(c for cls in ALL_CHECKERS for c in cls.rule_codes())
 
-def run_analysis(paths, baseline=None, select=None, ignore=None):
+
+def run_analysis(paths, baseline=None, select=None, ignore=None,
+                 keep_suppressed=False):
     """Run every checker over ``paths`` (files or directories).
 
     :param baseline: a :class:`core.Baseline` (or None) absorbing known findings
@@ -90,11 +112,15 @@ def run_analysis(paths, baseline=None, select=None, ignore=None):
     :param ignore: iterable of rule-id prefixes to suppress, applied AFTER
         ``select`` — the staged-rollout knob (``--ignore PT8`` ships a new
         family dark)
-    :returns: sorted list of non-suppressed, non-baselined :class:`Finding`
+    :param keep_suppressed: keep noqa'd/baselined findings, annotated via
+        :attr:`core.Finding.status` (the ``--format json`` machine mode)
+    :returns: sorted list of :class:`Finding` (only ``status == 'open'`` ones
+        unless ``keep_suppressed``)
     """
     sources = collect_sources(paths)
     checkers = [cls() for cls in ALL_CHECKERS]
-    findings = run_checkers(checkers, sources, baseline=baseline)
+    findings = run_checkers(checkers, sources, baseline=baseline,
+                            keep_suppressed=keep_suppressed)
     if select is not None:
         prefixes = tuple(select)
         findings = [f for f in findings if f.code.startswith(prefixes)]
@@ -105,8 +131,9 @@ def run_analysis(paths, baseline=None, select=None, ignore=None):
 
 
 __all__ = [
-    'ALL_CHECKERS', 'AutotuneActionChecker', 'Baseline',
-    'BaseExceptionContainmentChecker', 'Checker',
+    'ALL_CHECKERS', 'ALL_RULE_CODES', 'AbiConformanceChecker',
+    'AutotuneActionChecker', 'Baseline',
+    'BaseExceptionContainmentChecker', 'Checker', 'CppSafetyChecker',
     'ExceptionHygieneChecker', 'Finding',
     'HashabilityChecker', 'JaxPurityChecker', 'LockDisciplineChecker',
     'NativeBufferChecker', 'ProtocolLintChecker', 'ResourceLifecycleChecker',

@@ -149,6 +149,7 @@ def _name_escapes_expr(expr, view_names):
 
 class NativeBufferChecker(Checker):
     code = 'PT500'
+    codes = ('PT500', 'PT501', 'PT502', 'PT503')
     name = 'native-buffer-safety'
     description = ('frombuffer/memoryview escaping without copy or writability '
                    'check; unbounded page views (PT501); unbounded native '

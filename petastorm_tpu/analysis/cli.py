@@ -9,16 +9,27 @@ Default path is the installed ``petastorm_tpu`` package.
 
 Exit-code contract (stable; scripts and CI may rely on it):
 
-* ``0`` — clean: no findings remain after noqa suppression, baseline
+* ``0`` — clean: no OPEN findings remain after noqa suppression, baseline
   absorption and ``--select``/``--ignore`` filtering (also: ``--rules`` and
   ``--write-baseline`` succeeded).
-* ``1`` — findings remain (each printed to stdout).
+* ``1`` — open findings remain (each printed to stdout).
 * ``2`` — usage error: unknown option, missing path, or a ``--select``/
   ``--ignore`` token that matches no known rule family.
 
+``--format json`` emits ONE machine-readable finding object per line
+(JSONL), so CI and the Admin tooling can annotate diffs line by line:
+
+    {"rule": "PT900", "path": "native/fused.py", "line": 84,
+     "message": "...", "snippet": "...", "status": "open"}
+
+``status`` is ``open`` (actionable; these drive the exit code),
+``noqa`` (suppressed on its line) or ``baselined`` (absorbed by
+``--baseline``) — the JSON stream carries all three so a diff annotator can
+show suppressed findings too; text output prints only open ones.
+
 ``--select``/``--ignore`` take comma-separated rule-id prefixes and make
-staged rollouts possible: ship new rule families dark with ``--ignore PT8``,
-or gate a single family with ``--select PT8``.
+staged rollouts possible: ship new rule families dark with ``--ignore PT9``,
+or gate a single family with ``--select PT9``.
 """
 
 from __future__ import annotations
@@ -45,11 +56,17 @@ def build_parser():
         description='Repo-specific invariant linter: lock discipline (PT100), '
                     'resource lifecycle (PT200), exception hygiene (PT300), JAX '
                     'purity (PT400), native-buffer safety (PT500), hashability '
-                    '(PT600). See docs/analysis.md.')
+                    '(PT600), telemetry/worker/autotune hygiene (PT7xx), '
+                    'protocol discipline (PT8xx), cross-language ABI '
+                    'conformance + C++ overflow/bounds (PT9xx). '
+                    'See docs/analysis.md.')
     parser.add_argument('paths', nargs='*',
                         help='files/directories to scan (default: the installed '
                              'petastorm_tpu package)')
-    parser.add_argument('--format', choices=('text', 'json'), default='text')
+    parser.add_argument('--format', choices=('text', 'json'), default='text',
+                        help='json = one finding object per line (JSONL; '
+                             'includes noqa/baselined findings with their '
+                             'status — only "open" ones affect the exit code)')
     parser.add_argument('--baseline', metavar='FILE',
                         help='analysis_baseline.json absorbing known findings '
                              '(missing file = empty baseline)')
@@ -88,7 +105,7 @@ def main(argv=None):
         if not raw:
             return None
         prefixes = [c.strip().upper() for c in raw.split(',') if c.strip()]
-        known = [cls.code for cls in ALL_CHECKERS] + ['PT000']
+        known = [c for cls in ALL_CHECKERS for c in cls.rule_codes()] + ['PT000']
         for prefix in prefixes:
             if not any(code.startswith(prefix) for code in known):
                 print('error: {} prefix {!r} matches no known rule family '
@@ -103,24 +120,32 @@ def main(argv=None):
     if ignore == EXIT_USAGE:
         return EXIT_USAGE
     baseline = load_baseline(args.baseline) if args.baseline else None
-    findings = run_analysis(paths, baseline=baseline, select=select, ignore=ignore)
+    keep_suppressed = args.format == 'json' and not args.write_baseline
+    findings = run_analysis(paths, baseline=baseline, select=select,
+                            ignore=ignore, keep_suppressed=keep_suppressed)
+    open_findings = [f for f in findings if f.status == 'open']
 
     if args.write_baseline:
-        write_baseline(args.write_baseline, findings)
+        write_baseline(args.write_baseline, open_findings)
         print('baseline with {} entr{} written to {}'.format(
-            len(findings), 'y' if len(findings) == 1 else 'ies', args.write_baseline))
+            len(open_findings), 'y' if len(open_findings) == 1 else 'ies',
+            args.write_baseline))
         return EXIT_CLEAN
 
     if args.format == 'json':
-        print(json.dumps({'findings': [f.to_dict() for f in findings],
-                          'count': len(findings)}, indent=2))
-    else:
+        # JSONL: one stable finding object per line (see the module docstring
+        # for the schema); noqa/baselined findings ride along with their
+        # status so machine consumers can annotate suppressions too
         for f in findings:
+            print(json.dumps(f.to_dict(), sort_keys=True))
+    else:
+        for f in open_findings:
             print(f.format())
             if f.snippet:
                 print('    {}'.format(f.snippet))
-        print('{} finding{}'.format(len(findings), '' if len(findings) == 1 else 's'))
-    return EXIT_FINDINGS if findings else EXIT_CLEAN
+        print('{} finding{}'.format(len(open_findings),
+                                    '' if len(open_findings) == 1 else 's'))
+    return EXIT_FINDINGS if open_findings else EXIT_CLEAN
 
 
 if __name__ == '__main__':

@@ -37,19 +37,28 @@ _CODE_ALIASES = {'BLE001': 'PT300'}
 
 @dataclass(frozen=True, order=True)
 class Finding:
-    """One rule violation at a source location."""
+    """One rule violation at a source location.
+
+    ``status`` is ``'open'`` for an actionable finding; runs with
+    ``keep_suppressed=True`` also carry ``'noqa'`` (suppressed on its line)
+    and ``'baselined'`` (absorbed by the baseline) findings so machine
+    consumers (``--format json``) can annotate diffs with the full picture.
+    """
     path: str       # relative path (as scoped/reported)
     line: int       # 1-based
     code: str       # e.g. 'PT100'
     message: str
     snippet: str = field(default='', compare=False)
+    status: str = field(default='open', compare=False)
 
     def format(self):
         return '{}:{}: {} {}'.format(self.path, self.line, self.code, self.message)
 
     def to_dict(self):
-        return {'path': self.path, 'line': self.line, 'code': self.code,
-                'message': self.message, 'snippet': self.snippet}
+        """The stable one-object-per-line JSON schema of ``--format json``."""
+        return {'rule': self.code, 'path': self.path, 'line': self.line,
+                'message': self.message, 'snippet': self.snippet,
+                'status': self.status}
 
 
 class SourceFile(object):
@@ -64,7 +73,8 @@ class SourceFile(object):
         self.is_python = relpath.endswith('.py')
         self.tree = None
         self.parse_error = None
-        self._noqa = self._collect_noqa(text) if self.is_python else {}
+        self._noqa = (self._collect_noqa(text) if self.is_python
+                      else self._collect_noqa_cpp(text))
         if self.is_python:
             try:
                 self.tree = ast.parse(text)
@@ -107,6 +117,23 @@ class SourceFile(object):
             pass
         return noqa
 
+    @staticmethod
+    def _collect_noqa_cpp(text):
+        """C++ flavor: ``// noqa: PT903 - reason`` line comments (the C++
+        rules PT502/PT9xx report on these sources)."""
+        noqa = {}
+        for i, line in enumerate(text.splitlines(), 1):
+            comment = line.split('//', 1)
+            if len(comment) < 2:
+                continue
+            m = _NOQA_RE.search('#' + comment[1])
+            if not m:
+                continue
+            codes = m.group('codes')
+            noqa[i] = None if codes is None else \
+                {c.strip().upper() for c in codes.split(',')}
+        return noqa
+
     def is_suppressed(self, line, code):
         if line not in self._noqa:
             return False
@@ -130,9 +157,17 @@ class Checker(object):
     """
 
     code = 'PT000'
+    #: every rule id the checker can emit (None = just ``code``); the linter
+    #: meta-test requires a committed bad/clean fixture pair per listed id,
+    #: so a new id registered here without teeth fails tier-1
+    codes = None
     name = 'base'
     description = ''
     scope = ('*.py',)
+
+    @classmethod
+    def rule_codes(cls):
+        return cls.codes or (cls.code,)
 
     def matches(self, src):
         import fnmatch
@@ -163,15 +198,22 @@ class Baseline(object):
 
     def absorb(self, findings):
         """Findings not covered by the baseline (consumes multiplicity)."""
+        return self.split(findings)[0]
+
+    def split(self, findings):
+        """``(open, absorbed)`` — absorbed findings carry status
+        ``'baselined'`` (consumes multiplicity, like :meth:`absorb`)."""
+        from dataclasses import replace
         remaining = dict(self._counts)
-        out = []
+        open_findings, absorbed = [], []
         for f in findings:
             key = self._key(f.code, f.path, f.snippet)
             if remaining.get(key, 0) > 0:
                 remaining[key] -= 1
+                absorbed.append(replace(f, status='baselined'))
             else:
-                out.append(f)
-        return out
+                open_findings.append(f)
+        return open_findings, absorbed
 
     @staticmethod
     def from_findings(findings):
@@ -228,11 +270,18 @@ def collect_sources(paths):
     return sources
 
 
-def run_checkers(checkers, sources, baseline=None):
+def run_checkers(checkers, sources, baseline=None, keep_suppressed=False):
     """Apply ``checkers`` to ``sources``; returns sorted findings with noqa
     suppression and baseline absorption applied. Python files that fail to
-    parse produce a single PT000 finding (the pass must not silently skip)."""
+    parse produce a single PT000 finding (the pass must not silently skip).
+
+    ``keep_suppressed=True`` keeps noqa'd/baselined findings in the result,
+    annotated via :attr:`Finding.status` (``'noqa'``/``'baselined'``) — the
+    machine-readable mode behind ``--format json``; only ``'open'`` findings
+    are actionable either way."""
+    from dataclasses import replace
     findings = []
+    suppressed = []
     for src in sources:
         if src.parse_error is not None:
             findings.append(Finding(path=src.relpath, line=src.parse_error.lineno or 1,
@@ -245,9 +294,14 @@ def run_checkers(checkers, sources, baseline=None):
             for f in checker.check(src):
                 if not src.is_suppressed(f.line, f.code):
                     findings.append(f)
+                elif keep_suppressed:
+                    suppressed.append(replace(f, status='noqa'))
     findings.sort()
     if baseline is not None:
-        findings = baseline.absorb(findings)
+        open_findings, absorbed = baseline.split(findings)
+        findings = open_findings + (absorbed if keep_suppressed else [])
+    if keep_suppressed:
+        findings = sorted(findings + suppressed)
     return findings
 
 

@@ -117,6 +117,7 @@ def _name_released_or_escapes(func, name):
 
 class ResourceLifecycleChecker(Checker):
     code = 'PT200'
+    codes = ('PT200', 'PT201')
     name = 'resource-lifecycle'
     description = ('resource types constructed without with/try-finally or a '
                    'release path; __del__-only cleanup (PT201)')

@@ -142,6 +142,7 @@ class _MethodScan(ast.NodeVisitor):
 
 class LockDisciplineChecker(Checker):
     code = 'PT100'
+    codes = ('PT100', 'PT101')
     name = 'lock-discipline'
     description = ('writes to lock-guarded shared state outside "with self._lock"; '
                    'lock-acquisition-order cycles (PT101)')

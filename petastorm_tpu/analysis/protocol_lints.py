@@ -94,6 +94,7 @@ class ProtocolLintChecker(Checker):
     defined outside ``workers/protocol.py``)."""
 
     code = 'PT800'
+    codes = ('PT800', 'PT801')
     name = 'protocol-discipline'
     description = ('message-kind dispatch chains must cover every declared kind '
                    'or carry an else (PT800); protocol constants/bytes are '

@@ -137,6 +137,14 @@ class AutotuneConfig(object):
     :param shrink_workers: allow giving grown slots back (False = grow-only)
     :param decision_log: JSONL path for the structured decision log (None =
         in-memory ``Autotuner.decisions`` only)
+    :param rollback: A/B-check every knob move — the first full evidence
+        window after a move is compared against the move's own evidence
+        window via :func:`observability.history.detect_regression`; on a
+        detected regression the knob is reverted and frozen, recorded as a
+        ``rollback`` decision
+    :param rollback_throughput_ratio/rollback_stall_rise: the
+        :func:`~petastorm_tpu.observability.history.detect_regression`
+        thresholds the A/B check uses
     """
 
     def __init__(self, interval_s=2.0, history_capacity=_history.DEFAULT_CAPACITY,
@@ -146,7 +154,8 @@ class AutotuneConfig(object):
                  min_shuffle_capacity=2,
                  cooldown_s=None, reverse_cooldown_s=None, freeze_s=None,
                  shrink_after_windows=5, shrink_workers=True,
-                 decision_log=None):
+                 decision_log=None, rollback=True,
+                 rollback_throughput_ratio=0.7, rollback_stall_rise=0.15):
         if interval_s <= 0:
             raise ValueError('interval_s must be > 0')
         if not 0.0 <= low_water < stall_threshold <= 1.0:
@@ -161,6 +170,10 @@ class AutotuneConfig(object):
             raise ValueError('min_prefetch_bytes > max_prefetch_bytes')
         if shrink_after_windows < 1:
             raise ValueError('shrink_after_windows must be >= 1')
+        if not 0.0 < rollback_throughput_ratio <= 1.0:
+            raise ValueError('rollback_throughput_ratio must be in (0, 1]')
+        if rollback_stall_rise < 0.0:
+            raise ValueError('rollback_stall_rise must be >= 0')
         self.interval_s = interval_s
         self.history_capacity = history_capacity
         self.stall_threshold = stall_threshold
@@ -177,6 +190,9 @@ class AutotuneConfig(object):
         self.shrink_after_windows = shrink_after_windows
         self.shrink_workers = shrink_workers
         self.decision_log = decision_log
+        self.rollback = rollback
+        self.rollback_throughput_ratio = rollback_throughput_ratio
+        self.rollback_stall_rise = rollback_stall_rise
 
     def resolved_max_workers(self):
         if self.max_workers is not None:
@@ -249,6 +265,7 @@ class Autotuner(object):
         self._knobs = {}
         self._calm_windows = 0
         self._grown_slots = 0  # net slots this controller added (shrink floor)
+        self._pending_ab = None  # last knob move awaiting its A/B window
         self._stop_event = threading.Event()
         self._thread = None
 
@@ -317,6 +334,21 @@ class Autotuner(object):
         through the attached knob targets)."""
         now = now if now is not None else time.monotonic()
         report = _history.windowed_stall_report(window)
+        # A/B check first: the window that just closed is the evidence for the
+        # PREVIOUS move — a detected regression reverts + freezes that knob
+        # before any new move is considered
+        if self._pending_ab is not None:
+            pending, self._pending_ab = self._pending_ab, None
+            if self.config.rollback:
+                regression = _history.detect_regression(
+                    pending['window'], window,
+                    throughput_ratio=self.config.rollback_throughput_ratio,
+                    stall_rise=self.config.rollback_stall_rise)
+                if regression is not None:
+                    record = self._rollback(pending, regression, report,
+                                            window, now)
+                    if record is not None:
+                        return record
         wait_frac = report.get('reader_wait_fraction') or 0.0
         if wait_frac >= self.config.stall_threshold:
             self._calm_windows = 0
@@ -384,7 +416,7 @@ class Autotuner(object):
     # each change explainable, the clamp makes the bounds unbreakable.
 
     def _record(self, knob, action, before, after, reason, report, window,
-                clamped):
+                clamped, regression=None):
         record = {
             'ts': round(time.time(), 3),
             'knob': knob, 'action': action,
@@ -399,6 +431,12 @@ class Autotuner(object):
                 'stages': report.get('stages'),
             },
         }
+        if regression is not None:
+            record['regression'] = regression
+        if action != 'rollback':
+            # arm the A/B check: the NEXT full window is this move's verdict
+            # (a rollback is the verdict itself — it never re-arms)
+            self._pending_ab = {'record': record, 'window': window}
         with self._decisions_lock:
             self.decisions.append(record)
             if len(self.decisions) > 1000:
@@ -499,6 +537,72 @@ class Autotuner(object):
         return self._record('shuffle_capacity', 'shrink', before, target,
                             reason, report, window,
                             clamped=target != before // 2)
+
+    def _rollback(self, pending, regression, report, window, now):
+        """Revert the knob move in ``pending`` (its A/B window regressed) and
+        freeze the knob so the controller does not immediately retry the move
+        it just proved harmful. Recorded as a ``rollback`` decision carrying
+        the regression evidence (ROADMAP follow-up: autotune regression
+        rollback)."""
+        rec = pending['record']
+        knob, moved = rec['knob'], rec['action']
+        reason = 'regression after {} {} ({}): reverting to {}'.format(
+            moved, knob, regression.get('kind'), rec['from'])
+        before = after = None
+        if knob == 'workers':
+            pool = self._pool
+            if pool is None:
+                return None
+            before = pool.workers_count
+            with decision_span(knob=knob, action='rollback', before=before,
+                               target=rec['from'], reason=reason) as span:
+                if moved == 'grow' and hasattr(pool, 'retire_worker_slot') \
+                        and before > rec['from']:
+                    pool.retire_worker_slot()
+                    self._grown_slots = max(0, self._grown_slots - 1)
+                elif moved == 'shrink' and hasattr(pool, 'add_worker_slot') \
+                        and before < rec['from']:
+                    pool.add_worker_slot()
+                    self._grown_slots += 1
+                after = pool.workers_count
+                span.note(after=after)
+                if self._ventilator is not None \
+                        and hasattr(self._ventilator, 'set_max_queue_size'):
+                    self._ventilator.set_max_queue_size(after + 2)
+        elif knob == 'prefetch_bytes':
+            cache = self._chunk_cache
+            if cache is None or not hasattr(cache, 'set_prefetch_budget'):
+                return None
+            before = cache.prefetch_budget_bytes
+            target = clamp(rec['from'], self.config.min_prefetch_bytes,
+                           self.config.max_prefetch_bytes)
+            with decision_span(knob=knob, action='rollback', before=before,
+                               target=target, reason=reason):
+                cache.set_prefetch_budget(target)
+            after = target
+        elif knob == 'shuffle_capacity':
+            loader = self._loader
+            if loader is None or not hasattr(loader, 'set_shuffle_capacity'):
+                return None
+            before = getattr(loader, 'shuffle_capacity', 0)
+            target = clamp(rec['from'], self.config.min_shuffle_capacity, None)
+            with decision_span(knob=knob, action='rollback', before=before,
+                               target=target, reason=reason):
+                loader.set_shuffle_capacity(target)
+            after = target
+        else:
+            return None
+        if after == before:
+            return None  # nothing to revert (pool declined / already there)
+        state = self._knob_state(knob)
+        state.last_t = now
+        state.last_direction = None  # the reverted move does not count
+        state.frozen_until = now + self.config.freeze_s
+        logger.warning('autotune: %s move of %r regressed (%s); reverted and '
+                       'frozen for %.1fs', moved, knob, regression.get('kind'),
+                       self.config.freeze_s)
+        return self._record(knob, 'rollback', before, after, reason, report,
+                            window, clamped=False, regression=regression)
 
     @staticmethod
     def _bottleneck_share(report):

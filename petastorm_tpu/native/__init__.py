@@ -73,6 +73,22 @@ def _load_library():
             ctypes.POINTER(ctypes.c_ulonglong),  # per-page values-region lengths
             ctypes.c_int, ctypes.c_int]
         from petastorm_tpu.native import fused as _fused
+        try:
+            abi = lib.pstpu_abi_version()
+        except AttributeError:
+            abi = None  # pre-versioned .so: definitionally not EXPECTED_ABI
+        if abi != _fused.EXPECTED_ABI:
+            # a kernel whose struct/function ABI we cannot trust must not be
+            # called through mirrors describing a different layout — that is
+            # silent memory corruption, not a fallback. Refuse it loudly.
+            logger.warning(
+                'native kernel reports ABI version %s but this build of '
+                'petastorm_tpu expects %d (stale libpstpu.so build cache?); '
+                'using the pyarrow fallback — rebuild with '
+                'python -m petastorm_tpu.native.build --force',
+                abi, _fused.EXPECTED_ABI)
+            _load_failed = True
+            return None
         _fused.register_abi(lib)
         _lib = lib
         return _lib

@@ -5,6 +5,16 @@ bundled inside the installed pyarrow wheel — no system Arrow needed. Invoked
 explicitly (``python -m petastorm_tpu.native.build``) or automatically on first
 import of :mod:`petastorm_tpu.native` (with a graceful pure-pyarrow fallback
 when no toolchain is available).
+
+**Sanitizer lane** (``docs/native.md``): ``PSTPU_SANITIZE=address,undefined``
+switches every target to an ASan/UBSan-instrumented build. Sanitized builds
+land in separate ``*.san.so`` files with their own flag-keyed stamps, so the
+sanitized and release kernels coexist in the source dir and flipping the env
+var back costs no rebuild. The instrumented ``.so`` only loads into a process
+with the sanitizer runtimes preloaded (``LD_PRELOAD=libasan.so libubsan.so``
+for gcc) — ``tests/test_sanitized_native.py`` drives the whole lane through a
+subprocess that replays the fused-decode fuzz corpus and the corrupt-chunk
+regressions through the instrumented kernels.
 """
 
 from __future__ import annotations
@@ -21,6 +31,53 @@ SHM_SOURCE = os.path.join(_HERE, 'shm_ring.cpp')
 SHM_OUTPUT = os.path.join(_HERE, 'libpstpu_shm.so')
 IMG_SOURCE = os.path.join(_HERE, 'image_codec.cpp')
 IMG_OUTPUT = os.path.join(_HERE, 'libpstpu_img.so')
+
+#: sanitizers PSTPU_SANITIZE accepts (comma-separated; gcc/clang spellings)
+_SANITIZERS = ('address', 'undefined', 'leak', 'thread')
+
+
+def sanitize_tokens():
+    """Validated tuple of sanitizers from ``PSTPU_SANITIZE`` (empty = release
+    build). An unknown token is a hard error — a typo must not silently
+    produce an uninstrumented kernel the caller believes is sanitized."""
+    raw = os.environ.get('PSTPU_SANITIZE', '').strip()
+    if not raw:
+        return ()
+    tokens = tuple(t.strip() for t in raw.split(',') if t.strip())
+    unknown = [t for t in tokens if t not in _SANITIZERS]
+    if unknown:
+        raise RuntimeError('PSTPU_SANITIZE: unknown sanitizer(s) {} '
+                           '(supported: {})'.format(unknown, ', '.join(_SANITIZERS)))
+    return tokens
+
+
+def _sanitized_output(base):
+    """Sanitized builds live in their own ``.san.so`` next to the release
+    ``.so`` (own stamp, own lock): both coexist and flipping PSTPU_SANITIZE
+    back and forth never invalidates the other flavor."""
+    if not sanitize_tokens():
+        return base
+    return base[:-len('.so')] + '.san.so'
+
+
+def _sanitize_flags():
+    tokens = sanitize_tokens()
+    if not tokens:
+        return []
+    # -O1: keep the checks honest without optimizing the faulting code away;
+    # frame pointers + debug info make the sanitizer reports readable
+    return ['-fsanitize={}'.format(','.join(tokens)),
+            '-fno-omit-frame-pointer', '-g', '-O1']
+
+
+def _sanitized_stamp(stamp_fn):
+    """Key the cache stamp by the sanitize flags so a .san.so compiled for a
+    different sanitizer set rebuilds instead of masquerading."""
+    def stamped():
+        tokens = sanitize_tokens()
+        base = stamp_fn()
+        return 'san[{}]:{}'.format(','.join(tokens), base) if tokens else base
+    return stamped
 
 
 def _arrow_paths():
@@ -136,17 +193,18 @@ def _build_target(output, stamp_fn, make_cmd, label, force, quiet):
 
 def build(force=False, quiet=False):
     """Compile the row-group reader kernel against the pyarrow wheel's Arrow
-    C++ libraries. Returns the .so path."""
+    C++ libraries. Returns the .so path (a ``.san.so`` under PSTPU_SANITIZE)."""
     def make_cmd(tmp_out):
         include, libdirs, arrow_lib, parquet_lib = _arrow_paths()
-        cmd = ['g++', '-O2', '-std=c++20', '-shared', '-fPIC', SOURCE,
-               '-I{}'.format(include)]
+        cmd = ['g++', '-O2', '-std=c++20', '-shared', '-fPIC'] \
+            + _sanitize_flags() + [SOURCE, '-I{}'.format(include)]
         for d in libdirs:
             cmd += ['-L{}'.format(d), '-Wl,-rpath,{}'.format(d)]
         return cmd + ['-l:{}'.format(arrow_lib), '-l:{}'.format(parquet_lib),
                       '-o', tmp_out]
 
-    return _build_target(OUTPUT, _stamp, make_cmd, 'native kernel', force, quiet)
+    return _build_target(_sanitized_output(OUTPUT), _sanitized_stamp(_stamp),
+                         make_cmd, 'native kernel', force, quiet)
 
 
 def build_shm(force=False, quiet=False):
@@ -154,10 +212,12 @@ def build_shm(force=False, quiet=False):
     def make_cmd(tmp_out):
         # -lrt: shm_open/shm_unlink live in librt until glibc 2.34 (a no-op
         # stub library after); without it the .so carries an undefined symbol
-        return ['g++', '-O2', '-std=c++17', '-shared', '-fPIC', SHM_SOURCE,
-                '-lrt', '-o', tmp_out]
+        return ['g++', '-O2', '-std=c++17', '-shared', '-fPIC'] \
+            + _sanitize_flags() + [SHM_SOURCE, '-lrt', '-o', tmp_out]
 
-    return _build_target(SHM_OUTPUT, _shm_stamp, make_cmd, 'shm ring', force, quiet)
+    return _build_target(_sanitized_output(SHM_OUTPUT),
+                         _sanitized_stamp(_shm_stamp), make_cmd, 'shm ring',
+                         force, quiet)
 
 
 def build_img(force=False, quiet=False):
@@ -168,10 +228,13 @@ def build_img(force=False, quiet=False):
     vector ISA the local CPU actually has (SSE4/AVX2) is available to the
     resample/unfilter loops. The .so never travels."""
     def make_cmd(tmp_out):
-        return ['g++', '-O3', '-march=native', '-std=c++17', '-shared', '-fPIC', IMG_SOURCE,
-                '-ljpeg', '-lpng16', '-ldeflate', '-o', tmp_out]
+        return ['g++', '-O3', '-march=native', '-std=c++17', '-shared', '-fPIC'] \
+            + _sanitize_flags() + [IMG_SOURCE,
+                                   '-ljpeg', '-lpng16', '-ldeflate', '-o', tmp_out]
 
-    return _build_target(IMG_OUTPUT, _img_stamp, make_cmd, 'image codec', force, quiet)
+    return _build_target(_sanitized_output(IMG_OUTPUT),
+                         _sanitized_stamp(_img_stamp), make_cmd, 'image codec',
+                         force, quiet)
 
 
 if __name__ == '__main__':

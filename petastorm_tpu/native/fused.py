@@ -50,6 +50,12 @@ logger = logging.getLogger(__name__)
 #: is a LOUD per-column fallback (status ``page-cap``), never silent
 MAX_PAGES = 4096
 
+#: the batch-buffer ABI version this module's ctypes mirrors describe. MUST
+#: equal the ``pstpu_abi_version()`` literal in rowgroup_reader.cpp — the
+#: loader refuses a kernel reporting anything else (stale build cache), and
+#: lint rule PT900 keeps the two literals in sync statically.
+EXPECTED_ABI = 3
+
 # modes / codecs — keep in sync with rowgroup_reader.cpp
 MODE_FIXED = 0
 MODE_BINARY_RAW = 1

@@ -969,7 +969,9 @@ int decode_binary_img(FusedCol* c, const std::vector<PageRec>& pages,
       return kColImgDims;
     }
   }
-  if (row_bytes == 0 || uint64_t(n) * row_bytes > c->out_cap) return kColBounds;
+  // division form: n * row_bytes would wrap for corrupt huge dimensions,
+  // sneaking a tiny product past the capacity check (PT903)
+  if (row_bytes == 0 || uint64_t(n) > c->out_cap / row_bytes) return kColBounds;
   std::vector<void*> outs(un);
   for (size_t i = 0; i < un; i++) outs[i] = c->out + uint64_t(i) * row_bytes;
   const int threads = c->img_threads > 0 ? c->img_threads : 1;

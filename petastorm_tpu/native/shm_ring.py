@@ -22,6 +22,28 @@ _load_failed = False
 DEFAULT_RING_BYTES = 64 << 20
 
 
+class RingHeaderStruct(ctypes.Structure):
+    """Field-for-field mirror of ``struct RingHeader`` (shm_ring.cpp) — the
+    shared-memory segment layout both sides of the ring map. Python never
+    touches the header directly (all access goes through the C API), but the
+    mirror is the executable documentation of the cross-process layout and
+    lint rule PT900 proves it identical to the C struct, so a C-side edit
+    that would desynchronize producer and consumer mappings fails the linter
+    instead of corrupting rings at runtime."""
+
+    _fields_ = [
+        ('head', ctypes.c_uint64),
+        ('tail', ctypes.c_uint64),
+        ('capacity', ctypes.c_uint64),
+        ('magic', ctypes.c_uint64),
+        ('pad', ctypes.c_char * 32),
+    ]
+
+
+#: byte offset of the ring's data area inside the shm segment
+RING_HEADER_BYTES = ctypes.sizeof(RingHeaderStruct)
+
+
 def _load_library():
     global _lib, _load_failed
     if _lib is not None or _load_failed:

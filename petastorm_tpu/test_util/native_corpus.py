@@ -1,0 +1,206 @@
+"""Handwritten/fuzzed Parquet chunk corpus for the native decode kernels.
+
+One source of adversarial inputs, consumed from two directions:
+
+* ``tests/test_fused_decode.py`` replays it through the **release** kernels
+  and asserts the error-sentinel contract (malformed bytes return a status,
+  never crash or over-read);
+* ``tests/test_sanitized_native.py`` replays the identical corpus through
+  **ASan/UBSan-instrumented** kernels (``PSTPU_SANITIZE=address,undefined``,
+  see ``native/build.py``), where an over-read the release build happens to
+  survive becomes a hard failure.
+
+The builders handwrite thrift compact-protocol page headers byte by byte, so
+the corpus covers inputs no real writer produces (declared counts of
+``2**61``, truncated headers, spliced garbage) — exactly the class both PR 6
+review bugs lived in.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import struct
+
+import numpy as np
+
+
+def tvarint(v):
+    """Thrift compact-protocol unsigned varint."""
+    out = bytearray()
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def tzigzag(v):
+    return tvarint((v << 1) ^ (v >> 63))
+
+
+def plain_page(num_values, itemsize=8, value=0, values=None, encoding=0):
+    """One handwritten v1 data page (thrift compact header + values)."""
+    if values is None:
+        values = struct.pack('<q', value)[:itemsize] * num_values
+    dph = (bytes([0x15]) + tzigzag(num_values)   # 1: num_values
+           + bytes([0x15]) + tzigzag(encoding)   # 2: encoding
+           + bytes([0x15]) + tzigzag(3)          # 3: def-levels RLE
+           + bytes([0x15]) + tzigzag(3)          # 4: rep-levels RLE
+           + b'\x00')
+    header = (bytes([0x15]) + tzigzag(0)                  # 1: type DATA_PAGE
+              + bytes([0x15]) + tzigzag(len(values))      # 2: uncompressed
+              + bytes([0x15]) + tzigzag(len(values))      # 3: compressed
+              + bytes([0x2C]) + dph                       # 5: DataPageHeader
+              + b'\x00')
+    return header + values
+
+
+def dict_page(num_values, values):
+    """One handwritten v1 DICTIONARY page declaring ``num_values`` entries."""
+    header = (bytes([0x15]) + tzigzag(2)              # 1: type DICTIONARY_PAGE
+              + bytes([0x15]) + tzigzag(len(values))  # 2: uncompressed
+              + bytes([0x15]) + tzigzag(len(values))  # 3: compressed
+              + bytes([0x4C])                         # 7: DictionaryPageHeader
+              + bytes([0x15]) + tzigzag(num_values)   # 1: num_values
+              + bytes([0x15]) + tzigzag(0)            # 2: encoding PLAIN
+              + b'\x00'
+              + b'\x00')
+    return header + values
+
+
+def overflow_dict_chunk():
+    """The PR 6 regression: a dictionary page declaring ``2**61`` entries
+    over ONE real 8-byte value, indexed far out of range — the
+    multiplication-form bounds product used to wrap to 0 and pass."""
+    dict_vals = struct.pack('<q', 42)
+    idx = bytes([8]) + tvarint(4 << 1) + bytes([200])  # RLE run: 4 x index 200
+    return dict_page(1 << 61, dict_vals) + plain_page(4, values=idx, encoding=2)
+
+
+def fuzz_corpus(seed=0xF05ED, mutated=150, garbage=60, max_garbage=96):
+    """The seeded corpus the release fuzz test replays: byte mutations /
+    truncations / splices of a valid two-page chunk, then pure garbage.
+    Yields ``bytes`` (deterministic for a given seed)."""
+    rng = np.random.default_rng(seed)
+    valid = bytearray(plain_page(4) * 2)
+    for _ in range(mutated):
+        data = bytearray(valid)
+        for _ in range(rng.integers(1, 8)):
+            op = rng.integers(0, 3)
+            if op == 0 and len(data) > 1:           # mutate
+                data[rng.integers(0, len(data))] = rng.integers(0, 256)
+            elif op == 1 and len(data) > 2:         # truncate
+                del data[int(rng.integers(1, len(data))):]
+            else:                                    # splice random bytes
+                data += bytes(rng.integers(0, 256, rng.integers(1, 32),
+                                           dtype=np.uint8))
+        yield bytes(data)
+    for _ in range(garbage):
+        yield bytes(rng.integers(0, 256, rng.integers(0, max_garbage),
+                                 dtype=np.uint8))
+
+
+def replay_chunk_through_kernels(lib, data, reason_by_status):
+    """Drive one corpus entry through every parser at the native boundary:
+    the plain-page scanner (both def-level modes) and the fused kernel in
+    every mode x codec combination. Raises AssertionError when a kernel
+    breaks the sentinel contract; under sanitizers an over-read aborts the
+    process before any assertion fires."""
+    from petastorm_tpu.native import fused
+
+    chunk = np.frombuffer(bytes(data), dtype=np.uint8) if len(data) else \
+        np.zeros(1, np.uint8)[:0]
+    offs = (ctypes.c_ulonglong * 16)()
+    counts = (ctypes.c_longlong * 16)()
+    vlens = (ctypes.c_ulonglong * 16)()
+    for has_def in (0, 1):
+        n = lib.pstpu_scan_plain_pages(
+            chunk.ctypes.data_as(ctypes.c_void_p), chunk.size, offs, counts,
+            vlens, 16, has_def)
+        assert -1 <= n <= 16, n
+    if chunk.size == 0:
+        return
+    for mode, codec in ((0, 0), (0, 1), (1, 0), (1, 1)):
+        plan = fused.ColumnPlan('f')
+        plan.mode = mode
+        plan.codec = codec
+        plan.itemsize = 8
+        plan.strip_npy = mode == 1
+        plan.out_dtype = np.dtype(np.int64)
+        plan.out_shape = (4,)
+        plan.chunk_len = chunk.size
+        plan.out_bound = 64
+        out = np.zeros(64, np.uint8)
+        (res,) = fused.read_into(lib, [chunk], [plan], 4, out, [0])
+        assert res[0] in reason_by_status or res[0] == 0, res
+
+
+def replay_corrupt_chunk_regressions(lib):
+    """The handwritten corrupt-chunk regressions (the shipped PR 6 bug
+    class), asserting each is rejected with the expected status."""
+    from petastorm_tpu.native import fused
+
+    chunk = np.frombuffer(overflow_dict_chunk(), dtype=np.uint8)
+    plan = fused.ColumnPlan('x')
+    plan.itemsize = 8
+    plan.phys_dtype = np.dtype(np.int64)
+    plan.out_dtype = np.dtype(np.int64)
+    plan.out_shape = (4,)
+    plan.chunk_len = chunk.size
+    plan.out_bound = 4 * 8
+    out = np.zeros(32, np.uint8)
+    (res,) = fused.read_into(lib, [chunk], [plan], 4, out, [0])
+    assert res[0] == 9, res  # kColDict: rejected, never dereferenced
+
+    # stale-metadata precheck: a failing column must not shift its
+    # neighbors' aux buffers (the aux_bufs index-misalignment regression)
+    import io
+    cells = []
+    for i in range(2):
+        buf = io.BytesIO()
+        np.save(buf, np.arange(3, dtype=np.int64) + i)
+        cells.append(buf.getvalue())
+    values = b''.join(struct.pack('<I', len(c)) + c for c in cells)
+    chunk2 = np.frombuffer(plain_page(2, values=values), dtype=np.uint8)
+    payload = 3 * 8
+    bad = fused.ColumnPlan('bad')
+    bad.chunk_len = chunk2.size + 1
+    bad.out_bound = 16
+    good = fused.ColumnPlan('good')
+    good.mode = fused.MODE_BINARY_RAW
+    good.strip_npy = True
+    good.chunk_len = chunk2.size
+    good.out_bound = 2 * payload
+    out2 = np.zeros(16 + 2 * payload, np.uint8)
+    res2 = fused.read_into(lib, [chunk2, chunk2], [bad, good], 2, out2, [0, 16])
+    assert res2[0][0] != 0 and res2[1][0] == 0, res2
+    assert res2[1][3] > 0 and res2[1][4] == cells[0][:res2[1][3]], res2
+
+
+def replay_ring_cycles(ring_mod, name_suffix):
+    """Reserve/commit/abort + pad-marker wrap cycles and the never-fit
+    reservation through a (possibly sanitized) shm ring build."""
+    ring = ring_mod.ShmRing.create('/pstpu_san_{}'.format(name_suffix), 4096)
+    try:
+        for i in range(60):
+            payload = bytes([i % 251]) * (i * 37 % 900 + 10)
+            mv = ring.try_reserve(len(payload))
+            assert mv is not None
+            mv[:len(payload)] = payload
+            ring.commit(len(payload))
+            assert ring.try_read() == payload
+        ring.try_reserve(100)
+        ring.abort()
+        assert ring.try_read() is None
+        assert ring.try_write(b'x' * 1992) and ring.try_read() is not None
+        try:
+            ring.try_reserve(3000)  # wrap pad + header + payload can never fit
+        except ValueError:
+            pass
+        else:
+            raise AssertionError('never-fit reservation did not raise')
+    finally:
+        ring.close()

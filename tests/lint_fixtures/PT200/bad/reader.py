@@ -1,0 +1,8 @@
+class Res(object):
+    def close(self):
+        pass
+
+
+def leak():
+    r = Res()
+    r.poke()

@@ -1,0 +1,3 @@
+class Leaky(object):
+    def __del__(self):
+        self._free()

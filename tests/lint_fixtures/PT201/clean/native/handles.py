@@ -1,0 +1,6 @@
+class Fine(object):
+    def close(self):
+        pass
+
+    def __del__(self):
+        self.close()

@@ -1,0 +1,5 @@
+def pump(q):
+    try:
+        q.get()
+    except Exception:
+        pass

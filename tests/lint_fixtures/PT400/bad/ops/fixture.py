@@ -1,0 +1,7 @@
+import jax
+import numpy as np
+
+
+@jax.jit
+def noisy(x):
+    return x + np.random.rand()

@@ -1,0 +1,6 @@
+import jax
+
+
+@jax.jit
+def pure(x, key):
+    return x + jax.random.normal(key, x.shape)

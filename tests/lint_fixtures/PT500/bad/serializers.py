@@ -1,0 +1,5 @@
+import numpy as np
+
+
+def returns_view(buf):
+    return np.frombuffer(buf, np.uint8)

@@ -1,0 +1,4 @@
+def fill(desc, buf):
+    desc.out = buf.ctypes.data
+    desc.chunk = buf.ctypes.data
+    desc.chunk_len = buf.nbytes

@@ -1,0 +1,10 @@
+from petastorm_tpu import observability as obs
+
+
+def process():
+    obs.stage('decode')
+    do_work()
+
+
+def do_work():
+    pass

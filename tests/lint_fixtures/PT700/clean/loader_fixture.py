@@ -1,0 +1,10 @@
+from petastorm_tpu import observability as obs
+
+
+def process():
+    with obs.stage('decode', cat='worker'):
+        do_work()
+
+
+def do_work():
+    pass

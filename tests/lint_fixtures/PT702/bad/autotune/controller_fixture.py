@@ -1,0 +1,2 @@
+def grow(self):
+    self._pool.add_worker_slot()

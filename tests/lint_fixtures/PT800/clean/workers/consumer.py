@@ -1,0 +1,10 @@
+from petastorm_tpu.workers.protocol import MSG_DATA, MSG_DONE
+
+
+def consume(kind, payload):
+    if kind == MSG_DATA:
+        return payload
+    elif kind == MSG_DONE:
+        return None
+    else:
+        raise RuntimeError(kind)

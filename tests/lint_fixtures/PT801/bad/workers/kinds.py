@@ -1,0 +1,1 @@
+_DATA = 0

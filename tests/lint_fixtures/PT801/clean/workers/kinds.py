@@ -1,0 +1,5 @@
+from petastorm_tpu.workers.protocol import MSG_DATA
+
+
+def is_data(kind):
+    return kind == MSG_DATA

@@ -294,6 +294,98 @@ def test_decision_span_records_at_counters_level():
     assert events[0]['args']['after'] == 2
 
 
+def _regressed_window(rows_per_s=30.0):
+    """An A/B window whose throughput collapsed versus _stalled_window()."""
+    win = _stalled_window()
+    win['rows_per_s'] = rows_per_s
+    return win
+
+
+def test_rollback_reverts_regressed_worker_grow():
+    """The A/B contract: a knob move whose next evidence window regresses is
+    reverted, frozen, and recorded as a 'rollback' decision carrying the
+    regression evidence."""
+    tuner, pool, _, _ = _tuner(AutotuneConfig(interval_s=1.0, cooldown_s=1.0,
+                                              freeze_s=500.0, max_workers=8))
+    grow = tuner.evaluate(_stalled_window(), now=10.0)
+    assert grow['action'] == 'grow' and pool.workers_count == 2
+    d = tuner.evaluate(_regressed_window(), now=20.0)
+    assert d['action'] == 'rollback' and d['knob'] == 'workers'
+    assert d['from'] == 2 and d['to'] == 1 and pool.workers_count == 1
+    assert d['regression']['kind'] == 'throughput_drop'
+    assert 'regression after grow' in d['reason']
+    # the knob is frozen: the still-stalled pipeline cannot re-grow it
+    for now in (30.0, 120.0, 400.0):
+        assert tuner.evaluate(_stalled_window(), now=now) is None
+    assert pool.workers_count == 1
+    # ...until the freeze expires
+    assert tuner.evaluate(_stalled_window(), now=600.0)['action'] == 'grow'
+
+
+def test_rollback_stall_rise_and_prefetch_restore():
+    cfg = AutotuneConfig(interval_s=1.0, freeze_s=500.0)
+    tuner, _pool, cache, _ = _tuner(cfg, prefetch=64 << 20)
+    d = tuner.evaluate(_stalled_window('stage_chunk_fetch_s'), now=10.0)
+    assert d['knob'] == 'prefetch_bytes' and cache.prefetch_budget_bytes == 128 << 20
+    # throughput held (no drop) but the windowed wait fraction rose by more
+    # than rollback_stall_rise: the stall_rise arm of detect_regression
+    regressed = _stalled_window('stage_chunk_fetch_s', wait=0.95, span=0.9)
+    regressed['rows_per_s'] = 95.0
+    rb = tuner.evaluate(regressed, now=20.0)
+    assert rb['action'] == 'rollback' and rb['knob'] == 'prefetch_bytes'
+    assert cache.prefetch_budget_bytes == 64 << 20
+    assert rb['regression']['kind'] == 'stall_rise'
+
+
+def test_no_rollback_when_ab_window_holds():
+    """A move whose next window holds (no regression) keeps its effect, and
+    the A/B arm is consumed — a later regression is attributed to nothing."""
+    tuner, pool, _, _ = _tuner(AutotuneConfig(interval_s=1.0, cooldown_s=100.0,
+                                              max_workers=8))
+    tuner.evaluate(_stalled_window(), now=10.0)
+    assert tuner._pending_ab is not None
+    d = tuner.evaluate(_stalled_window(), now=10.5)  # held: within cooldown, no new move
+    assert d is None and pool.workers_count == 2
+    assert tuner._pending_ab is None
+    # a regression two windows later is NOT pinned on the old move
+    d = tuner.evaluate(_regressed_window(), now=11.0)
+    assert d is None
+    assert pool.workers_count == 2
+
+
+def test_rollback_disabled_keeps_the_move():
+    tuner, pool, _, _ = _tuner(AutotuneConfig(interval_s=1.0, rollback=False,
+                                              cooldown_s=100.0, max_workers=8))
+    tuner.evaluate(_stalled_window(), now=10.0)
+    d = tuner.evaluate(_regressed_window(), now=20.0)
+    assert d is None and pool.workers_count == 2
+    assert not any(r['action'] == 'rollback' for r in tuner.decision_records())
+
+
+def test_rollback_recorded_in_decision_log(tmp_path):
+    log_path = tmp_path / 'decisions.jsonl'
+    cfg = AutotuneConfig(interval_s=1.0, cooldown_s=1.0, freeze_s=500.0,
+                         max_workers=8, decision_log=str(log_path))
+    tuner, _, _, _ = _tuner(cfg)
+    tuner.evaluate(_stalled_window(), now=10.0)
+    tuner.evaluate(_regressed_window(), now=20.0)
+    lines = [json.loads(line) for line in log_path.read_text().splitlines()]
+    assert [r['action'] for r in lines] == ['grow', 'rollback']
+    assert lines[1]['regression']['kind'] == 'throughput_drop'
+    assert lines[1]['window']['rows_per_s'] == 30.0  # the regressed evidence
+
+
+def test_rollback_decision_span_recorded():
+    obs.configure('counters')
+    tuner, _, _, _ = _tuner(AutotuneConfig(interval_s=1.0, cooldown_s=1.0,
+                                           max_workers=8))
+    tuner.evaluate(_stalled_window(), now=10.0)
+    tuner.evaluate(_regressed_window(), now=20.0)
+    events = [e for e in obs.get_ring().snapshot()
+              if e['name'] == 'autotune.decision']
+    assert [e['args']['action'] for e in events] == ['grow', 'rollback']
+
+
 def test_decision_log_jsonl(tmp_path):
     log_path = tmp_path / 'decisions.jsonl'
     cfg = AutotuneConfig(interval_s=1.0, decision_log=str(log_path))

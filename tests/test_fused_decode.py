@@ -300,50 +300,14 @@ def test_decode_collate_share_helper():
 # _MAX_PAGES: loud fallback
 # ---------------------------------------------------------------------------
 
-def _tvarint(v):
-    out = bytearray()
-    while True:
-        b = v & 0x7F
-        v >>= 7
-        if v:
-            out.append(b | 0x80)
-        else:
-            out.append(b)
-            return bytes(out)
+# the handwritten thrift page builders live in test_util/native_corpus.py so
+# the sanitized fuzz-replay lane (test_sanitized_native.py) drives the SAME
+# corpus through ASan/UBSan-instrumented kernels
+from petastorm_tpu.test_util import native_corpus  # noqa: E402
 
-
-def _tzigzag(v):
-    return _tvarint((v << 1) ^ (v >> 63))
-
-
-def _plain_page(num_values, itemsize=8, value=0, values=None, encoding=0):
-    """One handwritten v1 data page (thrift compact header + values)."""
-    if values is None:
-        values = struct.pack('<q', value)[:itemsize] * num_values
-    dph = (bytes([0x15]) + _tzigzag(num_values)   # 1: num_values
-           + bytes([0x15]) + _tzigzag(encoding)   # 2: encoding
-           + bytes([0x15]) + _tzigzag(3)          # 3: def-levels RLE
-           + bytes([0x15]) + _tzigzag(3)          # 4: rep-levels RLE
-           + b'\x00')
-    header = (bytes([0x15]) + _tzigzag(0)                  # 1: type DATA_PAGE
-              + bytes([0x15]) + _tzigzag(len(values))      # 2: uncompressed
-              + bytes([0x15]) + _tzigzag(len(values))      # 3: compressed
-              + bytes([0x2C]) + dph                        # 5: DataPageHeader
-              + b'\x00')
-    return header + values
-
-
-def _dict_page(num_values, values):
-    """One handwritten v1 DICTIONARY page declaring ``num_values`` entries."""
-    header = (bytes([0x15]) + _tzigzag(2)              # 1: type DICTIONARY_PAGE
-              + bytes([0x15]) + _tzigzag(len(values))  # 2: uncompressed
-              + bytes([0x15]) + _tzigzag(len(values))  # 3: compressed
-              + bytes([0x4C])                          # 7: DictionaryPageHeader
-              + bytes([0x15]) + _tzigzag(num_values)   #   1: num_values
-              + bytes([0x15]) + _tzigzag(0)            #   2: encoding PLAIN
-              + b'\x00'
-              + b'\x00')
-    return header + values
+_tvarint = native_corpus.tvarint
+_plain_page = native_corpus.plain_page
+_dict_page = native_corpus.dict_page
 
 
 def test_page_cap_overflow_is_loud(monkeypatch):
@@ -443,54 +407,13 @@ def test_precheck_failed_column_keeps_aux_alignment():
 # ---------------------------------------------------------------------------
 
 def _fuzz_one(lib, data):
-    chunk = np.frombuffer(bytes(data), dtype=np.uint8) if len(data) else \
-        np.zeros(1, np.uint8)[:0]
-    # page scanner
-    offs = (ctypes.c_ulonglong * 16)()
-    counts = (ctypes.c_longlong * 16)()
-    vlens = (ctypes.c_ulonglong * 16)()
-    for has_def in (0, 1):
-        n = lib.pstpu_scan_plain_pages(
-            chunk.ctypes.data_as(ctypes.c_void_p), chunk.size, offs, counts,
-            vlens, 16, has_def)
-        assert -1 <= n <= 16
-    # fused kernel, every mode x codec
-    for mode, codec in ((0, 0), (0, 1), (1, 0), (1, 1)):
-        plan = fused.ColumnPlan('f')
-        plan.mode = mode
-        plan.codec = codec
-        plan.itemsize = 8
-        plan.strip_npy = mode == 1
-        plan.out_dtype = np.dtype(np.int64)
-        plan.out_shape = (4,)
-        plan.chunk_len = chunk.size
-        plan.out_bound = 64
-        out = np.zeros(64, np.uint8)
-        if chunk.size == 0:
-            continue
-        (res,) = fused.read_into(lib, [chunk], [plan], 4, out, [0])
-        assert res[0] in fused.REASON_BY_STATUS or res[0] == 0
+    native_corpus.replay_chunk_through_kernels(lib, data, fused.REASON_BY_STATUS)
 
 
 def test_fuzz_page_parsers_seeded():
     lib = native._load_library()
-    rng = np.random.default_rng(0xF05ED)
-    valid = bytearray(_plain_page(4) * 2)
-    for _ in range(150):
-        data = bytearray(valid)
-        for _ in range(rng.integers(1, 8)):
-            op = rng.integers(0, 3)
-            if op == 0 and len(data) > 1:           # mutate
-                data[rng.integers(0, len(data))] = rng.integers(0, 256)
-            elif op == 1 and len(data) > 2:         # truncate
-                del data[int(rng.integers(1, len(data))):]
-            else:                                    # splice random bytes
-                data += bytes(rng.integers(0, 256, rng.integers(1, 32),
-                                           dtype=np.uint8))
+    for data in native_corpus.fuzz_corpus():
         _fuzz_one(lib, data)
-    for _ in range(60):  # pure garbage
-        _fuzz_one(lib, bytes(rng.integers(0, 256, rng.integers(0, 96),
-                                          dtype=np.uint8)))
 
 
 def test_fuzz_snappy_and_hybrid_hypothesis():

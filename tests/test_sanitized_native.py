@@ -1,0 +1,141 @@
+"""The sanitizer-instrumented fuzz lane (``PSTPU_SANITIZE``, docs/native.md).
+
+The release fuzz tests (test_fused_decode.py) assert the error-sentinel
+contract; an out-of-bounds READ the release build happens to survive still
+passes them. This lane rebuilds the kernels with
+``PSTPU_SANITIZE=address,undefined`` and replays the identical corpus
+(``petastorm_tpu/test_util/native_corpus.py``) plus the handwritten
+corrupt-chunk regressions and the shm-ring reserve/commit cycles through the
+instrumented ``.san.so`` — any over-read/overflow/UB aborts the subprocess.
+
+Slow-marked (a full ASan rebuild of the Arrow-linked kernel takes tens of
+seconds) and skipped wherever the toolchain lacks the gcc sanitizer
+runtimes. The replay runs in a subprocess because an instrumented shared
+library only loads with ``libasan``/``libubsan`` preloaded.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+import petastorm_tpu
+from petastorm_tpu.native import build as native_build
+
+pytestmark = pytest.mark.slow
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(petastorm_tpu.__file__)))
+SANITIZE = 'address,undefined'
+
+
+def _runtime_lib(name):
+    """Absolute path of a gcc sanitizer runtime, or None when the toolchain
+    does not ship it (g++ echoes the bare name back for unknown files)."""
+    try:
+        out = subprocess.run(['g++', '-print-file-name={}'.format(name)],
+                             capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    path = out.stdout.strip()
+    return path if os.path.isabs(path) and os.path.exists(path) else None
+
+
+@pytest.fixture(scope='module')
+def sanitizer_env():
+    asan = _runtime_lib('libasan.so')
+    ubsan = _runtime_lib('libubsan.so')
+    if asan is None or ubsan is None:
+        pytest.skip('gcc sanitizer runtimes not installed')
+    env = dict(os.environ)
+    env.update({
+        'PSTPU_SANITIZE': SANITIZE,
+        'LD_PRELOAD': '{} {}'.format(asan, ubsan),
+        # leak detection sees the interpreter's arena noise, not ours; any
+        # real finding must abort loudly so the subprocess exits non-zero
+        'ASAN_OPTIONS': 'detect_leaks=0:abort_on_error=1',
+        'UBSAN_OPTIONS': 'halt_on_error=1:print_stacktrace=1',
+        'PYTHONPATH': REPO_ROOT,
+        'JAX_PLATFORMS': 'cpu',
+    })
+    return env
+
+
+_DRIVER = '''\
+"""Sanitized replay driver (written to a real file: spawn cannot run stdin)."""
+import os
+import sys
+
+assert os.environ.get('PSTPU_SANITIZE') == {sanitize!r}
+
+from petastorm_tpu.native import build
+out = build.build(quiet=True)
+assert out.endswith('.san.so'), out
+shm_out = build.build_shm(quiet=True)
+assert shm_out.endswith('.san.so'), shm_out
+
+import petastorm_tpu.native as native
+lib = native._load_library()
+assert lib is not None, 'sanitized kernel failed to load'
+
+from petastorm_tpu.native import fused, shm_ring
+from petastorm_tpu.test_util import native_corpus
+
+for data in native_corpus.fuzz_corpus():
+    native_corpus.replay_chunk_through_kernels(lib, data, fused.REASON_BY_STATUS)
+native_corpus.replay_corrupt_chunk_regressions(lib)
+
+assert shm_ring.is_available(), 'sanitized shm ring failed to load'
+native_corpus.replay_ring_cycles(shm_ring, str(os.getpid()))
+
+print('SANITIZED-REPLAY-OK')
+'''
+
+
+def test_sanitized_build_coexists_with_release(sanitizer_env, tmp_path):
+    """PSTPU_SANITIZE builds land in their own flag-keyed ``.san.so`` + stamp
+    and leave the release artifacts untouched."""
+    release_so = native_build.SHM_OUTPUT
+    release_stamp = None
+    if os.path.exists(release_so + '.stamp'):
+        with open(release_so + '.stamp') as f:
+            release_stamp = f.read()
+    driver = tmp_path / 'build_probe.py'
+    driver.write_text(
+        'from petastorm_tpu.native import build\n'
+        'print(build.build_shm(quiet=True))\n')
+    proc = subprocess.run([sys.executable, str(driver)], env=sanitizer_env,
+                          capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    san_so = proc.stdout.strip().splitlines()[-1]
+    assert san_so.endswith('libpstpu_shm.san.so')
+    assert os.path.exists(san_so)
+    with open(san_so + '.stamp') as f:
+        assert f.read().startswith('san[{}]:'.format(SANITIZE))
+    # release artifacts untouched: both flavors coexist
+    if release_stamp is not None:
+        with open(release_so + '.stamp') as f:
+            assert f.read() == release_stamp
+
+
+def test_sanitize_env_validation(monkeypatch):
+    monkeypatch.setenv('PSTPU_SANITIZE', 'address,bogus')
+    with pytest.raises(RuntimeError, match='bogus'):
+        native_build.sanitize_tokens()
+    monkeypatch.setenv('PSTPU_SANITIZE', '')
+    assert native_build.sanitize_tokens() == ()
+
+
+def test_sanitized_fuzz_replay(sanitizer_env, tmp_path):
+    """THE lane: the fused-decode fuzz corpus, the corrupt-chunk regressions
+    and the ring reserve/commit cycles run clean under ASan+UBSan."""
+    driver = tmp_path / 'sanitized_replay.py'
+    driver.write_text(_DRIVER.format(sanitize=SANITIZE))
+    proc = subprocess.run([sys.executable, str(driver)], env=sanitizer_env,
+                          capture_output=True, text=True, timeout=560)
+    assert proc.returncode == 0, \
+        'sanitized replay failed\nstdout:\n{}\nstderr:\n{}'.format(
+            proc.stdout, proc.stderr)
+    assert 'SANITIZED-REPLAY-OK' in proc.stdout
+    for marker in ('AddressSanitizer', 'runtime error'):
+        assert marker not in proc.stderr, proc.stderr

@@ -18,7 +18,9 @@ import textwrap
 
 import pytest
 
-from petastorm_tpu.analysis import ALL_CHECKERS, run_analysis
+from petastorm_tpu.analysis import ALL_CHECKERS, ALL_RULE_CODES, run_analysis
+from petastorm_tpu.analysis.abi import AbiConformanceChecker
+from petastorm_tpu.analysis.cpp_safety import CppSafetyChecker
 from petastorm_tpu.analysis.buffers import NativeBufferChecker
 from petastorm_tpu.analysis.core import (Baseline, SourceFile, load_baseline,
                                          run_checkers, write_baseline)
@@ -1127,6 +1129,170 @@ def test_syntax_error_reported_not_skipped():
 
 
 # ---------------------------------------------------------------------------
+# PT900/PT901/PT902/PT903/PT904 — ABI conformance + C++ overflow/bounds
+# ---------------------------------------------------------------------------
+
+NATIVE_SRC = os.path.join(PKG_DIR, 'native')
+
+
+def _mutated_native_tree(tmp_path, mutations):
+    """Copy the REAL native sources with seeded text mutations applied —
+    the teeth proof runs the checkers against the production code, not a
+    toy (a rule that only fires on fixtures is not protecting the tree)."""
+    nat = tmp_path / 'native'
+    nat.mkdir()
+    for fn in os.listdir(NATIVE_SRC):
+        if not fn.endswith(('.py', '.cpp')):
+            continue
+        with open(os.path.join(NATIVE_SRC, fn)) as f:
+            text = f.read()
+        for old, new in mutations.get(fn, ()):
+            assert old in text, 'mutation anchor vanished from {}'.format(fn)
+            text = text.replace(old, new)
+        (nat / fn).write_text(text)
+    return str(tmp_path)
+
+
+def _mutant_codes(tmp_path, mutations, select):
+    return [f.code for f in run_analysis(
+        [_mutated_native_tree(tmp_path, mutations)], select=select)]
+
+
+def test_real_native_tree_is_abi_clean(tmp_path):
+    """The unmutated native sources pass every PT9xx rule (the same property
+    the tier-1 gate enforces, isolated here for debuggability)."""
+    clean = _mutated_native_tree(tmp_path, {})
+    assert run_analysis([clean], select=['PT9']) == []
+
+
+def test_pt900_field_reorder_flagged(tmp_path):
+    codes = _mutant_codes(tmp_path, {'rowgroup_reader.cpp': [(
+        'uint64_t chunk_len;\n  uint8_t* out;',
+        'uint8_t* out;\n  uint64_t chunk_len;')]}, ['PT900'])
+    assert 'PT900' in codes
+
+
+def test_pt900_widened_type_flagged(tmp_path):
+    codes = _mutant_codes(tmp_path, {'rowgroup_reader.cpp': [(
+        'int32_t itemsize;', 'int64_t itemsize;')]}, ['PT900'])
+    assert 'PT900' in codes
+
+
+def test_pt900_added_field_flagged(tmp_path):
+    codes = _mutant_codes(tmp_path, {'rowgroup_reader.cpp': [(
+        'uint64_t aux1;', 'uint64_t aux1;\n  uint64_t aux2;')]}, ['PT900'])
+    assert 'PT900' in codes
+
+
+def test_pt900_abi_version_literal_sync(tmp_path):
+    """The satellite acceptance: EXPECTED_ABI and pstpu_abi_version() are
+    literal-synced — bumping one without the other is a PT900 finding."""
+    from petastorm_tpu.native import fused
+    with open(os.path.join(NATIVE_SRC, 'rowgroup_reader.cpp')) as f:
+        cpp = f.read()
+    assert 'return {};'.format(fused.EXPECTED_ABI) in \
+        cpp.split('pstpu_abi_version()', 1)[1][:40]
+    findings = run_analysis([_mutated_native_tree(tmp_path, {
+        'rowgroup_reader.cpp': [(
+            'int pstpu_abi_version() {{ return {}; }}'.format(fused.EXPECTED_ABI),
+            'int pstpu_abi_version() {{ return {}; }}'.format(fused.EXPECTED_ABI + 1),
+        )]})], select=['PT900'])
+    assert any('EXPECTED_ABI' in f.message for f in findings), findings
+
+
+def test_pt901_dropped_parameter_flagged(tmp_path):
+    codes = _mutant_codes(tmp_path, {'shm_ring.cpp': [(
+        'int pstpu_ring_write(void* h, const void* data, uint64_t len) {',
+        'int pstpu_ring_write(void* h, const void* data) {')]}, ['PT901'])
+    assert 'PT901' in codes
+
+
+def test_pt901_return_type_drift_flagged(tmp_path):
+    codes = _mutant_codes(tmp_path, {'shm_ring.cpp': [(
+        'uint64_t pstpu_ring_capacity(void* h) {',
+        'int pstpu_ring_capacity(void* h) {')]}, ['PT901'])
+    assert 'PT901' in codes
+
+
+def test_pt902_dropped_capacity_param_flagged(tmp_path):
+    codes = _mutant_codes(tmp_path, {'shm_ring.cpp': [(
+        'int pstpu_ring_write(void* h, const void* data, uint64_t len) {',
+        'int pstpu_ring_write(void* h, const void* data) {')]}, ['PT902'])
+    assert 'PT902' in codes
+
+
+def test_pt903_mult_form_bound_flagged(tmp_path):
+    """Re-introducing the shipped PR 6 dictionary bounds bug fires PT903."""
+    codes = _mutant_codes(tmp_path, {'rowgroup_reader.cpp': [(
+        'if (uint64_t(pg.num_values) > vlen / w) return kColDict;',
+        'if (uint64_t(pg.num_values) * w > vlen) return kColDict;')]}, ['PT903'])
+    assert codes == ['PT903']
+
+
+def test_pt904_dropped_capacity_check_flagged(tmp_path):
+    """Dropping the aux_cap check before the aux_buf memcpy fires PT904."""
+    codes = _mutant_codes(tmp_path, {'rowgroup_reader.cpp': [(
+        'if (prefix > c->aux_cap || c->aux_buf == nullptr) '
+        'return kColNonUniform;\n    ', '')]}, ['PT904'])
+    assert codes == ['PT904']
+
+
+def test_pt903_cpp_noqa_suppresses(tmp_path):
+    src = SourceFile('<fixture>', 'native/x.cpp', textwrap.dedent('''
+        int check(uint64_t n, uint64_t w, uint64_t cap) {
+          if (n * w > cap) return -1;  // noqa: PT903 - n capped by caller
+          return 0;
+        }
+        '''))
+    findings = [f for f in CppSafetyChecker().check(src)
+                if not src.is_suppressed(f.line, f.code)]
+    assert findings == []
+
+
+def test_abi_checker_ignores_fixture_without_cpp():
+    src = SourceFile('<fixture>', 'native/fused.py',
+                     'import ctypes\nlib = None\n')
+    assert list(AbiConformanceChecker().check(src)) == []
+
+
+# ---------------------------------------------------------------------------
+# the linter meta-test: every registered rule id has committed teeth
+# ---------------------------------------------------------------------------
+
+FIXTURE_ROOT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            'lint_fixtures')
+
+
+@pytest.mark.parametrize('rule', ALL_RULE_CODES)
+def test_rule_fires_on_fixture_and_stays_silent_on_clean_twin(rule):
+    """THE meta-gate: a registered rule must fire on its committed bad
+    fixture and stay silent on the clean twin — registering a toothless (or
+    overreaching) rule fails tier-1."""
+    bad = os.path.join(FIXTURE_ROOT, rule, 'bad')
+    clean = os.path.join(FIXTURE_ROOT, rule, 'clean')
+    assert os.path.isdir(bad) and os.path.isdir(clean), (
+        'rule {} is registered in ALL_CHECKERS but has no committed fixture '
+        'pair under tests/lint_fixtures/{}/ — add bad/ and clean/ trees '
+        'proving it has teeth'.format(rule, rule))
+    bad_codes = {f.code for f in run_analysis([bad])}
+    assert rule in bad_codes, (
+        'rule {} did not fire on its own bad fixture (toothless rule); '
+        'found only: {}'.format(rule, sorted(bad_codes)))
+    clean_codes = {f.code for f in run_analysis([clean])}
+    assert rule not in clean_codes, (
+        'rule {} fired on its clean twin (overreaching rule)'.format(rule))
+
+
+def test_no_orphan_fixture_directories():
+    dirs = {d for d in os.listdir(FIXTURE_ROOT)
+            if os.path.isdir(os.path.join(FIXTURE_ROOT, d))}
+    assert dirs == set(ALL_RULE_CODES), (
+        'fixture dirs and registered rule ids diverged: extra={}, missing={}'
+        .format(sorted(dirs - set(ALL_RULE_CODES)),
+                sorted(set(ALL_RULE_CODES) - dirs)))
+
+
+# ---------------------------------------------------------------------------
 # the tier-1 gate + CLI
 # ---------------------------------------------------------------------------
 
@@ -1139,13 +1305,59 @@ def test_package_tree_is_clean():
 
 
 def test_cli_json_clean_exit():
+    """A clean tree exits 0; the JSONL stream may still carry noqa/baselined
+    findings, but none with status 'open'."""
     proc = subprocess.run(
         [sys.executable, '-m', 'petastorm_tpu.analysis', PKG_DIR,
          '--format', 'json', '--baseline', BASELINE_PATH],
         capture_output=True, text=True, timeout=120)
     assert proc.returncode == 0, proc.stdout + proc.stderr
-    payload = json.loads(proc.stdout)
-    assert payload['count'] == 0
+    records = [json.loads(line) for line in proc.stdout.splitlines()]
+    assert all(r['status'] in ('noqa', 'baselined') for r in records)
+    # the tree uses noqa (with reasons): the machine stream surfaces them
+    assert any(r['status'] == 'noqa' for r in records)
+
+
+def test_cli_json_one_stable_object_per_line(tmp_path):
+    """The documented JSONL contract: one finding per line with the stable
+    key set, status distinguishing open from noqa-suppressed."""
+    bad = tmp_path / 'bad.py'
+    bad.write_text('class C(object):\n'
+                   '    def __eq__(self, other):\n'
+                   '        return True\n'
+                   'class D(object):\n'
+                   '    def __eq__(self, other):  # noqa: PT600 - identity only\n'
+                   '        return True\n')
+    proc = subprocess.run(
+        [sys.executable, '-m', 'petastorm_tpu.analysis', str(bad),
+         '--format', 'json'],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1  # one OPEN finding drives the exit code
+    records = [json.loads(line) for line in proc.stdout.splitlines()]
+    assert len(records) == 2
+    for r in records:
+        assert set(r) == {'rule', 'path', 'line', 'message', 'snippet', 'status'}
+        assert r['rule'] == 'PT600' and r['path'] == 'bad.py'
+    assert sorted(r['status'] for r in records) == ['noqa', 'open']
+
+
+def test_cli_json_baselined_status(tmp_path):
+    bad = tmp_path / 'bad.py'
+    bad.write_text('class C(object):\n'
+                   '    def __eq__(self, other):\n'
+                   '        return True\n')
+    baseline = tmp_path / 'baseline.json'
+    subprocess.run(
+        [sys.executable, '-m', 'petastorm_tpu.analysis', str(bad),
+         '--write-baseline', str(baseline)],
+        capture_output=True, text=True, timeout=120)
+    proc = subprocess.run(
+        [sys.executable, '-m', 'petastorm_tpu.analysis', str(bad),
+         '--format', 'json', '--baseline', str(baseline)],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    records = [json.loads(line) for line in proc.stdout.splitlines()]
+    assert [r['status'] for r in records] == ['baselined']
 
 
 def test_cli_reports_findings_and_exits_1(tmp_path):
