@@ -78,6 +78,7 @@ from petastorm_tpu.analysis.jax_purity import JaxPurityChecker
 from petastorm_tpu.analysis.lifecycle import ResourceLifecycleChecker
 from petastorm_tpu.analysis.locks import LockDisciplineChecker
 from petastorm_tpu.analysis.protocol_lints import ProtocolLintChecker
+from petastorm_tpu.analysis.serve_lints import ServeActuatorChecker
 from petastorm_tpu.analysis.telemetry import TelemetrySpanChecker
 
 #: the full first-party rule set, in rule-id order
@@ -92,6 +93,7 @@ ALL_CHECKERS = (
     BaseExceptionContainmentChecker,
     AutotuneActionChecker,
     ProtocolLintChecker,
+    ServeActuatorChecker,
     AbiConformanceChecker,
     CppSafetyChecker,
 )
@@ -136,7 +138,7 @@ __all__ = [
     'BaseExceptionContainmentChecker', 'Checker', 'CppSafetyChecker',
     'ExceptionHygieneChecker', 'Finding',
     'HashabilityChecker', 'JaxPurityChecker', 'LockDisciplineChecker',
-    'NativeBufferChecker', 'ProtocolLintChecker', 'ResourceLifecycleChecker',
+    'NativeBufferChecker', 'ProtocolLintChecker', 'ResourceLifecycleChecker', 'ServeActuatorChecker',
     'SourceFile', 'TelemetrySpanChecker', 'collect_sources', 'load_baseline',
     'run_analysis', 'run_checkers',
 ]

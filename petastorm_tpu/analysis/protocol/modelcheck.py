@@ -254,6 +254,11 @@ def main(argv=None):
                     'supervision protocol (docs/protocol.md). Exit codes: 0 '
                     'exhausted+clean, 1 violation (minimized trace printed), '
                     '2 usage error, 3 budget ran out before exhaustion.')
+    parser.add_argument('--serve', action='store_true',
+                        help='check the serve fan-out protocol '
+                             '(multi-consumer broadcast-ring invariants, '
+                             'docs/serve.md) instead of the supervision '
+                             'protocol; --mutate then takes a serve mutation')
     parser.add_argument('--workers', type=int, default=DEFAULT_SCOPE['workers'])
     parser.add_argument('--items', type=int, default=DEFAULT_SCOPE['items'])
     parser.add_argument('--crashes', type=int, default=DEFAULT_SCOPE['crashes'])
@@ -264,7 +269,9 @@ def main(argv=None):
     parser.add_argument('--no-publish', action='store_true',
                         help='do not model the payload message as a separate '
                              'step (smaller space, weaker delivery invariant)')
-    parser.add_argument('--mutate', choices=S.MUTATIONS, default=None,
+    from petastorm_tpu.analysis.protocol import serve_spec as SV
+    parser.add_argument('--mutate', choices=S.MUTATIONS + SV.MUTATIONS,
+                        default=None,
                         help='seed one protocol defect; the checker must then '
                              'produce a counterexample')
     parser.add_argument('--budget-s', type=float, default=600.0,
@@ -276,15 +283,50 @@ def main(argv=None):
     parser.add_argument('--json', action='store_true')
     try:
         args = parser.parse_args(argv)
-        cfg = S.SpecConfig(workers=args.workers, items=args.items,
-                           crashes=args.crashes, retries=args.retries,
-                           errors=args.errors, policy=args.policy,
-                           publish=not args.no_publish, mutation=args.mutate)
+        if args.serve:
+            cfg = SV.ServeSpecConfig(mutation=args.mutate,
+                                     **SV.DEFAULT_SERVE_SCOPE)
+        else:
+            cfg = S.SpecConfig(workers=args.workers, items=args.items,
+                               crashes=args.crashes, retries=args.retries,
+                               errors=args.errors, policy=args.policy,
+                               publish=not args.no_publish, mutation=args.mutate)
     except (SystemExit, ValueError) as e:
         if isinstance(e, SystemExit):
             return 2 if e.code else 0
         print('error: {}'.format(e), file=sys.stderr)
         return 2
+
+    if args.serve:
+        result = SV.check(cfg, budget_s=args.budget_s, max_states=args.max_states)
+        if args.json:
+            print(json.dumps(result.to_dict(), indent=2))
+        else:
+            print('serve scope: {}'.format(cfg.describe()))
+            print('explored {} canonical states, {} transitions, depth {}, '
+                  '{} terminal, in {:.2f}s'.format(
+                      result.states, result.transitions, result.depth,
+                      result.terminal_states, result.elapsed_s))
+            if result.violation:
+                print('counterexample ({} steps, invariant: {}):'.format(
+                    len(result.trace), result.violation))
+                for i, label in enumerate(result.trace):
+                    print('  {:>3}. {!r}'.format(i + 1, label))
+            elif result.exhausted:
+                print('exhausted: all invariants hold ({})'.format(
+                    ', '.join(SV.INVARIANTS)))
+            else:
+                print('NOT exhausted: budget ran out — verdict covers only '
+                      'the explored prefix')
+        if result.violation:
+            return 1
+        if not result.exhausted:
+            return 3
+        if args.min_states is not None and result.states < args.min_states:
+            print('state count {} below the declared floor {}'.format(
+                result.states, args.min_states), file=sys.stderr)
+            return 3
+        return 0
 
     result = check(cfg, budget_s=args.budget_s, max_states=args.max_states)
     if args.json:

@@ -163,6 +163,127 @@ class ProtocolMonitor(object):
                     'events_checked': self.violations_checked}
 
 
+class ServeMonitor(object):
+    """Runtime conformance monitor for the serve fan-out plane
+    (``docs/serve.md``, multi-consumer invariant catalog in
+    ``docs/protocol.md``). Each process checks its observable projection of
+    the broadcast protocol:
+
+    * daemon side — a tenant attaches at most once and only detaches/evicts
+      while attached; a stream never publishes the SAME item seq twice (a
+      repeat means a batch was decoded-and-published twice: the retry path
+      may reorder seqs, but never duplicate them); nothing is published to a
+      stream after its END;
+    * consumer side — no seq is delivered twice to this consumer (a duplicate
+      means a ring slot was re-delivered: the released-exactly-once-per-
+      consumer invariant broken), and nothing is delivered after the
+      stream's END frame.
+
+    Violations raise :class:`~petastorm_tpu.errors.ProtocolViolation`.
+    """
+
+    def __init__(self, name='serve'):
+        self._name = name
+        self._lock = threading.Lock()
+        self._attached = set()          # live tenant ids (daemon side)
+        self._seen_tenants = set()
+        self._published = {}            # stream id -> set of published seqs
+        self._ended = set()             # stream ids past their END frame
+        self._delivered = set()         # consumer side: seqs delivered here
+        self._consumer_ended = False
+        self.events_checked = 0
+
+    def _fail(self, message):
+        raise ProtocolViolation('[serve monitor: {}] {}'.format(self._name, message))
+
+    # -- daemon-side events --------------------------------------------------
+
+    def on_attach(self, tenant_id, stream_id):
+        with self._lock:
+            self.events_checked += 1
+            if tenant_id in self._attached:
+                self._fail('tenant {} attached twice'.format(tenant_id))
+            self._attached.add(tenant_id)
+            self._seen_tenants.add(tenant_id)
+
+    def on_detach(self, tenant_id):
+        with self._lock:
+            self.events_checked += 1
+            if tenant_id not in self._attached:
+                self._fail('detach of tenant {} which is not attached — a '
+                           'double detach would free another tenant\'s ring '
+                           'slot'.format(tenant_id))
+            self._attached.discard(tenant_id)
+
+    def on_evict(self, tenant_id):
+        with self._lock:
+            self.events_checked += 1
+            if tenant_id not in self._attached:
+                self._fail('eviction of tenant {} which is not attached'
+                           .format(tenant_id))
+            # an evicted tenant stays 'attached' until its client detaches —
+            # eviction only stops its cursor from constraining the producer
+
+    def on_publish(self, stream_id, seq):
+        with self._lock:
+            self.events_checked += 1
+            if stream_id in self._ended:
+                self._fail('publish on stream {} after its END frame'
+                           .format(stream_id))
+            if seq is not None:
+                seen = self._published.setdefault(stream_id, set())
+                if seq in seen:
+                    self._fail('stream {} published seq {} twice — one decode '
+                               'must publish exactly once (retries may '
+                               'reorder seqs, never duplicate them)'
+                               .format(stream_id, seq))
+                seen.add(seq)
+
+    def on_end(self, stream_id):
+        with self._lock:
+            self.events_checked += 1
+            if stream_id in self._ended:
+                self._fail('stream {} ended twice'.format(stream_id))
+            self._ended.add(stream_id)
+
+    # -- consumer-side events ------------------------------------------------
+
+    def on_deliver(self, seq):
+        with self._lock:
+            self.events_checked += 1
+            if self._consumer_ended:
+                self._fail('batch delivered after the stream END frame')
+            if seq is not None:
+                if seq in self._delivered:
+                    self._fail('batch seq {} delivered twice — the ring '
+                               'delivered a slot twice to this consumer'
+                               .format(seq))
+                self._delivered.add(seq)
+
+    def on_consumer_end(self):
+        with self._lock:
+            self.events_checked += 1
+            if self._consumer_ended:
+                self._fail('stream END delivered twice to this consumer')
+            self._consumer_ended = True
+
+
+def serve_monitor_from_env(explicit, name):
+    """Resolve a serve-side ``monitor`` argument exactly like
+    :func:`monitor_from_env`, honoring ``PSTPU_SERVE_MONITOR`` (with
+    ``PSTPU_PROTOCOL_MONITOR`` as the umbrella opt-in)."""
+    import os
+    if explicit is None:
+        env = os.environ.get('PSTPU_SERVE_MONITOR',
+                             os.environ.get('PSTPU_PROTOCOL_MONITOR', ''))
+        explicit = env not in ('', '0')
+    if not explicit:
+        return None
+    if isinstance(explicit, ServeMonitor):
+        return explicit
+    return ServeMonitor(name=name)
+
+
 def monitor_from_env(explicit, name):
     """Resolve a pool's ``protocol_monitor`` constructor argument: a
     :class:`ProtocolMonitor` instance is used as-is, truthy builds a fresh
@@ -179,4 +300,5 @@ def monitor_from_env(explicit, name):
     return ProtocolMonitor(name=name)
 
 
-__all__ = ['ProtocolMonitor', 'ProtocolViolation', 'monitor_from_env']
+__all__ = ['ProtocolMonitor', 'ProtocolViolation', 'ServeMonitor',
+           'monitor_from_env', 'serve_monitor_from_env']

@@ -60,3 +60,25 @@ class ProtocolViolation(PetastormTpuError):
     message for a never-issued id, a live/stale misclassification, a second
     completion for one item, or diverged accounting at epoch drain. Raised by
     the opt-in runtime conformance monitor (``docs/protocol.md``)."""
+
+
+class ServeError(PetastormTpuError):
+    """Base class for shared-reader-service errors (``docs/serve.md``)."""
+
+
+class ConsumerEvictedError(ServeError):
+    """This consumer lagged beyond the serve daemon's bound and was evicted
+    from the broadcast ring so the rest of the fleet could keep flowing
+    (``docs/serve.md`` — eviction policy). Re-attach with
+    ``make_reader(serve=...)``, consume faster, or raise the daemon's
+    ``ring_bytes``/lag bound. Carries ``tenant_id`` when known."""
+
+    def __init__(self, message, tenant_id=None):
+        super().__init__(message)
+        self.tenant_id = tenant_id
+
+
+class ServeDaemonDiedError(ServeError):
+    """The serve daemon this consumer was attached to is gone (process died
+    or its control endpoint vanished) — raised instead of hanging on a quiet
+    ring. A fresh ``make_reader(serve=...)`` spawns a replacement daemon."""

@@ -526,6 +526,36 @@ def _parse_npy(header_bytes):
     return dtype, shape
 
 
+def column_region(plan, result, expected_rows):
+    """``(dtype_str, row_shape, nbytes)`` describing one successfully-decoded
+    column's bytes IN PLACE (no array built) — the layout descriptor the serve
+    blob fan-out ships to consumers, who view the shared mapping directly.
+    Mirrors :func:`build_column`'s validation; None rejects the column (the
+    caller falls back to the copy path). Columns needing a post-decode astype
+    decline: a dtype conversion is a copy, which this path exists to avoid."""
+    status, out_used, aux0, _aux1, aux_header = result
+    if status != 0:
+        return None
+    if plan.mode == MODE_BINARY_RAW and plan.strip_npy:
+        parsed = _parse_npy(aux_header)
+        if parsed is None:
+            return None
+        dtype, shape = parsed
+        count = int(np.prod(shape, dtype=np.int64)) if shape else 1
+        if count * dtype.itemsize != aux0 or out_used != expected_rows * aux0:
+            return None
+        return dtype.str, (expected_rows,) + shape, out_used
+    if plan.out_dtype is None or plan.out_shape is None:
+        return None
+    if plan.known_size and out_used != plan.out_bound:
+        return None
+    if plan.mode == MODE_BINARY_RAW and aux0 != plan.itemsize:
+        return None
+    if plan.field_dtype is not None and plan.field_dtype != plan.out_dtype:
+        return None
+    return plan.out_dtype.str, plan.out_shape, out_used
+
+
 def build_column(plan, result, out_buf, offset, expected_rows):
     """numpy column for one successfully-decoded plan: a typed view over the
     batch buffer region (fresh writable memory, so the decode()'s

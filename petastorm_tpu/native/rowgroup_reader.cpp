@@ -349,10 +349,18 @@ struct PageInfo {
   int64_t uncompressed_size = -1;
   int64_t compressed_size = -1;
   int64_t num_values = -1;
-  int32_t encoding = -1;           // DataPageHeader.encoding; 0=PLAIN
+  int32_t encoding = -1;           // DataPageHeader(.V2).encoding; 0=PLAIN
   int32_t def_level_encoding = -1; // DataPageHeader field 3; 3=RLE
   int64_t dict_num_values = -1;    // DictionaryPageHeader field 1
   int32_t dict_encoding = -1;      // DictionaryPageHeader field 2; 0/2=PLAIN
+  // DATA_PAGE_V2 only (DataPageHeaderV2, PageHeader field 8): the def/rep
+  // level blocks are an UNCOMPRESSED prefix of the page body with explicit
+  // byte lengths, and compression (field 7, default true) covers the data
+  // region alone
+  int64_t v2_num_nulls = -1;
+  int64_t v2_def_len = -1;
+  int64_t v2_rep_len = -1;
+  int32_t v2_is_compressed = 1;
   uint64_t header_len = 0;
 };
 
@@ -403,6 +411,25 @@ bool parse_page_header(TReader& r, PageInfo* info) {
         if (iid == 1 && itype == 5) info->dict_num_values = r.zigzag();
         else if (iid == 2 && itype == 5) info->dict_encoding = int32_t(r.zigzag());
         else r.skip_value(itype, 0);
+      }
+    } else if (id == 8 && type == 12) {  // DataPageHeaderV2
+      int16_t inner_last = 0;
+      while (r.ok) {
+        const uint8_t ih = r.byte();
+        if (ih == 0) break;
+        const int itype = ih & 0x0F;
+        int16_t iid = (ih >> 4) == 0 ? int16_t(r.zigzag())
+                                     : int16_t(inner_last + (ih >> 4));
+        inner_last = iid;
+        if (iid == 1 && itype == 5) info->num_values = r.zigzag();
+        else if (iid == 2 && itype == 5) info->v2_num_nulls = r.zigzag();
+        else if (iid == 4 && itype == 5) info->encoding = int32_t(r.zigzag());
+        else if (iid == 5 && itype == 5) info->v2_def_len = r.zigzag();
+        else if (iid == 6 && itype == 5) info->v2_rep_len = r.zigzag();
+        else if (iid == 7 && (itype == 1 || itype == 2)) {
+          // compact-protocol bool: the value IS the type nibble (1=true)
+          info->v2_is_compressed = itype == 1 ? 1 : 0;
+        } else r.skip_value(itype, 0);
       }
     } else {
       r.skip_value(type, 0);
@@ -699,6 +726,13 @@ struct PageRec {
   uint64_t body_len;   // compressed size
   uint64_t plain_len;  // uncompressed size
   bool is_dict;
+  // DATA_PAGE_V2: rep+def levels are an uncompressed prefix of the body
+  // (skipped by explicit length — num_nulls == 0 is checked at scan time, so
+  // the all-ones def levels carry no information), and `v2_compressed`
+  // scopes the chunk codec to the data region alone
+  bool is_v2 = false;
+  bool v2_compressed = false;
+  uint64_t levels_len = 0;
 };
 
 int scan_fused_pages(const FusedCol& c, int max_pages, std::vector<PageRec>* pages) {
@@ -735,8 +769,27 @@ int scan_fused_pages(const FusedCol& c, int max_pages, std::vector<PageRec>* pag
       rec.encoding = info.encoding;
       rec.num_values = info.num_values;
       rec.is_dict = false;
+    } else if (info.page_type == 3) {  // data page v2
+      if (info.encoding != 0 && info.encoding != 2 && info.encoding != 8) {
+        return kColEncoding;
+      }
+      if (info.num_values < 0 || info.v2_def_len < 0 || info.v2_rep_len < 0) {
+        return kColParse;
+      }
+      // v2 headers state num_nulls explicitly: only a proven-null-free page
+      // fuses (the v1 path needs chunk statistics for the same proof), and a
+      // flat column's rep levels are zero-length by construction
+      if (info.v2_num_nulls != 0) return kColDefLevels;
+      const uint64_t levels = uint64_t(info.v2_def_len) + uint64_t(info.v2_rep_len);
+      if (levels > rec.body_len || levels > rec.plain_len) return kColDefLevels;
+      rec.encoding = info.encoding;
+      rec.num_values = info.num_values;
+      rec.is_dict = false;
+      rec.is_v2 = true;
+      rec.v2_compressed = info.v2_is_compressed != 0;
+      rec.levels_len = levels;
     } else {
-      return kColPageType;  // v2 / index / unknown pages: Arrow path
+      return kColPageType;  // index / unknown pages: Arrow path
     }
     if (int(pages->size()) >= max_pages) return kColPageCap;
     pages->push_back(rec);
@@ -753,6 +806,27 @@ int page_values(const FusedCol& c, const PageRec& pg, std::vector<uint8_t>* scra
                 const uint8_t** vals, uint64_t* vlen) {
   const uint8_t* base = c.chunk + pg.body_off;
   uint64_t len = pg.body_len;
+  if (pg.is_v2) {
+    // v2 layout: [rep levels][def levels] UNCOMPRESSED, then the data region
+    // (compressed only when the header's is_compressed flag says so). The
+    // level lengths were bounds-checked against body/plain size at scan time.
+    const uint8_t* data = base + pg.levels_len;
+    const uint64_t data_len = len - pg.levels_len;
+    const uint64_t plain_data = pg.plain_len - pg.levels_len;
+    if (pg.v2_compressed && c.codec == kCodecSnappy) {
+      scratch->resize(size_t(plain_data));
+      if (!snappy_uncompress(data, data_len, scratch->data(), plain_data)) {
+        return kColParse;
+      }
+      *vals = scratch->data();
+      *vlen = plain_data;
+      return kColOk;
+    }
+    if (pg.v2_compressed && c.codec != kCodecUncompressed) return kColCompressed;
+    *vals = data;
+    *vlen = data_len;
+    return kColOk;
+  }
   if (c.codec == kCodecSnappy) {
     scratch->resize(size_t(pg.plain_len));
     if (!snappy_uncompress(base, len, scratch->data(), pg.plain_len)) {

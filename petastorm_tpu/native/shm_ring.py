@@ -43,6 +43,91 @@ class RingHeaderStruct(ctypes.Structure):
 #: byte offset of the ring's data area inside the shm segment
 RING_HEADER_BYTES = ctypes.sizeof(RingHeaderStruct)
 
+#: broadcast-ring consumer slots per segment (must match kBcastSlots in
+#: shm_ring.cpp — PT900 proves the whole header layout, which pins this too)
+BCAST_MAX_CONSUMERS = 8
+
+
+class BcastHeaderStruct(ctypes.Structure):
+    """Field-for-field mirror of ``struct BcastHeader`` (shm_ring.cpp) — the
+    multi-consumer broadcast segment both the serve daemon and its attached
+    consumers map. As with :class:`RingHeaderStruct`, Python never touches the
+    header directly; the mirror is executable documentation of the
+    cross-process layout, and lint rule PT900 proves it identical to the C
+    struct so a C-side edit that would desynchronize producer and consumer
+    mappings fails the linter instead of corrupting rings at runtime."""
+
+    _fields_ = [
+        ('tail', ctypes.c_uint64),
+        ('capacity', ctypes.c_uint64),
+        ('magic', ctypes.c_uint64),
+        ('max_consumers', ctypes.c_uint64),
+        ('epoch', ctypes.c_uint64),
+        ('pad0', ctypes.c_char * 24),
+        ('heads', ctypes.c_uint64 * 8),
+        ('states', ctypes.c_uint64 * 8),
+        ('gens', ctypes.c_uint64 * 8),
+    ]
+
+
+#: byte offset of the broadcast ring's data area inside the shm segment
+BCAST_HEADER_BYTES = ctypes.sizeof(BcastHeaderStruct)
+
+
+class IdleWait(object):
+    """Escalating wait for ring poll loops: spin → ``sched_yield`` → sleep.
+
+    The consumer/producer wait loops used to be flat sleep-poll backoffs; on a
+    host running many attached serve consumers the aggregate idle polling
+    burns cores while the producer is quiet. This helper keeps the first
+    misses latency-free (pure spins), yields the core for the next tier, and
+    escalates to exponentially longer sleeps only when the peer is genuinely
+    idle. Spins are accounted to the ``ring_idle_spins`` counter (flushed in
+    batches so the hot loop never touches the metrics lock per iteration).
+
+    Call :meth:`wait` per empty poll and :meth:`reset` on progress.
+    """
+
+    __slots__ = ('_spins', '_yields', '_sleep_s', '_max_sleep_s', '_misses',
+                 '_cur_sleep', '_pending_spins')
+
+    def __init__(self, spins=64, yields=64, sleep_s=0.0002, max_sleep_s=0.002):
+        self._spins = spins
+        self._yields = yields
+        self._sleep_s = sleep_s
+        self._max_sleep_s = max_sleep_s
+        self._misses = 0
+        self._cur_sleep = sleep_s
+        self._pending_spins = 0
+
+    def _flush(self):
+        if self._pending_spins:
+            from petastorm_tpu import observability as obs
+            obs.count('ring_idle_spins', self._pending_spins)
+            self._pending_spins = 0
+
+    def wait(self):
+        """One empty-poll step: spin, yield, or sleep per the escalation."""
+        self._misses += 1
+        if self._misses <= self._spins:
+            self._pending_spins += 1
+            return
+        if self._misses <= self._spins + self._yields:
+            import os
+            os.sched_yield()
+            return
+        if self._misses == self._spins + self._yields + 1:
+            self._flush()  # entering the sleep tier: the peer is idle
+        time.sleep(self._cur_sleep)
+        self._cur_sleep = min(self._cur_sleep * 2, self._max_sleep_s)
+
+    def reset(self):
+        """Progress was made: restart the escalation at the spin tier."""
+        if self._misses:
+            self._flush()
+            self._misses = 0
+            self._cur_sleep = self._sleep_s
+
 
 def _load_library():
     global _lib, _load_failed
@@ -88,6 +173,52 @@ def _load_library():
         lib.pstpu_ring_read.restype = ctypes.c_int64
         lib.pstpu_ring_read.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64]
         lib.pstpu_ring_close.argtypes = [ctypes.c_void_p]
+        # broadcast (single-producer, multi-consumer) ring — the serve
+        # daemon's fan-out transport
+        lib.pstpu_bcast_create.restype = ctypes.c_void_p
+        lib.pstpu_bcast_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+        lib.pstpu_bcast_attach.restype = ctypes.c_void_p
+        lib.pstpu_bcast_attach.argtypes = [ctypes.c_char_p]
+        lib.pstpu_bcast_capacity.restype = ctypes.c_uint64
+        lib.pstpu_bcast_capacity.argtypes = [ctypes.c_void_p]
+        lib.pstpu_bcast_join.restype = ctypes.c_int64
+        lib.pstpu_bcast_join.argtypes = [ctypes.c_void_p]
+        lib.pstpu_bcast_leave.restype = ctypes.c_int64
+        lib.pstpu_bcast_leave.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.pstpu_bcast_evict.restype = ctypes.c_int64
+        lib.pstpu_bcast_evict.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.pstpu_bcast_state.restype = ctypes.c_int64
+        lib.pstpu_bcast_state.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.pstpu_bcast_lag.restype = ctypes.c_int64
+        lib.pstpu_bcast_lag.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.pstpu_bcast_consumer_count.restype = ctypes.c_int64
+        lib.pstpu_bcast_consumer_count.argtypes = [ctypes.c_void_p]
+        lib.pstpu_bcast_free_space.restype = ctypes.c_uint64
+        lib.pstpu_bcast_free_space.argtypes = [ctypes.c_void_p]
+        lib.pstpu_bcast_tail.restype = ctypes.c_uint64
+        lib.pstpu_bcast_tail.argtypes = [ctypes.c_void_p]
+        lib.pstpu_bcast_min_head.restype = ctypes.c_uint64
+        lib.pstpu_bcast_min_head.argtypes = [ctypes.c_void_p]
+        lib.pstpu_bcast_write.restype = ctypes.c_int
+        lib.pstpu_bcast_write.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                          ctypes.c_uint64]
+        lib.pstpu_bcast_writev.restype = ctypes.c_int
+        lib.pstpu_bcast_writev.argtypes = [ctypes.c_void_p,
+                                           ctypes.POINTER(ctypes.c_void_p),
+                                           ctypes.POINTER(ctypes.c_uint64),
+                                           ctypes.c_int32]
+        lib.pstpu_bcast_reserve.restype = ctypes.c_void_p
+        lib.pstpu_bcast_reserve.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                                            ctypes.POINTER(ctypes.c_int32)]
+        lib.pstpu_bcast_commit.restype = ctypes.c_int
+        lib.pstpu_bcast_commit.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.pstpu_bcast_abort.argtypes = [ctypes.c_void_p]
+        lib.pstpu_bcast_next_len.restype = ctypes.c_int64
+        lib.pstpu_bcast_next_len.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.pstpu_bcast_read.restype = ctypes.c_int64
+        lib.pstpu_bcast_read.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                         ctypes.c_void_p, ctypes.c_uint64]
+        lib.pstpu_bcast_close.argtypes = [ctypes.c_void_p]
         _lib = lib
         return _lib
 
@@ -288,4 +419,217 @@ class ShmRing(object):
     def close(self):
         if self._handle:
             self._lib.pstpu_ring_close(self._handle)
+            self._handle = None
+
+
+#: broadcast consumer-slot states (mirror kSlot* in shm_ring.cpp)
+BCAST_ATTACHED = 1
+BCAST_EVICTED = 2
+
+
+class BcastConsumerGone(Exception):
+    """Raised by consumer-side reads whose slot was evicted or freed. ``evicted``
+    distinguishes a producer-side eviction (too slow; docs/serve.md) from a
+    token invalidated by a detach."""
+
+    def __init__(self, message, evicted):
+        super().__init__(message)
+        self.evicted = evicted
+
+
+class BcastRing(object):
+    """One single-producer / multi-consumer broadcast ring in POSIX shared
+    memory (the serve daemon's fan-out transport, ``docs/serve.md``).
+
+    A published message is logically reference-counted across the attached
+    consumers: each consumer's read cursor advance IS its release, and the
+    bytes are reclaimed when the slowest attached cursor passes them. Consumer
+    slots are granted by the PRODUCER (:meth:`join` runs daemon-side between
+    writes — the control-plane round trip is what keeps a join from racing a
+    write); consumers attach the mapping with :meth:`attach` and read with the
+    granted token. The producer may :meth:`evict` a lagging consumer, whose
+    next read raises :class:`BcastConsumerGone` instead of stalling the fleet.
+    """
+
+    def __init__(self, handle, lib):
+        self._handle = handle
+        self._lib = lib
+
+    @classmethod
+    def create(cls, name, capacity=DEFAULT_RING_BYTES):
+        lib = _load_library()
+        if lib is None:
+            raise RuntimeError('shm ring library not available')
+        handle = lib.pstpu_bcast_create(name.encode(), capacity)
+        if not handle:
+            raise OSError('bcast ring create failed: {}'.format(
+                lib.pstpu_ring_last_error().decode()))
+        return cls(handle, lib)
+
+    @classmethod
+    def attach(cls, name):
+        lib = _load_library()
+        if lib is None:
+            raise RuntimeError('shm ring library not available')
+        handle = lib.pstpu_bcast_attach(name.encode())
+        if not handle:
+            raise OSError('bcast ring attach failed: {}'.format(
+                lib.pstpu_ring_last_error().decode()))
+        return cls(handle, lib)
+
+    @property
+    def capacity(self):
+        return self._lib.pstpu_bcast_capacity(self._handle)
+
+    # -- producer side -------------------------------------------------------
+
+    def join(self):
+        """Grant a consumer slot (PRODUCER side, between writes). Returns the
+        consumer token, or raises OSError when every slot is taken."""
+        token = self._lib.pstpu_bcast_join(self._handle)
+        if token < 0:
+            raise OSError('bcast ring has no free consumer slots '
+                          '({} max)'.format(BCAST_MAX_CONSUMERS))
+        return token
+
+    def leave(self, token):
+        """Release a consumer slot (either side; idempotent for stale tokens).
+        True when the token was still valid."""
+        return self._lib.pstpu_bcast_leave(self._handle, token) == 0
+
+    def evict(self, token):
+        """PRODUCER side: mark a lagging consumer evicted — its cursor stops
+        bounding the producer; its next read raises BcastConsumerGone."""
+        return self._lib.pstpu_bcast_evict(self._handle, token) == 0
+
+    def state(self, token):
+        """1 attached, 2 evicted, 0 freed, -1 stale token."""
+        return self._lib.pstpu_bcast_state(self._handle, token)
+
+    def lag(self, token):
+        """Unconsumed bytes behind the producer for one consumer (-1 stale)."""
+        return self._lib.pstpu_bcast_lag(self._handle, token)
+
+    def consumer_count(self):
+        """Attached consumers; 0 for a closed ring (teardown paths probe this
+        before writing, so close-vs-publish races resolve to a dropped frame,
+        never a call on a dead handle)."""
+        if not self._handle:
+            return 0
+        return self._lib.pstpu_bcast_consumer_count(self._handle)
+
+    def free_space(self):
+        return self._lib.pstpu_bcast_free_space(self._handle)
+
+    def tail(self):
+        """Monotonic producer position (bytes published incl. framing)."""
+        return self._lib.pstpu_bcast_tail(self._handle)
+
+    def min_head(self):
+        """Slowest attached cursor (== tail with nobody attached): the fleet
+        has consumed everything below this position. The serve daemon's blob
+        GC keys on it. 0 for a closed ring."""
+        if not self._handle:
+            return 0
+        return self._lib.pstpu_bcast_min_head(self._handle)
+
+    def try_write(self, data):
+        """True = broadcast to every attached consumer; False = some consumer
+        is too far behind (caller retries / evicts). Raises when the message
+        can never fit."""
+        rc = self._lib.pstpu_bcast_write(self._handle, data, len(data))
+        if rc < 0:
+            raise ValueError('message of {} bytes exceeds bcast ring capacity {} — '
+                             'increase serve ring_bytes'.format(len(data), self.capacity))
+        return rc == 1
+
+    def try_writev(self, parts):
+        """Gather write of N bytes-like/ndarray segments as one broadcast
+        message (zero-join publish; same contract as ShmRing.try_writev)."""
+        ptrs, lens, total, keepalive = ShmRing._gather(parts)
+        rc = self._lib.pstpu_bcast_writev(self._handle, ptrs, lens, len(parts))
+        del keepalive
+        if rc < 0:
+            raise ValueError('message of {} bytes exceeds bcast ring capacity {} — '
+                             'increase serve ring_bytes'.format(total, self.capacity))
+        return rc == 1
+
+    def try_reserve(self, max_len):
+        """In-place publish channel (PR 6 contract, preserved on the fan-out
+        transport): a contiguous writable slot of ``max_len`` payload bytes,
+        or None when a consumer is too far behind; raises ValueError when it
+        can never fit."""
+        status = ctypes.c_int32(0)
+        ptr = self._lib.pstpu_bcast_reserve(self._handle, max_len,
+                                            ctypes.byref(status))
+        if status.value < 0:
+            raise ValueError('reservation of {} bytes cannot fit bcast ring capacity '
+                             '{} — increase serve ring_bytes'.format(max_len, self.capacity))
+        if not ptr:
+            return None
+        return memoryview((ctypes.c_char * max_len).from_address(ptr)).cast('B')  # noqa: PT500 - producer-side slot, ring outlives it
+
+    def commit(self, actual_len):
+        if self._lib.pstpu_bcast_commit(self._handle, actual_len) != 0:
+            raise ValueError('bcast commit failed: {}'.format(
+                self._lib.pstpu_ring_last_error().decode()))
+
+    def abort(self):
+        self._lib.pstpu_bcast_abort(self._handle)
+
+    # -- consumer side -------------------------------------------------------
+
+    def next_len(self, token):
+        """Length of this consumer's next message; -1 empty. Raises
+        BcastConsumerGone on eviction / stale token."""
+        n = self._lib.pstpu_bcast_next_len(self._handle, token)
+        if n == -3:
+            raise BcastConsumerGone('consumer evicted from bcast ring (lagged '
+                                    'beyond the producer bound)', evicted=True)
+        if n == -4:
+            raise BcastConsumerGone('bcast consumer token is stale (slot freed '
+                                    'or re-granted)', evicted=False)
+        return n
+
+    def try_read_view(self, token):
+        """One message for this consumer as a fresh writable memoryview, or
+        None when nothing is waiting. Raises BcastConsumerGone on eviction /
+        stale token; torn reads from a concurrent eviction are discarded by
+        the native seqlock validation, never delivered."""
+        n = self.next_len(token)
+        if n < 0:
+            return None
+        buf = ctypes.create_string_buffer(int(n))
+        got = self._lib.pstpu_bcast_read(self._handle, token, buf, n)
+        if got == -3:
+            raise BcastConsumerGone('consumer evicted from bcast ring (lagged '
+                                    'beyond the producer bound)', evicted=True)
+        if got == -4:
+            raise BcastConsumerGone('bcast consumer token is stale (slot freed '
+                                    'or re-granted)', evicted=False)
+        if got < 0:
+            return None  # raced (message grew past our probe): re-poll
+        return memoryview(buf)[:got]  # noqa: PT500 - fresh writable buffer per message
+
+    def read_view(self, token, stop_check=None, timeout_s=None):
+        """Blocking :meth:`try_read_view` with spin→yield→sleep escalation
+        (:class:`IdleWait`). Returns None on stop/timeout."""
+        idle = IdleWait()
+        deadline = (time.monotonic() + timeout_s) if timeout_s is not None else None
+        while True:
+            view = self.try_read_view(token)
+            if view is not None:
+                idle.reset()
+                return view
+            if stop_check is not None and stop_check():
+                idle.reset()
+                return None
+            if deadline is not None and time.monotonic() >= deadline:
+                idle.reset()
+                return None
+            idle.wait()
+
+    def close(self):
+        if self._handle:
+            self._lib.pstpu_bcast_close(self._handle)
             self._handle = None

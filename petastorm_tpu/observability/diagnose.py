@@ -90,6 +90,89 @@ def format_fused_fallbacks(diagnostics):
     return '\n'.join(lines)
 
 
+def serve_tenant_table(stats):
+    """``{tenant_id: row}`` parsed from a serve daemon's stats document
+    (``ReaderService.stats()`` / the control-plane ``stats`` op): per-tenant
+    batches/bytes served, shared-decode hits, eviction flag, and the owning
+    stream's fair-share occupancy (docs/serve.md)."""
+    table = {}
+    for stream_id, stream in (stats or {}).get('streams', {}).items():
+        occupancy = stream.get('fair_share', {}).get('occupancy')
+        for tenant_id, t in stream.get('tenants', {}).items():
+            table[tenant_id] = {
+                'stream': stream_id[:8],
+                'dataset': stream.get('dataset_url'),
+                'batches': t.get('batches_served', 0),
+                'mbytes': round(t.get('bytes_served', 0) / 1e6, 1),
+                'shared_hits': t.get('shared_decode_hits', 0),
+                'weight': t.get('weight', 1),
+                'occupancy': occupancy,
+                'evicted': t.get('evicted', False),
+            }
+    return table
+
+
+def format_serve_tenants(stats):
+    """Human-readable per-tenant serving table (empty string when the daemon
+    serves no tenants)."""
+    table = serve_tenant_table(stats)
+    if not table:
+        return ''
+    lines = ['serve tenants (batches / MB served, shared-decode hits, '
+             'fair-share occupancy; docs/serve.md):',
+             '  {:<8} {:<9} {:>8} {:>9} {:>12} {:>7} {:>10} {:>8}'.format(
+                 'tenant', 'stream', 'batches', 'MB', 'shared_hits', 'weight',
+                 'occupancy', 'evicted')]
+    for tenant_id in sorted(table):
+        row = table[tenant_id]
+        lines.append('  {:<8} {:<9} {:>8} {:>9} {:>12} {:>7} {:>10} {:>8}'.format(
+            tenant_id, row['stream'], row['batches'], row['mbytes'],
+            row['shared_hits'], row['weight'],
+            '-' if row['occupancy'] is None else row['occupancy'],
+            'YES' if row['evicted'] else ''))
+    lines.append('  evictions total: {}'.format((stats or {}).get('evictions', 0)))
+    return '\n'.join(lines)
+
+
+def diagnose_serve(service_dir, as_json=False, stream=None):
+    """Connect to the serve daemon under ``service_dir`` and print its
+    per-tenant serving table + pool diagnostics. Returns 0, or 1 when no
+    daemon is reachable."""
+    stream = stream if stream is not None else sys.stdout
+    from petastorm_tpu.serve.service import read_endpoint
+    endpoint = read_endpoint(service_dir)
+    if endpoint is None:
+        print('no serve daemon endpoint under {} (is the daemon running?)'
+              .format(service_dir), file=stream)
+        return 1
+    from multiprocessing.connection import Client
+    try:
+        conn = Client(endpoint['address'], family='AF_UNIX')
+    except (OSError, ConnectionError) as e:
+        print('serve daemon endpoint {} unreachable: {}'.format(
+            endpoint['address'], e), file=stream)
+        return 1
+    try:
+        conn.send({'op': 'stats'})
+        reply = conn.recv()
+    finally:
+        conn.close()
+    stats = reply.get('stats', {}) if reply.get('ok') else {}
+    if as_json:
+        print(json.dumps({'serve_stats': stats,
+                          'tenants': serve_tenant_table(stats)}), file=stream)
+        return 0
+    table = format_serve_tenants(stats)
+    print(table if table else 'serve daemon pid {} is up with no tenants'.format(
+        stats.get('pid')), file=stream)
+    pool = stats.get('pool', {})
+    if pool:
+        print('daemon pool:', file=stream)
+        for key in sorted(pool):
+            print('  {} = {}'.format(key, pool[key]), file=stream)
+    return 0
+
+
 def watch(dataset_url, interval_s=2.0, ticks=None, batch_size=64,
           pool_type='thread', workers_count=3, telemetry='counters',
           use_batch_reader=False, reader_kwargs=None, as_json=False,
@@ -183,7 +266,11 @@ def main(argv=None):
         prog='petastorm-tpu-diagnose',
         description='Measure a short read of the dataset and attribute input '
                     'stalls to pipeline stages.')
-    parser.add_argument('dataset_url')
+    parser.add_argument('dataset_url', nargs='?', default=None)
+    parser.add_argument('--serve', metavar='SERVICE_DIR', default=None,
+                        help='instead of reading a dataset, connect to the '
+                             'serve daemon under SERVICE_DIR and print its '
+                             'per-tenant serving table (docs/serve.md)')
     parser.add_argument('--batch-size', type=int, default=64)
     parser.add_argument('--batches', type=int, default=50)
     parser.add_argument('-p', '--pool-type', choices=('thread', 'process', 'dummy'),
@@ -207,6 +294,11 @@ def main(argv=None):
                         help='with --watch: stop after this many rendered '
                              'ticks (0 = run until interrupted)')
     args = parser.parse_args(argv)
+
+    if args.serve is not None:
+        return diagnose_serve(args.serve, as_json=args.as_json)
+    if args.dataset_url is None:
+        parser.error('dataset_url is required (or pass --serve SERVICE_DIR)')
 
     if args.watch is not None:
         watch(args.dataset_url, interval_s=args.watch,

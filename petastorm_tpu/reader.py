@@ -132,7 +132,8 @@ def make_reader(dataset_url,
                 telemetry=None,
                 autotune=None,
                 on_error='raise', max_item_retries=None,
-                protocol_monitor=None):
+                protocol_monitor=None,
+                serve=None, serve_weight=1):
     """Reader for datasets written by :func:`materialize_dataset` — rows decoded
     through the stored Unischema's codecs (reference reader.py:50-174).
 
@@ -219,7 +220,40 @@ def make_reader(dataset_url,
         env var. Any event sequence the protocol spec rejects raises
         :class:`~petastorm_tpu.errors.ProtocolViolation` on the iterating
         thread.
+    :param serve: read through the per-host SHARED reader service instead of
+        a private pipeline (``docs/serve.md``): ``'auto'`` spawns-or-joins the
+        per-user daemon, a path uses that service directory (hermetic daemons
+        for tests/CI). N collocated jobs on one dataset then share ONE decode:
+        the daemon fans finished batches out over a broadcast shm ring and
+        returns a drop-in :class:`~petastorm_tpu.serve.ServedReader`.
+        ``reader_pool_type``/``workers_count`` shape the daemon when this call
+        spawns it (an already-running daemon keeps its fleet). Not supported
+        with ``serve``: ``resume_state`` and ``autotune``.
+    :param serve_weight: this consumer's fair-share weight in the daemon's
+        scheduler (>= 1; a weight-2 tenant's stream gets twice the decode
+        share of a weight-1 tenant's under contention).
     """
+    if serve:
+        return _make_served(dataset_url, batch_reader=False,
+                            schema_fields=schema_fields, seed=seed,
+                            shuffle_row_groups=shuffle_row_groups,
+                            shuffle_row_drop_partitions=shuffle_row_drop_partitions,
+                            predicate=predicate, rowgroup_selector=rowgroup_selector,
+                            num_epochs=num_epochs, cur_shard=cur_shard,
+                            shard_count=shard_count, cache_type=cache_type,
+                            cache_location=cache_location,
+                            cache_size_limit=cache_size_limit,
+                            cache_row_size_estimate=cache_row_size_estimate,
+                            transform_spec=transform_spec, ngram=ngram,
+                            output=output, batch_size=batch_size,
+                            drop_last=drop_last, resume_state=resume_state,
+                            storage_retry_policy=storage_retry_policy,
+                            chunk_cache=chunk_cache,
+                            chunk_cache_size_limit=chunk_cache_size_limit,
+                            telemetry=telemetry, autotune=autotune,
+                            serve=serve, serve_weight=serve_weight,
+                            reader_pool_type=reader_pool_type,
+                            workers_count=workers_count)
     error_policy = _resolve_error_policy(on_error, max_item_retries)
     try:
         schema = dataset_metadata.get_schema(dataset_url, retry_policy=storage_retry_policy)
@@ -269,6 +303,72 @@ def make_reader(dataset_url,
                   autotune=autotune)
 
 
+def _make_served(dataset_url, batch_reader, schema_fields, seed,
+                 shuffle_row_groups, shuffle_row_drop_partitions, predicate,
+                 rowgroup_selector, num_epochs, cur_shard, shard_count,
+                 cache_type, cache_location, cache_size_limit,
+                 cache_row_size_estimate, transform_spec, ngram, output,
+                 batch_size, drop_last, resume_state, storage_retry_policy,
+                 chunk_cache, chunk_cache_size_limit, telemetry, autotune,
+                 serve, serve_weight, reader_pool_type, workers_count):
+    """The ``serve=`` path of the reader factories: validate the combination,
+    assemble the canonical stream spec, and attach through the shared daemon
+    (``docs/serve.md``). The consumer-side results assembly (rows / columnar /
+    rebatch) is identical to the private path — same factories, same readers —
+    which is what makes :class:`~petastorm_tpu.serve.ServedReader` drop-in."""
+    if resume_state is not None:
+        raise ValueError('resume_state is not supported with serve=: the read '
+                         'position belongs to the shared stream (docs/serve.md)')
+    if autotune:
+        raise ValueError('autotune is not supported with serve=: the daemon '
+                         'owns the shared worker fleet')
+    obs.configure(telemetry)
+    columnar_ngram = output == 'columnar' and ngram is not None
+    if output not in ('rows', 'columnar'):
+        raise ValueError("output must be 'rows' or 'columnar', got {!r}".format(output))
+    if output == 'rows' and batch_size is not None:
+        raise ValueError("batch_size requires output='columnar'")
+    if columnar_ngram:
+        if batch_size is not None:
+            raise ValueError('batch_size rebatching is not supported with ngram')
+        results_queue_reader_factory = (
+            lambda out_schema: NgramBlockResultsQueueReader(out_schema, ngram))
+    elif batch_reader:
+        results_queue_reader_factory = _columnar_results_reader_factory(
+            'columnar', batch_size, drop_last, None)
+    else:
+        results_queue_reader_factory = _columnar_results_reader_factory(
+            output, batch_size, drop_last,
+            lambda out_schema: RowResultsQueueReader(out_schema, ngram))
+    cache = _make_cache(cache_type, cache_location, cache_size_limit,
+                        cache_row_size_estimate)
+    spec = {
+        'dataset_url': dataset_url,
+        'batch_reader': batch_reader,
+        'schema_fields': schema_fields,
+        'seed': seed,
+        'shuffle_row_groups': shuffle_row_groups,
+        'shuffle_row_drop_partitions': shuffle_row_drop_partitions,
+        'predicate': predicate,
+        'rowgroup_selector': rowgroup_selector,
+        'num_epochs': num_epochs,
+        'cur_shard': cur_shard,
+        'shard_count': shard_count,
+        'transform_spec': transform_spec,
+        'ngram': ngram,
+        'columnar_ngram': columnar_ngram,
+        'storage_retry_policy': storage_retry_policy,
+        'chunk_cache': chunk_cache,
+        'chunk_cache_size_limit': chunk_cache_size_limit,
+        'cache': cache,
+    }
+    from petastorm_tpu.serve.client import make_served_reader
+    return make_served_reader(
+        spec, serve, results_queue_reader_factory, weight=serve_weight,
+        spawn_args={'pool_type': reader_pool_type,
+                    'workers_count': workers_count})
+
+
 def make_batch_reader(dataset_url,
                       schema_fields=None,
                       reader_pool_type='thread', workers_count=10, results_queue_size=50,
@@ -287,7 +387,8 @@ def make_batch_reader(dataset_url,
                       telemetry=None,
                       autotune=None,
                       on_error='raise', max_item_retries=None,
-                      protocol_monitor=None):
+                      protocol_monitor=None,
+                      serve=None, serve_weight=1):
     """Columnar reader for ANY Parquet store (reference reader.py:177-289):
     yields one namedtuple of numpy column arrays per row group
     (``batched_output=True``). Schema is inferred from the Arrow schema unless
@@ -314,7 +415,31 @@ def make_batch_reader(dataset_url,
     ``protocol_monitor``: opt-in runtime conformance checking of the pool
     supervision protocol (docs/protocol.md) — identical semantics to
     :func:`make_reader`.
+
+    ``serve``/``serve_weight``: read through the per-host shared reader
+    service (docs/serve.md) — identical semantics to :func:`make_reader`.
     """
+    if serve:
+        return _make_served(dataset_url, batch_reader=True,
+                            schema_fields=schema_fields, seed=seed,
+                            shuffle_row_groups=shuffle_row_groups,
+                            shuffle_row_drop_partitions=shuffle_row_drop_partitions,
+                            predicate=predicate, rowgroup_selector=None,
+                            num_epochs=num_epochs, cur_shard=cur_shard,
+                            shard_count=shard_count, cache_type=cache_type,
+                            cache_location=cache_location,
+                            cache_size_limit=cache_size_limit,
+                            cache_row_size_estimate=cache_row_size_estimate,
+                            transform_spec=transform_spec, ngram=None,
+                            output='columnar', batch_size=batch_size,
+                            drop_last=drop_last, resume_state=resume_state,
+                            storage_retry_policy=storage_retry_policy,
+                            chunk_cache=chunk_cache,
+                            chunk_cache_size_limit=chunk_cache_size_limit,
+                            telemetry=telemetry, autotune=autotune,
+                            serve=serve, serve_weight=serve_weight,
+                            reader_pool_type=reader_pool_type,
+                            workers_count=workers_count)
     error_policy = _resolve_error_policy(on_error, max_item_retries)
     schema = dataset_metadata.infer_or_load_unischema(dataset_url,
                                                       retry_policy=storage_retry_policy)
@@ -408,17 +533,12 @@ class Reader(object):
                 'selector, or reduce shard_count.'.format(dataset_url, cur_shard, shard_count))
         self._pieces = pieces
 
-        # (5) ventilator + pool
+        # (5) ventilator + pool — the item list is the same plan the serve
+        # broker builds per stream (serve/plan.py)
+        from petastorm_tpu.serve.plan import build_work_items
         from petastorm_tpu.workers.ventilator import ConcurrentVentilator
-        items = []
-        for piece_index in range(len(pieces)):
-            for drop_part in range(shuffle_row_drop_partitions):
-                item = {'piece_index': piece_index}
-                if worker_predicate is not None:
-                    item['worker_predicate'] = worker_predicate
-                if shuffle_row_drop_partitions > 1:
-                    item['shuffle_row_drop_partition'] = (drop_part, shuffle_row_drop_partitions)
-                items.append(item)
+        items = build_work_items(len(pieces), shuffle_row_drop_partitions,
+                                 worker_predicate)
         if resume_state is not None:
             self._validate_resume_state(resume_state, dataset_url, len(pieces), len(items))
         self._num_items = len(items)

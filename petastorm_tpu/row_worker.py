@@ -119,9 +119,11 @@ class RowGroupDecoderWorker(WorkerBase):
         if worker_predicate is None and shuffle_row_drop_partition is None:
             if (args['transform_spec'] is None and ngram is None
                     and isinstance(cache, NullCache)
-                    and self._publish_fused_inplace(piece, needed)):
-                # the whole batch was decoded straight into the shm-ring slot
-                # the consumer maps; the publish was a header write
+                    and (self._publish_fused_blob(piece, needed)
+                         or self._publish_fused_inplace(piece, needed))):
+                # the whole batch was decoded straight into shared memory
+                # (serve fan-out blob, or the shm-ring slot the consumer
+                # maps); the publish was a layout descriptor / header write
                 return
             key = _cache_key(args['dataset_path'], piece, needed,
                              getattr(args['transform_spec'], 'image_decode_hints', None),
@@ -293,6 +295,69 @@ class RowGroupDecoderWorker(WorkerBase):
                          piece.path, piece.row_group, e)
             return {}
         return block
+
+    def _publish_fused_blob(self, piece, column_names):
+        """Serve fan-out zero-copy mode (docs/serve.md): when the publish
+        channel offers ``reserve_fused`` (the daemon's blob plane), run the
+        fused decode WRITING DIRECTLY INTO a shared blob mapping and publish
+        only the column-layout descriptor — consumers view the mapping in
+        place, so the batch is written once (by the decode itself) and never
+        copied again, no matter how many consumers attach. Unlike the ring
+        in-place mode this does not need sizes known ahead (a blob is random
+        access), so np.save raggedless cells (NdarrayCodec) qualify too.
+        Returns False (no observable effect) when any precondition fails."""
+        reserve = getattr(self.publish_func, 'reserve_fused', None)
+        pf = self._parquet_file(piece.path) if reserve is not None else None
+        if pf is None or not hasattr(pf, 'fused_plan'):
+            return False
+        schema = self.args['schema']
+        transform = self.args.get('transform_spec')
+        if any(c in piece.partition_keys for c in column_names):
+            return False  # partition columns would need a post-decode append
+        physical = [c for c in column_names if c in schema.fields]
+        if not physical or len(physical) != len(column_names):
+            return False
+        plan = pf.fused_plan(piece.row_group, physical, schema.fields,
+                             getattr(transform, 'image_decode_hints', None),
+                             getattr(transform, 'image_resize', None),
+                             include_pagescan=True)
+        if plan is None or plan.rest or not plan.columns:
+            return False
+        n = plan.expected_rows
+        if n <= 0:
+            return False
+        offsets, total = [], 0
+        for p in plan.columns:
+            offsets.append(total)
+            total += p.out_bound
+        reserved = reserve(total, n)
+        if reserved is None:
+            return False
+        view, finish, abort = reserved
+        try:
+            results = pf.fused_read_into(plan, view, offsets)
+        except Exception as e:  # noqa: BLE001 - kernel refusal: copy path serves it
+            logger.debug('fused blob read failed (%s); copy path', e)
+            abort()
+            return False
+        from petastorm_tpu.native import fused
+        cols = []
+        for p, res, off in zip(plan.columns, results, offsets):
+            region = fused.column_region(p, res, n)
+            if region is None:
+                abort()
+                fused.count_fallbacks(
+                    {p.name: fused.REASON_BY_STATUS.get(res[0], 'post-validate')})
+                return False
+            dtype_str, shape, nbytes = region
+            cols.append((p.name, dtype_str, shape, off, nbytes))
+        finish(cols)
+        obs.count('fused_columns_total', len(plan.columns))
+        obs.count('fused_batches_total')
+        obs.count('serve_fused_blob_batches_total')
+        obs.count('worker_rows_decoded_total', n)
+        fused.count_fallbacks(plan.reasons)
+        return True
 
     def _publish_fused_inplace(self, piece, column_names):
         """shm-ring in-place mode: reserve the ring slot the consumer will

@@ -1,0 +1,26 @@
+"""Shared reader service: one per-host daemon decodes each dataset once and
+serves decoded batches to many local consumer processes over broadcast shm
+rings (``docs/serve.md``).
+
+Entry points:
+
+* ``make_reader(..., serve='auto' | <service dir>)`` — the drop-in consumer
+  path (spawns-or-joins the daemon; returns a :class:`ServedReader`);
+* ``petastorm-tpu-serve`` / ``python -m petastorm_tpu.serve`` — run the
+  daemon explicitly (CI, systemd, containers);
+* :class:`ReaderService` — the embeddable broker, for tests and bespoke
+  deployments.
+"""
+
+from __future__ import annotations
+
+from petastorm_tpu.serve.client import (ServedReader, connect_service,
+                                        default_service_dir, make_served_reader)
+from petastorm_tpu.serve.plan import ReadPlan, build_read_plan
+from petastorm_tpu.serve.service import ReaderService, canonical_stream_id
+
+__all__ = [
+    'ReadPlan', 'ReaderService', 'ServedReader', 'build_read_plan',
+    'canonical_stream_id', 'connect_service', 'default_service_dir',
+    'make_served_reader',
+]

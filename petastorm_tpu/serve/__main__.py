@@ -1,0 +1,67 @@
+"""``petastorm-tpu-serve`` / ``python -m petastorm_tpu.serve`` — run the
+per-host shared reader daemon in the foreground (``docs/serve.md``).
+
+Normally consumers spawn the daemon implicitly via
+``make_reader(serve='auto')``; this entry point exists for explicit
+deployments (CI fixtures, systemd units, containers) and for debugging with
+the daemon's logs on a terminal.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog='petastorm-tpu-serve',
+        description='Per-host shared reader daemon: decode once, serve many '
+                    'local consumers over broadcast shm rings (docs/serve.md).')
+    parser.add_argument('--service-dir', required=True,
+                        help='service directory (control socket, stream specs, '
+                             'spawn lock); consumers pass the same path as '
+                             'make_reader(serve=...)')
+    parser.add_argument('--pool-type', choices=('thread', 'process', 'dummy'),
+                        default='thread')
+    parser.add_argument('--workers-count', type=int, default=4)
+    parser.add_argument('--ring-bytes', type=int, default=None,
+                        help='per-stream broadcast ring capacity (default 64 MiB)')
+    parser.add_argument('--idle-timeout', type=float, default=None,
+                        help='exit after this many seconds with no attached '
+                             'tenants (default 60; <= 0 disables)')
+    parser.add_argument('--evict-block', type=float, default=None,
+                        help='evict the slowest consumer after a publish stays '
+                             'blocked this long (default 10s)')
+    parser.add_argument('-v', '--verbose', action='store_true')
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(level=logging.DEBUG if args.verbose else logging.INFO,
+                        format='%(asctime)s %(levelname)s %(name)s: %(message)s')
+
+    from petastorm_tpu.serve.service import (DEFAULT_EVICT_BLOCK_S,
+                                             DEFAULT_IDLE_TIMEOUT_S,
+                                             DEFAULT_SERVE_RING_BYTES,
+                                             ReaderService)
+    idle = args.idle_timeout if args.idle_timeout is not None else DEFAULT_IDLE_TIMEOUT_S
+    service = ReaderService(
+        args.service_dir,
+        pool_type=args.pool_type,
+        workers_count=args.workers_count,
+        ring_bytes=args.ring_bytes or DEFAULT_SERVE_RING_BYTES,
+        idle_timeout_s=None if idle is not None and idle <= 0 else idle,
+        evict_block_s=(args.evict_block if args.evict_block is not None
+                       else DEFAULT_EVICT_BLOCK_S))
+    service.start()
+    try:
+        service.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        service.shutdown()
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

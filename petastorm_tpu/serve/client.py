@@ -1,0 +1,430 @@
+"""Consumer side of the shared reader service: spawn-or-join + ServedReader.
+
+``make_reader(serve='auto' | <service dir>)`` lands here: the client resolves
+the service directory, joins the running daemon (or wins the O_EXCL spawn
+race and starts one), ATTACHes its stream spec over the control socket, and
+gets back a broadcast-ring name + consumer token + the client-side read plan.
+:class:`ServedReader` is then a drop-in ``Reader``: the same iterator /
+``diagnostics`` / ``stop``/``join`` surface, with the pool replaced by a
+facade that reads frames off the fan-out ring.
+
+Failure surface (tests pin all three): a daemon crash raises
+:class:`~petastorm_tpu.errors.ServeDaemonDiedError` instead of hanging; an
+eviction raises :class:`~petastorm_tpu.errors.ConsumerEvictedError`; a clean
+per-tenant end of stream is a normal ``StopIteration``.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+import sys
+import time
+
+from petastorm_tpu import observability as obs
+from petastorm_tpu.errors import (ConsumerEvictedError, EmptyResultError,
+                                  ServeDaemonDiedError, ServeError)
+from petastorm_tpu.serializers import NumpyBlockSerializer
+from petastorm_tpu.serve.service import (LOCK_FILE, endpoint_path, read_endpoint)
+from petastorm_tpu.workers.protocol import (SERVE_BLOB, SERVE_COLS, SERVE_DATA,
+                                            SERVE_DONE, SERVE_END, SERVE_ERROR,
+                                            ring_unpack)
+
+logger = logging.getLogger(__name__)
+
+_SPAWN_TIMEOUT_S = 30.0
+#: liveness-probe period while blocked on a quiet ring
+_LIVENESS_PERIOD_S = 1.0
+
+
+def default_service_dir():
+    """Per-user default service directory ('auto'): one daemon per host+user."""
+    base = os.environ.get('PSTPU_SERVE_DIR')
+    if base:
+        return base
+    import tempfile
+    return os.path.join(tempfile.gettempdir(),
+                        'pstpu-serve-{}'.format(os.getuid()))
+
+
+def _pid_alive(pid):
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except (PermissionError, OSError):
+        return True
+    # signal-0 succeeds on a ZOMBIE too — and a daemon this process spawned
+    # becomes exactly that when it dies (nothing reaps it until interpreter
+    # exit), which would turn "daemon crashed" into an infinite liveness loop
+    try:
+        with open('/proc/{}/stat'.format(pid)) as f:
+            # field 3 (after the parenthesized comm, which may contain spaces)
+            return f.read().rsplit(')', 1)[-1].split()[0] != 'Z'
+    except (OSError, IndexError):
+        return True  # no procfs: assume alive (the conservative direction)
+
+
+def _spawn_daemon(service_dir, spawn_args):
+    """Launch the daemon process (detached session; logs into the service
+    dir). The caller holds the O_EXCL lock."""
+    argv = [sys.executable, '-m', 'petastorm_tpu.serve',
+            '--service-dir', service_dir]
+    for key, flag in (('pool_type', '--pool-type'),
+                      ('workers_count', '--workers-count'),
+                      ('ring_bytes', '--ring-bytes'),
+                      ('idle_timeout_s', '--idle-timeout'),
+                      ('evict_block_s', '--evict-block')):
+        value = spawn_args.get(key)
+        if value is not None:
+            argv += [flag, str(value)]
+    env = dict(os.environ)
+    pkg_parent = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    env['PYTHONPATH'] = pkg_parent + os.pathsep + env.get('PYTHONPATH', '')
+    log_path = os.path.join(service_dir, 'daemon.log')
+    with open(log_path, 'ab') as log:
+        proc = subprocess.Popen(argv, stdout=log, stderr=log,
+                                start_new_session=True, env=env)
+    logger.info('spawned serve daemon pid %d (dir=%s, log=%s)', proc.pid,
+                service_dir, log_path)
+    return proc
+
+
+def connect_service(service_dir, spawn_args=None, timeout_s=_SPAWN_TIMEOUT_S):
+    """Join the service daemon for ``service_dir``, spawning one via the
+    O_EXCL handshake when none is running. Returns an open control
+    Connection."""
+    from multiprocessing.connection import Client
+    service_dir = os.path.abspath(service_dir)
+    os.makedirs(service_dir, exist_ok=True)
+    lock_path = os.path.join(service_dir, LOCK_FILE)
+    deadline = time.monotonic() + timeout_s
+    spawned = False
+    while time.monotonic() < deadline:
+        endpoint = read_endpoint(service_dir)
+        if endpoint is not None:
+            if not _pid_alive(endpoint['pid']):
+                # stale endpoint from a dead daemon: clear it (and the lock)
+                # so the spawn race can run again
+                for p in (endpoint_path(service_dir), lock_path):
+                    try:
+                        os.unlink(p)
+                    except OSError:
+                        pass
+            else:
+                try:
+                    conn = Client(endpoint['address'], family='AF_UNIX')
+                    conn.send({'op': 'ping'})
+                    if conn.recv().get('ok'):
+                        return conn
+                    conn.close()
+                except (OSError, EOFError, ConnectionError):
+                    time.sleep(0.05)
+                    continue
+        if not spawned:
+            try:
+                fd = os.open(lock_path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                os.write(fd, str(os.getpid()).encode())
+                os.close(fd)
+                _spawn_daemon(service_dir, spawn_args or {})
+                spawned = True
+            except FileExistsError:
+                # another process won the race (or a daemon is mid-startup);
+                # clear a stale lock whose owner died before publishing
+                try:
+                    with open(lock_path) as f:
+                        owner = int(f.read().strip() or '0')
+                    if owner and not _pid_alive(owner) \
+                            and read_endpoint(service_dir) is None:
+                        os.unlink(lock_path)
+                except (OSError, ValueError):
+                    pass
+        time.sleep(0.05)
+    raise ServeError('no serve daemon reachable under {} within {}s (see {} '
+                     'for daemon-side errors)'.format(
+                         service_dir, timeout_s,
+                         os.path.join(service_dir, 'daemon.log')))
+
+
+def _map_blob(path, size, tenant_id):
+    """COW-map a served batch blob: writable views with zero upfront copy;
+    the mapping (not the name) keeps the pages alive past the daemon's
+    reclaim. A vanished blob means this consumer fell behind the fleet's GC
+    horizon — surfaced like an eviction, never as a hang or torn data."""
+    import mmap
+    try:
+        with open(path, 'rb') as f:
+            mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_COPY)
+        try:
+            mm.madvise(mmap.MADV_WILLNEED)  # prefault in-kernel, not per-page
+        except (AttributeError, OSError):
+            pass
+    except OSError as e:
+        raise ConsumerEvictedError(
+            'served batch blob {} was reclaimed before this consumer mapped '
+            'it (consumer far behind the fleet): {} — consume faster or '
+            'raise the daemon blob budget (docs/serve.md)'.format(path, e),
+            tenant_id=tenant_id)
+    return memoryview(mm)[:size]  # noqa: PT500 - fresh COW mapping per batch
+
+
+class _ServedPoolFacade(object):
+    """Duck-types the pool surface the results-queue readers consume
+    (``get_results`` / ``last_result_seq`` / ``done_callback``) over a
+    broadcast-ring consumer slot."""
+
+    def __init__(self, ring, token, daemon_pid, tenant_id, monitor=None):
+        self._ring = ring
+        self._token = token
+        self._daemon_pid = daemon_pid
+        self._tenant_id = tenant_id
+        self._serializer = NumpyBlockSerializer()
+        self._stopped = False
+        self._ended = False
+        self.last_result_seq = None
+        self.done_callback = None
+        self.monitor = monitor
+        self.batches_received = 0
+        self.bytes_received = 0
+
+    def get_results(self):
+        from petastorm_tpu.native.shm_ring import BcastConsumerGone
+        with obs.stage('pool_wait', cat='pool'):
+            while True:
+                if self._ended:
+                    raise EmptyResultError()
+                try:
+                    view = self._ring.read_view(self._token,
+                                                stop_check=lambda: self._stopped,
+                                                timeout_s=_LIVENESS_PERIOD_S)
+                except BcastConsumerGone as e:
+                    if e.evicted:
+                        raise ConsumerEvictedError(
+                            'this consumer was evicted by the serve daemon (it '
+                            'lagged far enough to stall the fleet) — consume '
+                            'faster, raise serve ring_bytes, or re-attach '
+                            '(docs/serve.md)', tenant_id=self._tenant_id)
+                    raise ServeError('serve consumer slot was released '
+                                     '(detached elsewhere?)')
+                if view is None:
+                    if self._stopped:
+                        raise EmptyResultError()
+                    if not _pid_alive(self._daemon_pid):
+                        raise ServeDaemonDiedError(
+                            'serve daemon (pid {}) died with this consumer '
+                            'attached; re-run make_reader(serve=...) to spawn '
+                            'a replacement'.format(self._daemon_pid))
+                    continue
+                kind, seq, payload = ring_unpack(view)
+                if kind == SERVE_DATA:
+                    if self.monitor is not None:
+                        self.monitor.on_deliver(seq)
+                    self.last_result_seq = seq
+                    self.batches_received += 1
+                    self.bytes_received += len(payload)
+                    return self._serializer.deserialize(payload)
+                elif kind == SERVE_COLS:
+                    # the zero-copy plane: the fused decode wrote the batch
+                    # straight into the blob; build typed views over the
+                    # COW mapping from the layout descriptor
+                    import pickle
+                    desc = pickle.loads(bytes(payload))
+                    if self.monitor is not None:
+                        self.monitor.on_deliver(seq)
+                    self.last_result_seq = seq
+                    self.batches_received += 1
+                    self.bytes_received += desc['size']
+                    mv = _map_blob(desc['path'], desc['size'], self._tenant_id)
+                    import numpy as np
+                    block = {}
+                    for name, dtype_str, shape, off, nbytes in desc['cols']:
+                        block[name] = np.frombuffer(
+                            mv[off:off + nbytes],
+                            dtype=np.dtype(dtype_str)).reshape(shape)
+                    return block
+                elif kind == SERVE_BLOB:
+                    # the batch sits in a shared /dev/shm blob: COW-map it
+                    # (writable numpy views, zero upfront copy); the daemon
+                    # reclaims the file once the fleet's cursors passed this
+                    # frame (plus a grace covering exactly this window)
+                    size_s, path = bytes(payload).decode().split('|', 1)
+                    if self.monitor is not None:
+                        self.monitor.on_deliver(seq)
+                    self.last_result_seq = seq
+                    self.batches_received += 1
+                    self.bytes_received += int(size_s)
+                    return self._serializer.deserialize(
+                        _map_blob(path, int(size_s), self._tenant_id))
+                elif kind == SERVE_DONE:
+                    if self.done_callback is not None and seq is not None:
+                        self.done_callback(seq)
+                elif kind == SERVE_END:
+                    if self.monitor is not None:
+                        self.monitor.on_consumer_end()
+                    self._ended = True
+                    raise EmptyResultError()
+                elif kind == SERVE_ERROR:
+                    import pickle
+                    try:
+                        err = pickle.loads(bytes(payload))
+                    except Exception:  # noqa: BLE001 - a garbled report must still fail loudly
+                        err = ServeError('serve daemon reported an unreadable error')
+                    raise ServeError('serve daemon stream failed: {}'.format(err))
+                else:
+                    logger.warning('dropping serve frame with unknown kind %r', kind)
+
+    def stop(self):
+        self._stopped = True
+
+    @property
+    def diagnostics(self):
+        return {'serve_batches_received': self.batches_received,
+                'serve_bytes_received': self.bytes_received}
+
+
+class ServedReader(object):
+    """Drop-in ``Reader`` over a shared serve daemon (``docs/serve.md``).
+
+    Iterates exactly like the plain reader it replaces (rows, columnar blocks
+    or rebatched blocks, per the ``make_reader`` arguments), but the decode
+    runs once in the per-host daemon no matter how many local consumers
+    attach. Not supported in served mode: ``resume_state`` (the stream is
+    shared — there is no private read position), ``autotune`` (the daemon owns
+    the fleet) — ``make_reader`` rejects those combinations.
+    """
+
+    def __init__(self, conn, reply, results_queue_reader_factory,
+                 service_dir, monitor=None):
+        self._conn = conn
+        self._service_dir = service_dir
+        self.tenant_id = reply['tenant_id']
+        self.stream_id = reply['stream_id']
+        plan = reply['client_plan']
+        self.schema = plan['schema']
+        self.output_schema = plan['output_schema']
+        self.transformed_schema = plan['transformed_schema']
+        self.ngram = plan['ngram']
+        from petastorm_tpu.native.shm_ring import BcastRing
+        self._ring = BcastRing.attach(reply['ring_name'])
+        self._facade = _ServedPoolFacade(self._ring, reply['token'],
+                                         reply['daemon_pid'], self.tenant_id,
+                                         monitor=monitor)
+        self._results_queue_reader = results_queue_reader_factory(
+            self.transformed_schema)
+        self.last_row_consumed = False
+        self._stopped = False
+
+    @property
+    def batched_output(self):
+        return self._results_queue_reader.batched_output
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            return self._results_queue_reader.read_next(self._facade)
+        except EmptyResultError:
+            self.last_row_consumed = True
+            raise StopIteration
+
+    next = __next__
+
+    def reset(self):
+        raise ServeError('reset() is not supported on a served reader: the '
+                         'stream is shared. Re-attach with make_reader(serve=...) '
+                         'for another pass.')
+
+    def state_dict(self):
+        raise ServeError('state_dict() is not supported on a served reader: '
+                         'the read position belongs to the shared stream, not '
+                         'this consumer (docs/serve.md).')
+
+    @property
+    def quarantined_items(self):
+        return []
+
+    @property
+    def diagnostics(self):
+        """Client-side counters + this tenant's daemon-side serving stats
+        (fair-share occupancy, shared-decode hits — docs/serve.md)."""
+        diag = obs.flatten_snapshot(obs.snapshot())
+        diag.update(self._facade.diagnostics)
+        stats = self.service_stats()
+        if stats is not None:
+            stream = stats.get('streams', {}).get(self.stream_id, {})
+            tenant = stream.get('tenants', {}).get(self.tenant_id, {})
+            diag.update({'serve_tenant_' + k: v for k, v in tenant.items()
+                         if not isinstance(v, dict)})
+            fair = stream.get('fair_share', {})
+            if 'occupancy' in fair:
+                diag['serve_fair_share_occupancy'] = fair['occupancy']
+            diag['serve_stream_decoded_batches'] = stream.get('decoded_batches', 0)
+            diag['serve_evictions'] = stats.get('evictions', 0)
+        return diag
+
+    def service_stats(self):
+        """The daemon's full stats document, or None when it is unreachable."""
+        if self._conn is None:
+            return None
+        try:
+            self._conn.send({'op': 'stats'})
+            reply = self._conn.recv()
+            return reply.get('stats') if reply.get('ok') else None
+        except (OSError, EOFError, ValueError):
+            return None
+
+    def stop(self):
+        if self._stopped:
+            return
+        self._stopped = True
+        self._facade.stop()
+        if self._conn is not None:
+            try:
+                self._conn.send({'op': 'detach', 'tenant_id': self.tenant_id})
+                self._conn.recv()
+            except (OSError, EOFError, ValueError):
+                pass  # daemon already gone: nothing to release
+            try:
+                self._conn.close()
+            except OSError:
+                pass
+            self._conn = None
+
+    def join(self):
+        if self._ring is not None:
+            self._ring.close()
+            self._ring = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc_value, tb):
+        if not self._stopped:
+            self.stop()
+            self.join()
+
+
+def make_served_reader(spec, serve, results_queue_reader_factory,
+                       weight=1, spawn_args=None, monitor=None):
+    """ATTACH ``spec`` to the service for ``serve`` ('auto' or a service
+    directory), spawning the daemon when absent. Returns a ServedReader."""
+    service_dir = default_service_dir() if serve in (True, 'auto') else str(serve)
+    conn = connect_service(service_dir, spawn_args=spawn_args)
+    conn.send({'op': 'attach', 'spec': spec, 'weight': weight})
+    reply = conn.recv()
+    if not reply.get('ok'):
+        try:
+            conn.close()
+        except OSError:
+            pass
+        raise ServeError('serve attach failed: {}'.format(reply.get('error')))
+    from petastorm_tpu.analysis.protocol.monitor import serve_monitor_from_env
+    return ServedReader(conn, reply, results_queue_reader_factory, service_dir,
+                        monitor=serve_monitor_from_env(monitor, 'serve-consumer'))
+
+
+__all__ = ['ServedReader', 'connect_service', 'default_service_dir',
+           'make_served_reader']

@@ -1,0 +1,285 @@
+"""Stream-multiplexing worker: ONE worker fleet serving many streams.
+
+The serve daemon owns a single supervised pool whose workers are
+:class:`MultiplexWorker` instances. Every ventilated item carries a
+``stream_id``; the worker lazily instantiates the stream's REAL worker
+(``RowGroupDecoderWorker`` / ``ArrowBatchWorker``) from a spec file the
+broker wrote under the service directory before ventilating the stream's
+first item, then delegates. Streams attach and detach at daemon runtime
+without the pool ever restarting — the broker's spec files are the
+side-channel that gets per-stream worker args into already-spawned worker
+processes (the daemon is per-host, so a local file is exactly as reachable
+as the shm ring the results ride back on).
+
+The inner worker receives this worker's ``publish_func`` unchanged, so the
+PR 6 in-place fused publish path (``publish.reserve_block``) keeps working
+under multiplexing.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import pickle
+import tempfile
+
+from petastorm_tpu.workers.worker_base import WorkerBase
+
+logger = logging.getLogger(__name__)
+
+#: open inner workers kept per pool worker; beyond this the least-recently
+#: used stream's worker is shut down (its spec file re-loads on demand)
+_MAX_OPEN_STREAMS = 8
+
+#: batches at least this large are parked in a shared /dev/shm blob and only
+#: the path crosses the broadcast ring (the serve analog of the process
+#: pool's blob sidechannel): the fused decode lands the batch DIRECTLY in the
+#: blob (in-place reserve_block), consumers COW-mmap it with zero upfront
+#: copy, and fan-out to K consumers costs no per-consumer copies at all
+DEFAULT_SERVE_BLOB_THRESHOLD = 1 << 20
+
+
+class BlobRef(object):
+    """A published batch parked in a shared blob file: what the worker hands
+    the pool instead of the block itself. Picklable (process-pool daemons ship
+    it over the results transport)."""
+
+    __slots__ = ('path', 'size')
+
+    def __init__(self, path, size):
+        self.path = path
+        self.size = size
+
+    def __reduce__(self):
+        return (BlobRef, (self.path, self.size))
+
+
+class FusedBlobRef(object):
+    """A fused batch decoded DIRECTLY into a shared blob: path + per-column
+    layout ``(name, dtype_str, shape, offset, nbytes)``. Consumers build
+    numpy views straight over the mapping — zero batch copies anywhere
+    between the Parquet pages and the training loop."""
+
+    __slots__ = ('path', 'size', 'rows', 'cols')
+
+    def __init__(self, path, size, rows, cols):
+        self.path = path
+        self.size = size
+        self.rows = rows
+        self.cols = cols
+
+    def __reduce__(self):
+        return (FusedBlobRef, (self.path, self.size, self.rows, self.cols))
+
+
+class _BlobPublish(object):
+    """Publish wrapper giving a stream's inner worker the serve blob channel:
+
+    * ``publish(block)`` — block payloads at/over the threshold are written
+      into a fresh blob (single ``write_parts_into`` copy) and published as a
+      :class:`BlobRef`; everything else passes through in-band;
+    * ``publish.reserve_block(meta, payload_max)`` — the PR 6 in-place
+      contract: the fused native decode writes the batch STRAIGHT into the
+      blob's mapping, so qualifying batches reach the consumers with zero
+      serialization copies anywhere.
+
+    Callable-object form (not a closure) so the worker-side probe
+    ``getattr(publish_func, 'reserve_block', None)`` finds the method.
+    """
+
+    def __init__(self, inner_publish, blob_dir, threshold, serializer):
+        self._inner = inner_publish
+        self._blob_dir = blob_dir
+        self._threshold = threshold
+        self._serializer = serializer
+        self._disabled = False
+
+    def _new_blob(self, total):
+        import mmap
+        fd, path = tempfile.mkstemp(prefix='sb', dir=self._blob_dir)
+        try:
+            os.posix_fallocate(fd, 0, total)  # ENOSPC here, not SIGBUS later
+            mm = mmap.mmap(fd, total)
+        except OSError:
+            os.close(fd)
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            raise
+        os.close(fd)
+        return mm, path
+
+    def __call__(self, data):
+        ser = self._serializer
+        if not self._disabled and self._blob_dir is not None \
+                and hasattr(ser, 'serialize_parts'):
+            parts = ser.serialize_parts(data)
+            if parts is not None:
+                total = ser.parts_size(parts)
+                if total >= self._threshold:
+                    # plain buffered writes, not an mmap: one kernel-side copy
+                    # per byte and none of the per-page fault churn a fresh
+                    # mapping pays on a multi-MB batch
+                    fd, path = tempfile.mkstemp(prefix='sb', dir=self._blob_dir)
+                    try:
+                        with os.fdopen(fd, 'wb') as f:
+                            for p in parts:
+                                f.write(ser._array_bytes(p)
+                                        if not isinstance(p, (bytes, bytearray))
+                                        else p)
+                        self._inner(BlobRef(path, total))
+                        return
+                    except OSError as e:
+                        logger.warning('serve blob write failed (%s); batch '
+                                       'falling back in-band', e)
+                        try:
+                            os.unlink(path)
+                        except OSError:
+                            pass
+                        self._disabled = True
+        self._inner(data)
+
+    def reserve_fused(self, total_bound, rows):
+        """The direct-decode channel: a writable blob mapping the fused
+        native decode lands the whole batch in, published as a column-layout
+        descriptor (:class:`FusedBlobRef`) instead of serialized bytes — no
+        serializer pass at all. Returns ``(payload_view, finish, abort)`` or
+        None. ``PSTPU_SERVE_FUSED_BLOB=0`` disables it (rollback knob: on
+        hosts where fresh-mapping fault+zero costs beat the serializer copy,
+        the plain blob channel can win)."""
+        if self._disabled or self._blob_dir is None:
+            return None
+        if os.environ.get('PSTPU_SERVE_FUSED_BLOB', '1') in ('0', 'off'):
+            return None
+        if total_bound < self._threshold:
+            return None
+        try:
+            mm, path = self._new_blob(total_bound)
+        except OSError as e:
+            logger.warning('serve blob allocation failed (%s); copy path', e)
+            self._disabled = True
+            return None
+        view = memoryview(mm)  # noqa: PT500 - writable blob mapping owned by this reservation
+
+        # as with reserve_block, the mapping is left to die with the caller's
+        # views; tmpfs pages are shared-visible the moment they are written
+        def finish(cols):
+            self._inner(FusedBlobRef(path, total_bound, rows, cols))
+
+        def abort():
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
+        return view, finish, abort
+
+    def reserve_block(self, meta_entries, payload_max):
+        """In-place channel: returns ``(payload_view, commit, abort)`` backed
+        by a fresh blob mapping, or None (callers use the copy path)."""
+        if self._disabled or self._blob_dir is None \
+                or not hasattr(self._serializer, 'frame_for_layout'):
+            return None
+        prefix = self._serializer.frame_for_layout(meta_entries)
+        if prefix is None:
+            return None
+        total = len(prefix) + payload_max
+        if total < self._threshold:
+            return None  # small batches take the in-band ring frame
+        try:
+            mm, path = self._new_blob(total)
+        except OSError as e:
+            logger.warning('serve blob allocation failed (%s); in-band path', e)
+            self._disabled = True
+            return None
+        view = memoryview(mm)  # noqa: PT500 - writable blob mapping owned by this reservation
+        view[:len(prefix)] = prefix
+
+        # NOTE: the mapping is NOT closed on commit/abort — the caller still
+        # holds numpy views over the payload slice (mmap.close would raise
+        # BufferError); the mapping unmaps when those views die, and tmpfs
+        # pages are shared-visible to consumers the moment they are written.
+        def commit(actual_payload=payload_max):
+            self._inner(BlobRef(path, len(prefix) + actual_payload))
+
+        def abort():
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
+        return view[len(prefix):], commit, abort
+
+
+def stream_spec_path(service_dir, stream_id):
+    """Canonical location of a stream's pickled (worker_class, worker_args)."""
+    return os.path.join(service_dir, 'streams', '{}.pkl'.format(stream_id))
+
+
+def write_stream_spec(service_dir, stream_id, worker_class, worker_args):
+    """Atomically publish a stream's worker spec for the fleet (broker side;
+    temp + rename so a worker never loads a half-written pickle)."""
+    path = stream_spec_path(service_dir, stream_id)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = '{}.tmp.{}'.format(path, os.getpid())
+    with open(tmp, 'wb') as f:
+        pickle.dump((worker_class, worker_args), f,
+                    protocol=pickle.HIGHEST_PROTOCOL)
+    os.replace(tmp, path)
+
+
+def remove_stream_spec(service_dir, stream_id):
+    try:
+        os.unlink(stream_spec_path(service_dir, stream_id))
+    except OSError:
+        pass
+
+
+class MultiplexWorker(WorkerBase):
+    """``args``: ``{'service_dir': path}`` (plus the usual telemetry/fault
+    riders). Items are the inner worker's kwargs plus ``stream_id``."""
+
+    def __init__(self, worker_id, publish_func, args):
+        super().__init__(worker_id, publish_func, args)
+        self._inner = {}   # stream_id -> inner worker (insertion-ordered LRU)
+
+    def _inner_worker(self, stream_id):
+        worker = self._inner.pop(stream_id, None)
+        if worker is None:
+            path = stream_spec_path(self.args['service_dir'], stream_id)
+            with open(path, 'rb') as f:
+                worker_class, worker_args = pickle.load(f)
+            publish = self.publish_func
+            blob_dir = self.args.get('blob_dir')
+            if blob_dir is not None:
+                from petastorm_tpu.serializers import NumpyBlockSerializer
+                publish = _BlobPublish(
+                    publish, blob_dir,
+                    self.args.get('blob_threshold', DEFAULT_SERVE_BLOB_THRESHOLD),
+                    NumpyBlockSerializer())
+            worker = worker_class(self.worker_id, publish, worker_args)
+            if len(self._inner) >= _MAX_OPEN_STREAMS:
+                old_id, old = next(iter(self._inner.items()))
+                del self._inner[old_id]
+                try:
+                    old.shutdown()
+                except Exception:  # noqa: BLE001 - a stale stream's cleanup must not fail the live one
+                    logger.debug('shutdown of idle stream %s worker failed', old_id)
+        self._inner[stream_id] = worker  # re-insert: most recently used
+        return worker
+
+    def process(self, stream_id, **kwargs):
+        self._inner_worker(stream_id).process(**kwargs)
+
+    def shutdown(self):
+        for worker in self._inner.values():
+            try:
+                worker.shutdown()
+            except Exception:  # noqa: BLE001 - best-effort fan-in of inner shutdowns
+                pass
+        self._inner = {}
+
+
+__all__ = ['BlobRef', 'DEFAULT_SERVE_BLOB_THRESHOLD', 'MultiplexWorker',
+           'remove_stream_spec', 'stream_spec_path', 'write_stream_spec']

@@ -58,6 +58,38 @@ def plain_page(num_values, itemsize=8, value=0, values=None, encoding=0):
     return header + values
 
 
+def v2_page(num_values, itemsize=8, value=0, values=None, encoding=0,
+            num_nulls=0, def_len=0, rep_len=0, levels=b''):
+    """One handwritten DATA_PAGE_V2 (thrift compact header + body). The body
+    is ``levels + values`` — v2 keeps the def/rep level blocks as an
+    uncompressed prefix with explicit byte lengths (fields 5/6), and field 7
+    (is_compressed) is written FALSE so the builder needs no codec."""
+    if values is None:
+        values = struct.pack('<q', value)[:itemsize] * num_values
+    body = levels + values
+    dph2 = (bytes([0x15]) + tzigzag(num_values)   # 1: num_values
+            + bytes([0x15]) + tzigzag(num_nulls)  # 2: num_nulls
+            + bytes([0x15]) + tzigzag(num_values)  # 3: num_rows
+            + bytes([0x15]) + tzigzag(encoding)   # 4: encoding
+            + bytes([0x15]) + tzigzag(def_len)    # 5: def-levels byte length
+            + bytes([0x15]) + tzigzag(rep_len)    # 6: rep-levels byte length
+            + bytes([0x12])                        # 7: is_compressed = FALSE
+            + b'\x00')
+    header = (bytes([0x15]) + tzigzag(3)               # 1: type DATA_PAGE_V2
+              + bytes([0x15]) + tzigzag(len(body))     # 2: uncompressed
+              + bytes([0x15]) + tzigzag(len(body))     # 3: compressed
+              + bytes([0x5C]) + dph2                   # 8: DataPageHeaderV2
+              + b'\x00')
+    return header + body
+
+
+def v2_overdeclared_levels_chunk():
+    """A corrupt v2 page whose declared def-levels length exceeds the whole
+    page body: skipping it blindly would read past the chunk. Must be
+    rejected at scan time (def-levels status), never dereferenced."""
+    return v2_page(4, def_len=1 << 20)
+
+
 def dict_page(num_values, values):
     """One handwritten v1 DICTIONARY page declaring ``num_values`` entries."""
     header = (bytes([0x15]) + tzigzag(2)              # 1: type DICTIONARY_PAGE
@@ -85,7 +117,9 @@ def fuzz_corpus(seed=0xF05ED, mutated=150, garbage=60, max_garbage=96):
     truncations / splices of a valid two-page chunk, then pure garbage.
     Yields ``bytes`` (deterministic for a given seed)."""
     rng = np.random.default_rng(seed)
-    valid = bytearray(plain_page(4) * 2)
+    # v1 + v2 pages in the base chunk: mutations/truncations exercise both
+    # header parsers (and the v2 level-skip arithmetic) under the sanitizers
+    valid = bytearray(plain_page(4) * 2 + v2_page(4))
     for _ in range(mutated):
         data = bytearray(valid)
         for _ in range(rng.integers(1, 8)):
@@ -154,6 +188,20 @@ def replay_corrupt_chunk_regressions(lib):
     out = np.zeros(32, np.uint8)
     (res,) = fused.read_into(lib, [chunk], [plan], 4, out, [0])
     assert res[0] == 9, res  # kColDict: rejected, never dereferenced
+
+    # v2 page declaring a def-levels block longer than its whole body: the
+    # level skip must be bounds-checked, not trusted
+    chunk_v2 = np.frombuffer(v2_overdeclared_levels_chunk(), dtype=np.uint8)
+    plan_v2 = fused.ColumnPlan('v2')
+    plan_v2.itemsize = 8
+    plan_v2.phys_dtype = np.dtype(np.int64)
+    plan_v2.out_dtype = np.dtype(np.int64)
+    plan_v2.out_shape = (4,)
+    plan_v2.chunk_len = chunk_v2.size
+    plan_v2.out_bound = 4 * 8
+    out_v2 = np.zeros(32, np.uint8)
+    (res_v2,) = fused.read_into(lib, [chunk_v2], [plan_v2], 4, out_v2, [0])
+    assert res_v2[0] == 5, res_v2  # kColDefLevels: rejected, never skipped-past
 
     # stale-metadata precheck: a failing column must not shift its
     # neighbors' aux buffers (the aux_bufs index-misalignment regression)

@@ -37,6 +37,13 @@ class DummyPool(object):
         self._results = deque()  # (MSG_DATA, seq, payload) | (MSG_DONE, seq, None)
         self._pending = deque()  # (dispatch, args, kwargs, attempts) (_seq rides kwargs)
         self._pending_lock = threading.Lock()
+        # serializes worker.process against join()'s worker.shutdown: the
+        # consumer thread may be mid-read inside native code (mmapped pages)
+        # while ANOTHER thread tears the pool down — e.g. diagnose --watch,
+        # whose pump thread iterates while the main thread exits the loader
+        # context; shutting the worker (closing files/mappings) under its
+        # feet is a segfault, not an exception
+        self._exec_lock = threading.Lock()
         self._worker = None
         self._stopped = False
         self._ventilator = None
@@ -70,7 +77,8 @@ class DummyPool(object):
     def start(self, worker_class, worker_setup_args=None, ventilator=None):
         if self._worker is not None:
             raise RuntimeError('Pool already started')
-        self._worker = worker_class(0, self._publish, worker_setup_args)
+        with self._exec_lock:
+            self._worker = worker_class(0, self._publish, worker_setup_args)
         if ventilator is not None:
             self._ventilator = ventilator
             self._ventilator.start()
@@ -103,8 +111,12 @@ class DummyPool(object):
         completed = True
         delivered = False
         try:
-            faults.on_item(kwargs)
-            self._worker.process(*args, **kwargs)
+            with self._exec_lock:
+                worker = self._worker
+                if worker is None:
+                    return False  # joined concurrently: nothing left to run
+                faults.on_item(kwargs)
+                worker.process(*args, **kwargs)
             self._results.append((MSG_DONE, self._current_seq, None))
             delivered = True
         except Exception as e:  # noqa: BLE001 - routed through the error policy
@@ -117,7 +129,7 @@ class DummyPool(object):
                     if self.protocol_monitor is not None:
                         self.protocol_monitor.on_complete(d, delivered)
                 if self._ventilator is not None:
-                    self._ventilator.processed_item()
+                    self._ventilator.processed_item(self._current_seq)
         return True
 
     def _handle_item_failure(self, exc, d, args, orig_kwargs, attempts):
@@ -224,9 +236,12 @@ class DummyPool(object):
             self._pending.clear()
 
     def join(self):
-        if self._worker is not None:
-            self._worker.shutdown()
-            self._worker = None
+        with self._exec_lock:
+            # under the exec lock: a consumer thread mid-process finishes its
+            # item before the worker's files/mappings are torn down
+            if self._worker is not None:
+                self._worker.shutdown()
+                self._worker = None
 
     @property
     def quarantined_items(self):

@@ -219,6 +219,9 @@ class ProcessPool(object):
         # get_results() poll loop against the join() drain so two threads never
         # race pstpu_ring_read on the same ring.
         self._ring_lock = threading.Lock()
+        # consumer-side idle-wait escalation (consumer thread only)
+        from petastorm_tpu.native.shm_ring import IdleWait
+        self._idle_wait = IdleWait()
         # item ownership/accounting state — _state_lock guards everything the
         # ventilator thread (ventilate) and the consumer thread (get_results/
         # supervise) both touch; callbacks into the ventilator always run with
@@ -488,7 +491,7 @@ class ProcessPool(object):
                 payload = bytearray(payload)
             return kind, (int(seq_bytes) if seq_bytes else None), payload
         deadline = time.monotonic() + timeout_ms / 1000.0
-        sleep_s = 0.0002
+        idle = self._idle_wait
         while True:
             with self._ring_lock:
                 for ring in self._rings:
@@ -496,17 +499,21 @@ class ProcessPool(object):
                         continue
                     view = ring.try_read_view()
                     if view is not None:
+                        idle.reset()
                         return ring_unpack(view)
                 for ring in self._retired_rings:
                     view = ring.try_read_view()
                     if view is not None:
+                        idle.reset()
                         return ring_unpack(view)
             if time.monotonic() >= deadline:
                 return None
-            # exponential backoff to 2ms: a sleeping consumer leaves the cores
-            # to the workers; sub-ms latency only matters on the first misses
-            time.sleep(sleep_s)
-            sleep_s = min(sleep_s * 2, 0.002)
+            # spin→yield→sleep escalation (shm_ring.IdleWait): the first
+            # misses stay latency-free, then the core is yielded, then the
+            # consumer sleeps up to 2ms — many idle consumers on one host no
+            # longer burn cores while the producers are quiet, and the spins
+            # land in the ring_idle_spins counter
+            idle.wait()
 
     def ventilate(self, *args, **kwargs):
         seq = kwargs.pop('_seq', None)
@@ -555,7 +562,9 @@ class ProcessPool(object):
             if self.protocol_monitor is not None and d is not None:
                 self.protocol_monitor.on_complete(d, delivered)
         if self._ventilator is not None:
-            self._ventilator.processed_item()
+            # the completed item's seq rides along so tenant-aware ventilators
+            # (FairShareVentilator) can release the right budget
+            self._ventilator.processed_item(rec['seq'] if rec is not None else None)
         if delivered and rec is not None and rec['seq'] is not None \
                 and self.done_callback is not None:
             self.done_callback(rec['seq'])

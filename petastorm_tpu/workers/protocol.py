@@ -65,6 +65,30 @@ KIND_CONSTANT_NAMES = {
     'MSG_HEARTBEAT': MSG_HEARTBEAT,
 }
 
+# -- serve-plane frame kinds (broadcast fan-out ring, docs/serve.md) --------
+#
+# NOT results-channel kinds: these frame daemon -> consumer broadcast traffic
+# on the BcastRing and are deliberately kept out of MESSAGE_KINDS (the pool
+# consumer loops never see them). Defined here because this module is the
+# single definition site for every wire constant (PT801).
+
+SERVE_DATA = b'd'    #: one decoded batch payload, in-band (serializer framing)
+SERVE_BLOB = b'b'    #: one decoded batch parked in a shared /dev/shm blob;
+                     #: payload = ``<size>|<path>`` — consumers COW-mmap it
+                     #: (zero upfront copy) and the daemon reclaims the file
+                     #: once the whole fleet's ring cursors passed the frame
+SERVE_COLS = b'c'    #: a FUSED batch decoded DIRECTLY into a shared blob:
+                     #: payload = pickled ``{'path','size','rows','cols'}``
+                     #: column-layout descriptor; consumers view the mapping
+                     #: in place — zero batch copies anywhere in the fan-out
+SERVE_DONE = b'f'    #: item completion sentinel (carries the item seq)
+SERVE_END = b'z'     #: per-tenant end of stream: the tenant's epochs finished
+SERVE_ERROR = b'e'   #: pickled daemon-side error report; the stream is over
+
+#: every serve-plane frame kind, in protocol order
+SERVE_KINDS = (SERVE_DATA, SERVE_BLOB, SERVE_COLS, SERVE_DONE, SERVE_END,
+               SERVE_ERROR)
+
 # -- shm-ring framing -------------------------------------------------------
 
 #: ring message header: kind byte + little-endian int64 dispatch id (-1 = None)
@@ -117,5 +141,8 @@ __all__ = [
     'ALL_KINDS', 'CONTROL_FINISHED', 'DispatchIds', 'KIND_CONSTANT_NAMES',
     'MESSAGE_KINDS', 'MSG_BLOB', 'MSG_DATA', 'MSG_DONE', 'MSG_ERROR',
     'MSG_HEARTBEAT', 'MSG_METRICS', 'MSG_STARTED', 'RING_HEADER_LEN',
+    'SERVE_BLOB', 'SERVE_COLS', 'SERVE_DATA', 'SERVE_DONE', 'SERVE_END',
+    'SERVE_ERROR',
+    'SERVE_KINDS',
     'ring_header', 'ring_unpack',
 ]

@@ -175,7 +175,10 @@ class ThreadPool(object):
             elif kind == MSG_DONE:
                 if self.protocol_monitor is not None:
                     self.protocol_monitor.on_message('done', d, live=True)
-                self._count_completed(seq, d)
+                # MSG_DONE payload is the delivered flag: quarantined/raised
+                # items complete undelivered but still carry their real seq
+                # for tenant-aware ventilator budget release
+                self._count_completed(seq, d, delivered=bool(payload))
             elif kind == MSG_ERROR:
                 if self.protocol_monitor is not None and d is not None:
                     self.protocol_monitor.on_message('error', d, live=True)
@@ -185,14 +188,14 @@ class ThreadPool(object):
                 # kind; reaching this is a framing bug, never a silent drop
                 raise RuntimeError('unknown results-queue kind {!r}'.format(kind))
 
-    def _count_completed(self, seq=None, dispatch=None):
+    def _count_completed(self, seq=None, dispatch=None, delivered=True):
         with self._counter_lock:
             self._completed_items += 1
             if self.protocol_monitor is not None and dispatch is not None:
-                self.protocol_monitor.on_complete(dispatch, delivered=seq is not None)
+                self.protocol_monitor.on_complete(dispatch, delivered=delivered)
         if self._ventilator is not None:
-            self._ventilator.processed_item()
-        if seq is not None and self.done_callback is not None:
+            self._ventilator.processed_item(seq)
+        if delivered and seq is not None and self.done_callback is not None:
             self.done_callback(seq)
 
     def _all_done(self):
@@ -291,7 +294,7 @@ class ThreadPool(object):
             logger.warning('Worker %d failed on item seq=%s AFTER publishing; '
                            'completing the item rather than re-running it: %s',
                            worker.worker_id, seq, exc)
-            self._stop_aware_put((MSG_DONE, seq, None, d))
+            self._stop_aware_put((MSG_DONE, seq, True, d))
             return
         if self._policy.should_retry_error(attempts):
             logger.warning('Worker %d failed on item seq=%s (attempt %d/%d); requeueing: %s',
@@ -315,17 +318,18 @@ class ThreadPool(object):
             obs.count('items_quarantined')
             logger.error('Quarantining item seq=%s after %d failed attempts: %s',
                          seq, attempts, record['error'])
-            # completion sentinel WITHOUT a seq: the item counts complete for
-            # epoch/flow-control accounting but is never marked delivered
-            self._stop_aware_put((MSG_DONE, None, None, d))
+            # undelivered completion sentinel: the item counts complete for
+            # epoch/flow-control/tenant-budget accounting but is never marked
+            # delivered (the delivered flag, not a dropped seq, encodes that)
+            self._stop_aware_put((MSG_DONE, seq, False, d))
             return
         logger.exception('Worker %d failed processing an item', worker.worker_id)
         attach_remote_context(exc, format_exception_tb(exc),
                               worker_id=worker.worker_id, seq=seq)
         self._stop_aware_put((MSG_ERROR, None, exc, d))
-        # seq-less sentinel: flow control counts the item but it is
+        # undelivered sentinel: flow control counts the item but it is
         # NOT marked delivered — a checkpoint will re-read it
-        self._stop_aware_put((MSG_DONE, None, None, d))
+        self._stop_aware_put((MSG_DONE, seq, False, d))
 
     def _worker_loop(self, worker):
         profiler = None
@@ -353,7 +357,7 @@ class ThreadPool(object):
                     finally:
                         if profiler is not None:
                             profiler.disable()
-                    self._stop_aware_put((MSG_DONE, seq, None, d))
+                    self._stop_aware_put((MSG_DONE, seq, True, d))
                 except WorkerTerminationRequested:
                     return
                 except Exception:  # noqa: BLE001 - routed through the error policy

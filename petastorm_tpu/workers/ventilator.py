@@ -122,9 +122,12 @@ class ConcurrentVentilator(VentilatorBase):
         self._thread = threading.Thread(target=self._ventilate_loop, daemon=True)
         self._thread.start()
 
-    def processed_item(self):
+    def processed_item(self, seq=None):
         """Called by the pool/consumer when one ventilated item finished
-        processing; unblocks the feeding thread.
+        processing; unblocks the feeding thread. ``seq`` is the completed
+        item's ventilation seq when the pool knows it (all first-party pools
+        do) — this ventilator's budget is global so it ignores it, but the
+        :class:`FairShareVentilator` needs it for per-tenant accounting.
 
         Supervision contract (docs/robustness.md): pools must call this
         EXACTLY ONCE per ventilated item, no matter how many times the item
@@ -267,3 +270,300 @@ class ConcurrentVentilator(VentilatorBase):
                 if counted and self._iterations_remaining is not None:
                     self._iterations_remaining -= 1
         self._completed = True
+
+
+class _TenantQueue(object):
+    """One tenant's item stream inside a :class:`FairShareVentilator`: its
+    items, remaining epochs, weight, in-flight budget, and counters. All
+    mutation happens under the ventilator's condition lock."""
+
+    __slots__ = ('tenant_id', 'items', 'iterations_remaining', 'weight',
+                 'max_in_flight', 'in_flight', 'dispatched', 'completed',
+                 'epoch_indices', 'epoch_pos', 'rng', 'shuffle', 'credits',
+                 'finished', 'removed')
+
+    def __init__(self, tenant_id, items, iterations, weight, max_in_flight,
+                 shuffle, seed):
+        self.tenant_id = tenant_id
+        self.items = list(items)
+        self.iterations_remaining = iterations
+        self.weight = max(1, int(weight))
+        self.max_in_flight = max(1, int(max_in_flight))
+        self.in_flight = 0
+        self.dispatched = 0
+        self.completed = 0
+        self.epoch_indices = []
+        self.epoch_pos = 0
+        self.rng = np.random.default_rng(seed)
+        self.shuffle = shuffle
+        self.credits = 0
+        self.finished = not self.items or iterations == 0
+        self.removed = False
+
+    def _lay_out_epoch(self):
+        """Start the next epoch's order, or mark the stream finished."""
+        if self.iterations_remaining is not None:
+            if self.iterations_remaining <= 0:
+                self.finished = True
+                return False
+            self.iterations_remaining -= 1
+        order = list(range(len(self.items)))
+        if self.shuffle:
+            order = [int(i) for i in self.rng.permutation(len(order))]
+        self.epoch_indices = order
+        self.epoch_pos = 0
+        return True
+
+    def next_item(self):
+        """The next item to dispatch, or None when the stream is exhausted.
+        Does NOT check the in-flight budget (the scheduler does)."""
+        if self.finished:
+            return None
+        if self.epoch_pos >= len(self.epoch_indices):
+            if not self._lay_out_epoch():
+                return None
+        item = self.items[self.epoch_indices[self.epoch_pos]]
+        self.epoch_pos += 1
+        return item
+
+    def exhausted(self):
+        """No further dispatches will ever happen for this tenant."""
+        if self.removed:
+            return True
+        if not self.finished:
+            if self.epoch_pos < len(self.epoch_indices):
+                return False
+            if self.iterations_remaining is None or self.iterations_remaining > 0:
+                return False
+        return True
+
+    def stats(self):
+        return {'weight': self.weight, 'max_in_flight': self.max_in_flight,
+                'in_flight': self.in_flight, 'dispatched': self.dispatched,
+                'completed': self.completed, 'finished': self.finished,
+                'removed': self.removed}
+
+
+class FairShareVentilator(VentilatorBase):
+    """Multiplexes MANY tenants' item streams onto ONE pool with weighted
+    fair-share scheduling — the serve daemon's broker half (``docs/serve.md``).
+
+    Each tenant registers an item list (row groups of its stream), an epoch
+    count, a scheduling ``weight`` and a per-tenant ``max_in_flight`` budget
+    (admission control: one tenant can never occupy more pool slots than its
+    budget, no matter how fast it drains results). Dispatch is starvation-free
+    weighted round-robin: every scheduling cycle refills each eligible
+    tenant's credits to its weight and then drains credits cyclically, so a
+    weight-2 tenant gets two dispatches per cycle to a weight-1 tenant's one,
+    and a tenant is never skipped while it has credits, backlog, and budget
+    headroom.
+
+    Every dispatched item is tagged with a globally unique ``_seq`` and the
+    tenant's ``stream_id`` kwarg; pools report completions back through
+    :meth:`processed_item(seq)` which resolves the owning tenant for budget
+    release and per-tenant epoch-termination detection (``on_tenant_done``
+    fires exactly once per tenant, when its last in-flight item completes
+    after its final epoch was fully dispatched).
+
+    Unlike :class:`ConcurrentVentilator` this ventilator is LONG-LIVED: it
+    completes only when stopped, tenants attach/detach at runtime, and
+    removing a tenant mid-epoch simply stops feeding it (in-flight items drain
+    normally; their completions release the budget but no done callback
+    fires)."""
+
+    def __init__(self, ventilate_fn, on_tenant_done=None):
+        self._ventilate_fn = ventilate_fn
+        self._on_tenant_done = on_tenant_done
+        self._cv = threading.Condition()
+        self._tenants = {}          # tenant_id -> _TenantQueue
+        self._order = []            # round-robin order of tenant ids
+        self._final_stats = {}      # drained tenants' last counters (bounded)
+        self._seq = 0
+        self._seq_tenant = {}       # seq -> tenant_id (live dispatches only)
+        self._stop_requested = False
+        self._completed = False
+        self._thread = None
+
+    # -- tenant lifecycle ----------------------------------------------------
+
+    def add_tenant(self, tenant_id, items, iterations=1, weight=1,
+                   max_in_flight=2, shuffle=False, seed=None):
+        """Register a tenant's stream; dispatching starts immediately (the
+        feeding thread wakes on the next cycle). Safe mid-run."""
+        if iterations is not None and (not isinstance(iterations, int) or iterations < 0):
+            raise ValueError('iterations must be a non-negative int or None')
+        with self._cv:
+            if tenant_id in self._tenants:
+                raise ValueError('tenant {!r} already registered'.format(tenant_id))
+            tq = _TenantQueue(tenant_id, items, iterations, weight,
+                              max_in_flight, shuffle, seed)
+            if not tq.finished:
+                self._tenants[tenant_id] = tq
+                self._order.append(tenant_id)
+            self._cv.notify_all()
+        if tq.finished:
+            # zero items / zero epochs: terminate the stream immediately
+            self._fire_done(tenant_id)
+
+    def remove_tenant(self, tenant_id):
+        """Stop feeding a tenant mid-run. In-flight items drain normally
+        (their completions release pool budget); no done callback fires."""
+        with self._cv:
+            tq = self._tenants.get(tenant_id)
+            if tq is None:
+                return False
+            tq.removed = True
+            tq.finished = True
+            if tq.in_flight == 0:
+                self._forget(tenant_id)
+            self._cv.notify_all()
+        return True
+
+    def _forget(self, tenant_id):
+        """Drop a fully-drained tenant's bookkeeping, retaining its final
+        counters for diagnostics (fair-share occupancy must survive stream
+        completion). Caller holds _cv."""
+        tq = self._tenants.pop(tenant_id, None)  # noqa: PT100 - every caller holds _cv
+        if tq is not None:
+            self._final_stats[tenant_id] = tq.stats()  # noqa: PT100 - caller holds _cv
+            while len(self._final_stats) > 64:  # bounded history
+                self._final_stats.pop(next(iter(self._final_stats)))  # noqa: PT100 - caller holds _cv
+        if tenant_id in self._order:
+            self._order.remove(tenant_id)  # noqa: PT100 - every caller holds _cv
+
+    def tenant_stats(self):
+        """Per-tenant scheduling/occupancy counters (fair-share evidence for
+        diagnostics; docs/serve.md) — live tenants plus the retained final
+        counters of recently drained ones."""
+        with self._cv:
+            out = dict(self._final_stats)
+            out.update({tid: tq.stats() for tid, tq in self._tenants.items()})
+            return out
+
+    def set_tenant_weight(self, tenant_id, weight):
+        """Retune a tenant's fair share at runtime (takes effect at the next
+        credit refill). True when the tenant is still registered."""
+        with self._cv:
+            tq = self._tenants.get(tenant_id)
+            if tq is None:
+                return False
+            tq.weight = max(1, int(weight))
+            return True
+
+    def tenant_of_seq(self, seq):
+        """Owning tenant of a live dispatch seq (None once completed)."""
+        with self._cv:
+            return self._seq_tenant.get(seq)
+
+    # -- VentilatorBase ------------------------------------------------------
+
+    def start(self):
+        if self._thread is not None:
+            raise RuntimeError('Ventilator already started')
+        self._thread = threading.Thread(target=self._ventilate_loop, daemon=True,
+                                        name='pstpu-fairshare-ventilator')
+        self._thread.start()
+
+    def processed_item(self, seq=None):
+        """Pool completion callback: releases the owning tenant's in-flight
+        budget and fires ``on_tenant_done`` when its stream fully drains."""
+        done_tenant = None
+        with self._cv:
+            tenant_id = self._seq_tenant.pop(seq, None)
+            tq = self._tenants.get(tenant_id) if tenant_id is not None else None
+            if tq is not None:
+                tq.in_flight -= 1
+                tq.completed += 1
+                if tq.exhausted() and tq.in_flight == 0:
+                    if not tq.removed:
+                        done_tenant = tenant_id
+                    self._forget(tenant_id)
+            self._cv.notify_all()
+        if done_tenant is not None:
+            self._fire_done(done_tenant)
+
+    def _fire_done(self, tenant_id):
+        if self._on_tenant_done is not None:
+            self._on_tenant_done(tenant_id)
+
+    def completed(self):
+        """Long-lived: only a stop completes this ventilator."""
+        return self._completed
+
+    def stop(self):
+        with self._cv:
+            self._stop_requested = True
+            self._cv.notify_all()
+        if self._thread is not None and self._thread is not threading.current_thread():
+            self._thread.join()
+        self._completed = True
+
+    def upcoming_items(self, max_items):
+        """Merged read-only peek at the next items across tenants (for the
+        chunk prefetcher): interleaves each tenant's unventilated head in
+        round-robin order."""
+        with self._cv:
+            heads = []
+            for tid in self._order:
+                tq = self._tenants[tid]
+                if tq.finished:
+                    continue
+                idxs = tq.epoch_indices[tq.epoch_pos:tq.epoch_pos + max_items]
+                heads.append([tq.items[i] for i in idxs])
+            out = []
+            for layer in zip(*heads) if heads else ():
+                out.extend(layer)
+                if len(out) >= max_items:
+                    break
+            return out[:max_items]
+
+    # -- the scheduler -------------------------------------------------------
+
+    def _pick_next(self):
+        """Under the lock: the next (tenant, item, seq) to dispatch by
+        weighted round-robin, or None when nothing is eligible. Refills
+        credits when every backlogged tenant is out of them, so weights shape
+        shares without ever starving anyone."""
+        for _refill in (False, True):
+            if _refill:
+                eligible = [self._tenants[tid] for tid in self._order
+                            if not self._tenants[tid].finished
+                            and self._tenants[tid].in_flight < self._tenants[tid].max_in_flight]
+                if not eligible:
+                    return None
+                for tq in eligible:
+                    tq.credits = tq.weight
+            for tid in list(self._order):
+                tq = self._tenants[tid]
+                if (tq.finished or tq.credits <= 0
+                        or tq.in_flight >= tq.max_in_flight):
+                    continue
+                item = tq.next_item()
+                if item is None:
+                    continue
+                tq.credits -= 1
+                tq.in_flight += 1
+                tq.dispatched += 1
+                seq = self._seq
+                self._seq += 1
+                self._seq_tenant[seq] = tid  # noqa: PT100 - _pick_next runs under _cv
+                # rotate: the tenant goes to the back so equal-credit tenants
+                # alternate instead of one draining its whole credit run
+                self._order.remove(tid)  # noqa: PT100 - _pick_next runs under _cv
+                self._order.append(tid)  # noqa: PT100 - _pick_next runs under _cv
+                return tq, item, seq
+        return None
+
+    def _ventilate_loop(self):
+        while True:
+            with self._cv:
+                while not self._stop_requested:
+                    picked = self._pick_next()
+                    if picked is not None:
+                        break
+                    self._cv.wait(timeout=0.1)
+                if self._stop_requested:
+                    return
+                tq, item, seq = picked
+            with obs.stage('ventilate', cat='ventilator'):
+                self._ventilate_fn(**dict(item, _seq=seq))

@@ -29,7 +29,8 @@ def test_console_scripts_declared_and_resolvable():
     assert set(scripts) == {'pstpu-throughput', 'pstpu-copy-dataset',
                             'pstpu-generate-metadata', 'pstpu-metadata-util',
                             'petastorm-tpu-lint', 'petastorm-tpu-diagnose',
-                            'petastorm-tpu-modelcheck', 'petastorm-tpu-autotune'}
+                            'petastorm-tpu-modelcheck', 'petastorm-tpu-autotune',
+                            'petastorm-tpu-serve'}
     import importlib
     for target in scripts.values():
         mod_name, func_name = target.split(':')

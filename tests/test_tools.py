@@ -315,3 +315,34 @@ def test_reader_throughput_jax_method_columnar(synthetic_dataset):
                                      'worker.fused_decode', 'worker.decode',
                                      'worker.transform', 'consumer.assembly',
                                      'pool.unattributed'}
+
+
+def test_bench_serve_smoke(tmp_path, capsys):
+    """End-to-end smoke of the serve benchmark (docs/serve.md): one fleet
+    size, a tiny store, real consumer subprocesses and a real spawned daemon.
+    The headline line must carry both aggregates and the ratios."""
+    import json as _json
+
+    import numpy as np
+
+    import bench_serve
+    from petastorm_tpu.codecs import ScalarCodec
+    from petastorm_tpu.etl.dataset_metadata import write_petastorm_dataset
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+    schema = Unischema('S', [
+        UnischemaField('x', np.int64, (), ScalarCodec(np.int64), False)])
+    url = 'file://' + str(tmp_path / 'store')
+    write_petastorm_dataset(url, schema, ({'x': i} for i in range(200)),
+                            rows_per_row_group=20)
+    bench_serve.main(['--url', url, '--consumers', '2',
+                      '--rows', '150', '--warmup-rows', '40'])
+    lines = [l for l in capsys.readouterr().out.splitlines() if l.startswith('{')]
+    recs = [_json.loads(l) for l in lines]
+    headline = [r for r in recs if r.get('metric') == 'serve_bench']
+    assert len(headline) == 1
+    h = headline[0]
+    assert h['single_plain_rate'] > 0
+    assert h['sweep']['2']['served_aggregate'] > 0
+    assert h['sweep']['2']['independent_aggregate'] > 0
+    assert h['single_served_rate'] > 0
+    assert isinstance(h['meets_bar'], bool)
