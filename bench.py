@@ -524,6 +524,10 @@ def main(argv=None):
         'duty': duty,
         'autotune': autotune,
         'chaos': _chaos_section() if args.chaos else None,
+        # per-batch critical-path attribution over the capture's span trees
+        # (spans level only): traced-batch count + the slowest batches with
+        # the stage that owned their dispatch-to-delivery latency
+        'critical_path': _critical_path_section(telemetry),
     }))
 
 
@@ -588,6 +592,18 @@ def _decode_collate_section():
     try:
         return obs.decode_collate_share(obs.flatten_snapshot(obs.snapshot()))
     except Exception:  # noqa: BLE001 - telemetry off/reset: the headline still prints
+        return None
+
+
+def _critical_path_section(telemetry):
+    """The causal-tracing summary block (docs/observability.md): only
+    meaningful when the capture ran at spans level."""
+    if telemetry != 'spans':
+        return None
+    from petastorm_tpu import observability as obs
+    try:
+        return obs.critical_path_summary(top=3)
+    except Exception:  # noqa: BLE001 - attribution must never sink the headline
         return None
 
 

@@ -16,7 +16,12 @@ JSON line per host plus an aggregate:
   {"metric": "pod_host", "host": h, "examples_per_sec": .., "stall": ..}
   {"metric": "pod_aggregate", "hosts": H, "examples_per_sec_total": .., ...}
 
+With ``--telemetry-out DIR`` each (simulated) host also appends its
+host-stamped diagnostics JSONL to ``DIR/host<h>.jsonl`` — feed the directory
+to ``petastorm-tpu-diagnose --pod DIR`` for the fleet view / straggler callout.
+
 Usage: python bench_pod.py [--hosts 4] [--steps 20] [--seq-len 4]
+       [--telemetry-out DIR]
        (set JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
         off-pod; the script forces them itself when no pod is present)
 """
@@ -84,6 +89,10 @@ def main(argv=None):
     parser.add_argument('--workers', type=int, default=2)
     parser.add_argument('--context', choices=('ring', 'ulysses'), default='ring',
                         help='context-parallel attention strategy over the seq axis')
+    parser.add_argument('--telemetry-out', default=None, metavar='DIR',
+                        help='write one host-stamped telemetry JSONL per '
+                             '(simulated) host into DIR — the input format of '
+                             'petastorm-tpu-diagnose --pod (docs/observability.md)')
     args = parser.parse_args(argv)
 
     _ensure_devices(args.devices)
@@ -138,6 +147,24 @@ def main(argv=None):
         model, jax.random.PRNGKey(0),
         jnp.zeros((args.batch_size, args.seq_len, args.feature_dim)))
 
+    if args.telemetry_out:
+        os.makedirs(args.telemetry_out, exist_ok=True)
+
+    def _telemetry_snapshot(host, loader):
+        """One pod-aggregator line: the loader's flat diagnostics under this
+        simulated host's identity stamp (on a real pod every process writes
+        its own file; here 'host<h>' keys keep the series distinct)."""
+        if not args.telemetry_out:
+            return
+        from petastorm_tpu import observability as obs
+        rec = {'ts': round(time.time(), 3),
+               'host': obs.host_identity('host{}'.format(host)),
+               'metrics': {k: v for k, v in loader.diagnostics.items()
+                           if isinstance(v, (int, float))}}
+        path = os.path.join(args.telemetry_out, 'host{}.jsonl'.format(host))
+        with open(path, 'a') as f:
+            f.write(json.dumps(rec) + '\n')
+
     total_rate = 0.0
     worst_stall = 0.0
     with mesh:
@@ -163,6 +190,7 @@ def main(argv=None):
                     x, labels = stage(stack_ngram_time_axis(next(it)))
                     state, metrics = step(state, x, labels)
                 jax.block_until_ready(metrics['loss'])
+                _telemetry_snapshot(host, loader)
                 wait = 0.0
                 t0 = time.perf_counter()
                 for _ in range(args.steps):
@@ -176,6 +204,7 @@ def main(argv=None):
                     state, metrics = step(state, x, labels)
                 jax.block_until_ready(metrics['loss'])
                 dt = time.perf_counter() - t0
+                _telemetry_snapshot(host, loader)
             rate = args.steps * args.batch_size / dt
             stall = wait / dt
             total_rate += rate

@@ -38,6 +38,11 @@ Rule families (see ``docs/analysis.md`` for bad/good examples):
   change leaves an explainable ``autotune.decision`` event) and pass their
   values through ``clamp()`` (no knob write can escape the config's
   explicit bounds).
+* **PT703** trace-context propagation — spans on the worker/serve data path
+  must inherit the propagated ``TraceContext``: no raw ``record_span``
+  calls, no hand-rolled ``trace=``/``span=``/``parent=`` identity kwargs.
+  An orphan span drops out of every batch's causal tree
+  (docs/observability.md, "Causal tracing").
 * **PT800/PT801** worker-pool protocol discipline — consumer switches over
   results-channel message kinds must cover every kind declared in
   ``workers/protocol.MESSAGE_KINDS`` (or carry an else); protocol
@@ -80,6 +85,7 @@ from petastorm_tpu.analysis.locks import LockDisciplineChecker
 from petastorm_tpu.analysis.protocol_lints import ProtocolLintChecker
 from petastorm_tpu.analysis.serve_lints import ServeActuatorChecker
 from petastorm_tpu.analysis.telemetry import TelemetrySpanChecker
+from petastorm_tpu.analysis.trace_lints import TraceContextChecker
 
 #: the full first-party rule set, in rule-id order
 ALL_CHECKERS = (
@@ -92,6 +98,7 @@ ALL_CHECKERS = (
     TelemetrySpanChecker,
     BaseExceptionContainmentChecker,
     AutotuneActionChecker,
+    TraceContextChecker,
     ProtocolLintChecker,
     ServeActuatorChecker,
     AbiConformanceChecker,
@@ -139,6 +146,6 @@ __all__ = [
     'ExceptionHygieneChecker', 'Finding',
     'HashabilityChecker', 'JaxPurityChecker', 'LockDisciplineChecker',
     'NativeBufferChecker', 'ProtocolLintChecker', 'ResourceLifecycleChecker', 'ServeActuatorChecker',
-    'SourceFile', 'TelemetrySpanChecker', 'collect_sources', 'load_baseline',
-    'run_analysis', 'run_checkers',
+    'SourceFile', 'TelemetrySpanChecker', 'TraceContextChecker',
+    'collect_sources', 'load_baseline', 'run_analysis', 'run_checkers',
 ]

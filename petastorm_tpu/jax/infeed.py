@@ -85,7 +85,11 @@ def prefetch_to_device(iterator, target=None, size=2, background=True):
             while True:
                 while len(queue) < size:
                     try:
-                        queue.append(stage_batch(next(it), target))
+                        batch = next(it)
+                        # causal tracing: when fed a JaxDataLoader (not a bare
+                        # generator) the infeed span joins the batch's tree
+                        with obs.use_trace(getattr(iterator, 'last_trace', None)):
+                            queue.append(stage_batch(batch, target))
                     except StopIteration:
                         while queue:
                             yield queue.popleft()
@@ -108,7 +112,10 @@ def prefetch_to_device(iterator, target=None, size=2, background=True):
     def _pump():
         try:
             for batch in iterator:
-                staged = stage_batch(batch, target)
+                # link the staging span to the batch's trace (loader inputs
+                # carry last_trace; plain iterators stage unlinked)
+                with obs.use_trace(getattr(iterator, 'last_trace', None)):
+                    staged = stage_batch(batch, target)
                 while not stop.is_set():
                     try:
                         q.put(staged, timeout=0.1)

@@ -194,6 +194,12 @@ class JaxDataLoader(object):
         self._iter_start = None
         self._reader_wait_s = 0.0
         self._rows_out = 0
+        # causal tracing (docs/observability.md): virtual-root TraceContext of
+        # the most recent reader item folded into an emitted batch. A shuffled
+        # batch mixes rows from many items; the collate/infeed spans link to
+        # the LAST contributor — enough to walk one representative tree from
+        # dispatch to device without per-row bookkeeping in the hot loop.
+        self.last_trace = None
         if resume_state is not None:
             if not isinstance(resume_state, dict) or resume_state.get('version') != 1:
                 raise ValueError('Unrecognized resume_state (expected a dict produced by '
@@ -354,13 +360,16 @@ class JaxDataLoader(object):
     def _emit_columnar(self, batch):
         n = len(next(iter(batch.values()))) if batch else 0
         self._rows_out += n
-        with obs.stage('collate', cat='loader', rows=n):
+        self.last_trace = getattr(self.reader, 'last_trace', None)
+        with obs.stage('collate', cat='loader', rows=n) as sp:
+            sp.link(self.last_trace)
             batch = _sanitize_batch_columns(batch)
             if self._columnar_ngram:
                 batch = _unflatten_ngram_batch(batch)
         obs.count('loader_batches_total')
         if self._to_device is not None:
-            batch = self._stage(batch)
+            with obs.use_trace(self.last_trace):
+                batch = self._stage(batch)
         return batch
 
     def _iterate(self, buffer, pending):
@@ -451,7 +460,9 @@ class JaxDataLoader(object):
 
     def _emit(self, rows):
         self._rows_out += len(rows)
-        with obs.stage('collate', cat='loader', rows=len(rows)):
+        self.last_trace = getattr(self.reader, 'last_trace', None)
+        with obs.stage('collate', cat='loader', rows=len(rows)) as sp:
+            sp.link(self.last_trace)
             if self._ngram is not None:
                 batch = self._collate_ngram(rows)
             else:
@@ -460,7 +471,8 @@ class JaxDataLoader(object):
         if self._buffer is not None:
             obs.gauge_set('shuffle_buffer_occupancy', self._buffer.size)
         if self._to_device is not None:
-            batch = self._stage(batch)
+            with obs.use_trace(self.last_trace):
+                batch = self._stage(batch)
         return batch
 
     @property

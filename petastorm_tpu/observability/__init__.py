@@ -36,7 +36,15 @@ import time as _time
 
 from petastorm_tpu.observability import metrics as _metrics
 from petastorm_tpu.observability import trace as _trace
+from petastorm_tpu.observability.critical_path import (critical_path,  # noqa: F401
+                                                       critical_path_summary,
+                                                       format_critical_path,
+                                                       format_slowest_batches,
+                                                       format_span_tree,
+                                                       slowest_batches, span_tree,
+                                                       stage_breakdown, traces_in)
 from petastorm_tpu.observability.exporters import (JsonlExporter,  # noqa: F401
+                                                   host_identity,
                                                    to_prometheus_text, write_prometheus)
 from petastorm_tpu.observability.history import (HistoryRecorder,  # noqa: F401
                                                  detect_regression, history_windows,
@@ -44,10 +52,15 @@ from petastorm_tpu.observability.history import (HistoryRecorder,  # noqa: F401
                                                  windowed_stall_report)
 from petastorm_tpu.observability.metrics import (counters_on, flatten_snapshot,  # noqa: F401
                                                  get_registry, merge_snapshots, spans_on)
+from petastorm_tpu.observability.podagg import (format_pod_report,  # noqa: F401
+                                                load_host_series, load_pod,
+                                                pod_report)
 from petastorm_tpu.observability.report import (decode_collate_share,  # noqa: F401
                                                 format_stall_report, stall_report)
-from petastorm_tpu.observability.trace import (chrome_trace, export_chrome_trace,  # noqa: F401
-                                               get_ring, instant, span)
+from petastorm_tpu.observability.trace import (TraceContext, chrome_trace,  # noqa: F401
+                                               current_trace, export_chrome_trace,
+                                               get_ring, instant, mint_trace,
+                                               root_of, span, trace_root, use_trace)
 
 _LEVELS = ('off', 'counters', 'spans')
 
@@ -116,27 +129,55 @@ def current_config():
 
 class _StageTimer(object):
     """Counter + (at spans level) trace event for one pipeline-stage
-    execution. Accumulates into ``stage_<name>_s``."""
+    execution. Accumulates into ``stage_<name>_s``.
 
-    __slots__ = ('name', 'cat', 'args', '_t0', '_wall0', '_spans')
+    At spans level the timer participates in trace-context propagation
+    exactly like :class:`petastorm_tpu.observability.trace._Span`: it stamps
+    ``trace``/``span``/``parent`` from the thread's active
+    :class:`TraceContext` and parents anything nested. :meth:`link` attaches
+    the span to a context discovered only mid-flight (``pool_wait``)."""
+
+    __slots__ = ('name', 'cat', 'args', '_t0', '_wall0', '_spans', '_ctx',
+                 '_link', '_sid', '_pushed')
 
     def __init__(self, name, cat, args, spans):
         self.name = name
         self.cat = cat
         self.args = args
         self._spans = spans
+        self._link = None
+        self._pushed = False
 
     def __enter__(self):
         if self._spans:
             self._wall0 = _time.time()
+            ctx = _trace.current_trace()
+            self._ctx = ctx
+            if ctx is not None:
+                self._sid = _trace.next_span_id()
+                _trace._push_trace(_trace.TraceContext(ctx.trace, self._sid))
+                self._pushed = True
+            else:
+                self._sid = None
         self._t0 = _time.perf_counter()
         return self
+
+    def link(self, ctx):
+        """Adopt ``ctx`` as this span's parent context (no-op below spans
+        level or when ``ctx`` is None)."""
+        if self._spans and ctx is not None:
+            self._link = ctx
 
     def __exit__(self, exc_type, exc_value, tb):
         dur = _time.perf_counter() - self._t0
         _metrics.get_registry().stage_timer(self.name).record(dur)
         if self._spans:
-            _trace.record_span(self.name, self.cat, self._wall0, dur, self.args)
+            if self._pushed:
+                _trace._pop_trace()
+            _trace.record_span(
+                self.name, self.cat, self._wall0, dur,
+                _trace.stamp_trace_args(self.args, self._link or self._ctx,
+                                        self._sid))
         return False
 
 
@@ -192,13 +233,17 @@ def absorb_trace_events(events):
 
 __all__ = [
     'HistoryRecorder',
-    'JsonlExporter', 'TelemetryConfig', 'absorb_trace_events', 'add_seconds',
-    'chrome_trace', 'configure', 'count', 'counters_on', 'current_config',
+    'JsonlExporter', 'TelemetryConfig', 'TraceContext', 'absorb_trace_events',
+    'add_seconds', 'chrome_trace', 'configure', 'count', 'counters_on',
+    'critical_path', 'critical_path_summary', 'current_config', 'current_trace',
     'decode_collate_share', 'detect_regression', 'drain_trace_events',
-    'export_chrome_trace', 'flatten_snapshot',
+    'export_chrome_trace', 'flatten_snapshot', 'format_critical_path',
+    'format_pod_report', 'format_slowest_batches', 'format_span_tree',
     'format_stall_report', 'gauge_set', 'get_registry', 'get_ring',
-    'history_windows', 'instant', 'load_history',
-    'merge_snapshots', 'observe', 'resolve_telemetry', 'snapshot', 'span',
-    'spans_on', 'stage', 'stall_report', 'to_prometheus_text', 'window_delta',
-    'windowed_stall_report', 'write_prometheus',
+    'history_windows', 'host_identity', 'instant', 'load_history',
+    'load_host_series', 'load_pod', 'merge_snapshots', 'mint_trace', 'observe',
+    'pod_report', 'resolve_telemetry', 'root_of', 'slowest_batches', 'snapshot',
+    'span', 'span_tree', 'spans_on', 'stage', 'stage_breakdown', 'stall_report',
+    'to_prometheus_text', 'trace_root', 'traces_in', 'use_trace',
+    'window_delta', 'windowed_stall_report', 'write_prometheus',
 ]

@@ -15,6 +15,18 @@ are called out. ``--json`` stays machine-readable per tick (one JSON line
 each), which also makes the output a replayable history for
 ``petastorm-tpu-autotune``.
 
+``--batch TRACE_ID`` (or ``--batch slowest``) adds per-batch causal tracing
+to the one-shot read: the slowest-batches table, the chosen batch's full
+cross-process span tree, and its critical path
+(``observability/critical_path.py``).
+
+``--pod DIR`` renders the fleet instead of reading a dataset: DIR holds the
+host-stamped JSONL exports of a pod's hosts (one
+:class:`~petastorm_tpu.observability.exporters.JsonlExporter` file each), and
+the pod report names per-host throughput/stall and the straggler host
+(``observability/podagg.py``). Combine with ``--watch SECONDS`` to re-render
+live as the hosts keep exporting.
+
 Open traces in https://ui.perfetto.dev (or chrome://tracing). See
 ``docs/observability.md`` for how to read the output and
 ``docs/troubleshooting.md`` ("reading a stall report") for the remedies.
@@ -173,6 +185,56 @@ def diagnose_serve(service_dir, as_json=False, stream=None):
     return 0
 
 
+def show_batch(batch_id='slowest', events=None, stream=None, top=10):
+    """Render the slowest-batches table plus the selected batch's span tree
+    and critical path from ``events`` (default: this process's trace ring).
+    ``batch_id`` is a trace id (``'<ns>:<seq>'``) or ``'slowest'``. Returns 0,
+    or 1 when no traced batches / no such trace exist."""
+    stream = stream if stream is not None else sys.stdout
+    if events is None:
+        events = obs.get_ring().snapshot()
+    rows = obs.slowest_batches(events, top=top)
+    if not rows:
+        print('no traced batches in the ring (tracing needs telemetry=spans)',
+              file=stream)
+        return 1
+    print(obs.format_slowest_batches(rows), file=stream)
+    trace_id = rows[0]['trace'] if batch_id in (None, 'slowest') else batch_id
+    tree = obs.span_tree(events, trace_id)
+    if tree is None:
+        print('trace {} not found in the ring (rotated out, or never traced)'
+              .format(trace_id), file=stream)
+        return 1
+    print(obs.format_span_tree(tree), file=stream)
+    print(obs.format_critical_path(obs.critical_path(tree)), file=stream)
+    return 0
+
+
+def watch_pod(pod_dir, interval_s=2.0, ticks=None, window_s=None,
+              as_json=False, stream=None):
+    """Re-render the pod report from the exports under ``pod_dir`` every
+    ``interval_s`` while the hosts keep appending. ``ticks`` bounds the run
+    (None = until interrupted). Returns the number of ticks rendered."""
+    stream = stream if stream is not None else sys.stdout
+    rendered = 0
+    try:
+        while ticks is None or rendered < ticks:
+            report = obs.pod_report(pod_dir, seconds=window_s)
+            rendered += 1
+            if as_json:
+                print(json.dumps({'tick': rendered, 'ts': round(time.time(), 3),
+                                  'pod': report}), file=stream, flush=True)
+            else:
+                print('--- pod tick {} ---'.format(rendered), file=stream)
+                print(obs.format_pod_report(report), file=stream)
+                stream.flush()
+            if ticks is None or rendered < ticks:
+                time.sleep(interval_s)
+    except KeyboardInterrupt:
+        pass
+    return rendered
+
+
 def watch(dataset_url, interval_s=2.0, ticks=None, batch_size=64,
           pool_type='thread', workers_count=3, telemetry='counters',
           use_batch_reader=False, reader_kwargs=None, as_json=False,
@@ -230,6 +292,7 @@ def watch(dataset_url, interval_s=2.0, ticks=None, batch_size=64,
                      if not (k.startswith('fused_fallback_column:') and not v)})
                 if as_json:
                     print(json.dumps({'tick': rendered, 'ts': round(time.time(), 3),
+                                      'host': obs.host_identity(),
                                       'window': report,
                                       'fused_fallbacks': fallbacks,
                                       'regression': regression}),
@@ -271,6 +334,17 @@ def main(argv=None):
                         help='instead of reading a dataset, connect to the '
                              'serve daemon under SERVICE_DIR and print its '
                              'per-tenant serving table (docs/serve.md)')
+    parser.add_argument('--pod', metavar='DIR', default=None,
+                        help='instead of reading a dataset, merge the '
+                             'host-stamped JSONL exports under DIR and print '
+                             'the pod report (per-host throughput/stall, '
+                             'straggler callout); combine with --watch to '
+                             're-render live')
+    parser.add_argument('--batch', metavar='TRACE_ID', default=None,
+                        help="after the measured read, print the slowest-"
+                             "batches table plus this batch's span tree and "
+                             "critical path ('slowest' picks the worst; "
+                             "implies --telemetry spans)")
     parser.add_argument('--batch-size', type=int, default=64)
     parser.add_argument('--batches', type=int, default=50)
     parser.add_argument('-p', '--pool-type', choices=('thread', 'process', 'dummy'),
@@ -297,8 +371,20 @@ def main(argv=None):
 
     if args.serve is not None:
         return diagnose_serve(args.serve, as_json=args.as_json)
+    if args.pod is not None:
+        if args.watch is not None:
+            watch_pod(args.pod, interval_s=args.watch, ticks=args.ticks or None,
+                      as_json=args.as_json)
+            return 0
+        report = obs.pod_report(args.pod)
+        if args.as_json:
+            print(json.dumps({'pod': report, 'host': obs.host_identity()}))
+        else:
+            print(obs.format_pod_report(report))
+        return 0
     if args.dataset_url is None:
-        parser.error('dataset_url is required (or pass --serve SERVICE_DIR)')
+        parser.error('dataset_url is required (or pass --serve SERVICE_DIR / '
+                     '--pod DIR)')
 
     if args.watch is not None:
         watch(args.dataset_url, interval_s=args.watch,
@@ -308,16 +394,20 @@ def main(argv=None):
               as_json=args.as_json)
         return 0
 
-    telemetry = 'spans' if args.trace_out else args.telemetry
+    telemetry = 'spans' if (args.trace_out or args.batch) else args.telemetry
     report, diag = diagnose(args.dataset_url, batch_size=args.batch_size,
                             batches=args.batches, pool_type=args.pool_type,
                             workers_count=args.workers_count, telemetry=telemetry,
                             use_batch_reader=args.batch_reader)
+    # every snapshot names the host that measured it, so dumps collected
+    # across a pod stay attributable after they leave the machine
+    ident = obs.host_identity()
     if args.as_json:
-        print(json.dumps({'stall_report': report,
+        print(json.dumps({'host': ident, 'stall_report': report,
                           'fused_fallbacks': fused_fallback_table(diag),
                           'diagnostics': {k: v for k, v in sorted(diag.items())}}))
     else:
+        print('host: {} (pid {})'.format(ident['host'], ident['pid']))
         print(obs.format_stall_report(report))
         fallbacks = format_fused_fallbacks(diag)
         if fallbacks:
@@ -325,6 +415,8 @@ def main(argv=None):
         print('diagnostics:')
         for key in sorted(diag):
             print('  {} = {}'.format(key, diag[key]))
+    if args.batch:
+        show_batch(args.batch)
     if args.trace_out:
         n = obs.export_chrome_trace(args.trace_out)
         print('wrote {} trace events to {} (open in https://ui.perfetto.dev)'.format(

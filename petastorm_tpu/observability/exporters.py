@@ -8,13 +8,47 @@ the live process registry and on cross-process merges
 from __future__ import annotations
 
 import json
+import os
 import re
+import socket
+import sys
 import threading
 import time
 
 from petastorm_tpu.observability import metrics as _metrics
 
 _NAME_SANITIZE = re.compile(r'[^a-zA-Z0-9_:]')
+
+#: when this process started exporting — lets the pod aggregator tell a
+#: restarted host (fresh counters) from a stalled one (same counters)
+_BOOT_TS = round(time.time(), 3)
+
+
+def host_identity(key=None):
+    """This process's identity stamp for exported telemetry records::
+
+        {'host': <short key>, 'process_index': <int|None>,
+         'hostname': ..., 'pid': ..., 'boot_ts': <epoch s>}
+
+    ``process_index`` comes from an already-imported jax (``jax.process_index``
+    identifies the host in a TPU pod); the check is on ``sys.modules`` so a
+    CPU-only export never triggers the heavy import. ``key`` overrides the
+    short host key (the pod aggregator's grouping label) — ``bench_pod`` uses
+    that to stamp its simulated hosts distinctly within one process."""
+    process_index = None
+    jax = sys.modules.get('jax')
+    if jax is not None:
+        try:
+            process_index = int(jax.process_index())
+        except Exception:  # noqa: BLE001 - uninitialized backends must not break exporting
+            process_index = None
+    hostname = socket.gethostname()
+    pid = os.getpid()
+    if key is None:
+        key = ('proc{}'.format(process_index) if process_index is not None
+               else '{}:{}'.format(hostname, pid))
+    return {'host': key, 'process_index': process_index, 'hostname': hostname,
+            'pid': pid, 'boot_ts': _BOOT_TS}
 
 
 def _prom_name(name, prefix):
@@ -59,18 +93,48 @@ def write_prometheus(path, snapshot=None, prefix='pstpu_'):
         f.write(to_prometheus_text(snapshot, prefix=prefix))
 
 
+def _count_lines(path):
+    """Lines in ``path`` (0 when absent/unreadable). Bounded work: only ever
+    called on rotated exports, whose size is capped by ``max_bytes``."""
+    try:
+        with open(path, 'rb') as f:
+            return sum(1 for _ in f)
+    except OSError:
+        return 0
+
+
 class JsonlExporter(object):
     """Background thread appending one JSON line per interval to ``path``:
-    ``{"ts": <epoch s>, "metrics": {<flat name: value>}}``. Deterministic
-    release via :meth:`stop` (or the context manager); the final flush runs on
-    stop so short-lived runs still record their last state."""
+    ``{"ts": <epoch s>, "host": {...}, "metrics": {<flat name: value>}}``.
+    Deterministic release via :meth:`stop` (or the context manager); the final
+    flush runs on stop so short-lived runs still record their last state.
 
-    def __init__(self, path, interval_s=5.0, snapshot_fn=None):
+    Every line carries this process's :func:`host_identity` stamp so exports
+    from several hosts can be merged by the pod aggregator
+    (``observability/podagg.py``); ``host_key`` overrides the short key.
+
+    Output growth is bounded when ``max_bytes`` is set: once the file would
+    exceed the cap it rotates to ``path + '.1'`` (one backup generation, so
+    on-disk use stays under ~2x the cap), and lines discarded with an
+    overwritten backup are counted into ``telemetry_export_dropped_total`` —
+    a silent gap in a telemetry series should itself be visible in telemetry.
+    """
+
+    def __init__(self, path, interval_s=5.0, snapshot_fn=None, max_bytes=None,
+                 host_key=None):
         if interval_s <= 0:
             raise ValueError('interval_s must be > 0')
+        if max_bytes is not None and max_bytes < 1:
+            raise ValueError('max_bytes must be >= 1 (or None for unbounded)')
         self._path = path
         self._interval_s = interval_s
         self._snapshot_fn = snapshot_fn or (lambda: _metrics.get_registry().snapshot())
+        self._max_bytes = max_bytes
+        self._host = host_identity(host_key)
+        try:
+            self._bytes = os.path.getsize(path)
+        except OSError:
+            self._bytes = 0
         self._stop_event = threading.Event()
         self._thread = None
 
@@ -82,11 +146,27 @@ class JsonlExporter(object):
         self._thread.start()
         return self
 
+    def _maybe_rotate(self, pending_bytes):
+        if (self._max_bytes is None or self._bytes == 0
+                or self._bytes + pending_bytes <= self._max_bytes):
+            return
+        backup = self._path + '.1'
+        dropped = _count_lines(backup)  # about to be overwritten
+        if dropped and _metrics.counters_on():
+            _metrics.get_registry().counter('telemetry_export_dropped_total').inc(dropped)
+        try:
+            os.replace(self._path, backup)
+        except OSError:
+            return  # keep appending to the old file rather than losing the flush
+        self._bytes = 0
+
     def _flush(self):
-        line = json.dumps({'ts': round(time.time(), 3),
-                           'metrics': _metrics.flatten_snapshot(self._snapshot_fn())})
+        line = json.dumps({'ts': round(time.time(), 3), 'host': self._host,
+                           'metrics': _metrics.flatten_snapshot(self._snapshot_fn())}) + '\n'
+        self._maybe_rotate(len(line))
         with open(self._path, 'a') as f:
-            f.write(line + '\n')
+            f.write(line)
+        self._bytes += len(line)
 
     def _loop(self):
         while not self._stop_event.wait(self._interval_s):

@@ -19,19 +19,38 @@ measured with ``perf_counter`` for precision. Worker-process events travel to
 the main process piggybacked on the pool's results channel (drained
 incrementally with :meth:`TraceRing.drain`), keyed by their own ``pid`` so
 Perfetto renders one track per process.
+
+Causal tracing (docs/observability.md "trace context"): every ventilated work
+item is minted a :class:`TraceContext` — a trace id ``'<ns>:<seq>'`` (the
+ventilator's 8-hex nonce plus the item's ventilation seq) and a parent span
+id. The context is carried on a thread-local stack: spans opened while a
+context is active stamp ``trace``/``span``/``parent`` into their event args
+and push themselves as the parent of anything nested, so the ring holds a
+reconstructable cross-process span TREE per batch, not a flat list. The trace
+id itself doubles as the id of the (virtual) root node, so any process that
+knows ``(ns, seq)`` — e.g. a serve client reading a ring frame header — can
+derive the root with :func:`trace_root` and parent its own spans to it
+without any extra bytes on the wire.
 """
 
 from __future__ import annotations
 
+import itertools
 import json
 import os
 import threading
 import time
-from collections import deque
+from collections import deque, namedtuple
 
 from petastorm_tpu.observability import metrics as _metrics
 
 DEFAULT_TRACE_CAPACITY = 65536
+
+#: causal identity of one ventilated item: ``trace`` is the stable per-item
+#: trace id (``'<ns>:<seq>'``), ``span`` the id new spans should parent to.
+#: A plain namedtuple: picklable (it rides the process pool's existing
+#: ventilation tuples) and cheap enough to mint per row group.
+TraceContext = namedtuple('TraceContext', ('trace', 'span'))
 
 
 class TraceRing(object):
@@ -108,27 +127,152 @@ def record_span(name, cat, ts_epoch_s, dur_s, args=None):
     _ring.add(event)
 
 
+# -- trace-context propagation ------------------------------------------------
+
+#: per-process monotonic span ids, mixed with the pid so ids stay unique
+#: across the processes whose events merge into one ring (``next`` on
+#: ``itertools.count`` is atomic under the GIL — no lock needed)
+_span_ids = itertools.count(1)
+
+_tls = threading.local()
+
+
+def next_span_id():
+    """A span id unique across every process contributing to a trace."""
+    return '{:x}.{:x}'.format(os.getpid(), next(_span_ids))
+
+
+def trace_root(ns, seq):
+    """The deterministic virtual-root context of item ``seq`` minted under
+    namespace ``ns``: the trace id doubles as the root span id, so any process
+    knowing ``(ns, seq)`` can parent spans to the root with zero extra wire
+    bytes (the serve client derives this from the ring frame header)."""
+    trace_id = '{}:{}'.format(ns, seq)
+    return TraceContext(trace_id, trace_id)
+
+
+def root_of(ctx):
+    """The virtual-root context of ``ctx``'s trace (None in, None out) —
+    consumer-side spans (pool wait, collate, infeed) parent to the root, as
+    siblings of the dispatch chain, not under some arbitrary worker span."""
+    return None if ctx is None else TraceContext(ctx.trace, ctx.trace)
+
+
+def current_trace():
+    """The innermost active :class:`TraceContext` on this thread (or None)."""
+    stack = getattr(_tls, 'stack', None)
+    return stack[-1] if stack else None
+
+
+def _push_trace(ctx):
+    stack = getattr(_tls, 'stack', None)
+    if stack is None:
+        stack = _tls.stack = []
+    stack.append(ctx)
+
+
+def _pop_trace():
+    stack = getattr(_tls, 'stack', None)
+    if stack:
+        stack.pop()
+
+
+class _TraceScope(object):
+    """Context manager installing one :class:`TraceContext` as this thread's
+    active context (worker pools wrap ``worker.process`` in one so every stage
+    inside lands in the item's span tree)."""
+
+    __slots__ = ('_ctx',)
+
+    def __init__(self, ctx):
+        self._ctx = ctx
+
+    def __enter__(self):
+        _push_trace(self._ctx)
+        return self._ctx
+
+    def __exit__(self, exc_type, exc_value, tb):
+        _pop_trace()
+        return False
+
+
+def use_trace(ctx):
+    """Install a propagated :class:`TraceContext` around a block (no-op when
+    ``ctx`` is None or the level is below ``'spans'``)."""
+    if ctx is None or not _metrics.spans_on():
+        return _NOOP_SPAN
+    return _TraceScope(ctx)
+
+
+def mint_trace(ns, seq):
+    """Mint the trace for one ventilated item and install its root context
+    (the ventilators call this around their dispatch block, so the ventilate
+    span becomes the root's first child and ``pool.ventilate`` — which runs
+    inside — captures the context for propagation)."""
+    if not _metrics.spans_on():
+        return _NOOP_SPAN
+    return _TraceScope(trace_root(ns, seq))
+
+
 class _Span(object):
     """Context manager recording one complete event on exit. Use only via
     :func:`span`/:func:`petastorm_tpu.observability.stage` so the off-level
-    fast path stays a single int check."""
+    fast path stays a single int check.
 
-    __slots__ = ('name', 'cat', 'args', '_t0', '_wall0')
+    When a :class:`TraceContext` is active on the thread, the span stamps
+    ``trace``/``span``/``parent`` into its event args and installs itself as
+    the parent of anything opened inside it. :meth:`link` attaches the span to
+    a context discovered only mid-flight (``pool_wait`` learns its item's
+    identity from the frame it receives, after the span already opened)."""
+
+    __slots__ = ('name', 'cat', 'args', '_t0', '_wall0', '_ctx', '_link',
+                 '_sid', '_pushed')
 
     def __init__(self, name, cat, args):
         self.name = name
         self.cat = cat
         self.args = args
+        self._link = None
 
     def __enter__(self):
         self._wall0 = time.time()
+        ctx = current_trace()
+        self._ctx = ctx
+        if ctx is not None:
+            self._sid = next_span_id()
+            _push_trace(TraceContext(ctx.trace, self._sid))
+            self._pushed = True
+        else:
+            self._sid = None
+            self._pushed = False
         self._t0 = time.perf_counter()
         return self
 
+    def link(self, ctx):
+        """Adopt ``ctx`` as this span's parent context (overrides whatever was
+        active at entry; None is ignored)."""
+        if ctx is not None:
+            self._link = ctx
+
     def __exit__(self, exc_type, exc_value, tb):
-        record_span(self.name, self.cat, self._wall0,
-                    time.perf_counter() - self._t0, self.args)
+        dur = time.perf_counter() - self._t0
+        if self._pushed:
+            _pop_trace()
+        record_span(self.name, self.cat, self._wall0, dur,
+                    stamp_trace_args(self.args, self._link or self._ctx, self._sid))
         return False
+
+
+def stamp_trace_args(args, ctx, sid=None):
+    """Event args with the causal identity stamped in (``args`` unchanged when
+    no context is active)."""
+    if ctx is None:
+        return args
+    out = dict(args) if args else {}
+    out['trace'] = ctx.trace
+    out['span'] = sid if sid is not None else next_span_id()
+    out['parent'] = ctx.span
+    return out
 
 
 class _NoopSpan(object):
@@ -139,6 +283,9 @@ class _NoopSpan(object):
 
     def __exit__(self, exc_type, exc_value, tb):
         return False
+
+    def link(self, ctx):
+        return None
 
 
 _NOOP_SPAN = _NoopSpan()
@@ -153,10 +300,12 @@ def span(name, cat='pipeline', **args):
 
 
 def instant(name, cat='pipeline', **args):
-    """Zero-duration event (cache hit, rotation, …) at level ``'spans'``."""
+    """Zero-duration event (cache hit, rotation, …) at level ``'spans'``.
+    Stamped into the active trace (as a leaf) when a context is installed."""
     if not _metrics.spans_on():
         return
-    record_span(name, cat, time.time(), 0.0, args or None)
+    record_span(name, cat, time.time(), 0.0,
+                stamp_trace_args(args or None, current_trace()))
 
 
 def chrome_trace(events=None):

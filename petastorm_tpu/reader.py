@@ -725,6 +725,16 @@ class Reader(object):
         return getattr(self._pool, 'quarantined_items', [])
 
     @property
+    def last_trace(self):
+        """Virtual-root :class:`~petastorm_tpu.observability.TraceContext` of
+        the most recently returned item, or None when tracing is off (telemetry
+        level below ``'spans'``) or nothing was read yet. Downstream consumers
+        (the loader's collate stage, infeed) link their spans to it so a
+        batch's span tree stays causally connected across process boundaries
+        (docs/observability.md, "Causal tracing")."""
+        return getattr(self._pool, 'last_result_trace', None)
+
+    @property
     def diagnostics(self):
         """Pipeline health view: the unified pool schema (``workers_count``,
         ``items_ventilated``/``items_completed``/``items_in_flight``,

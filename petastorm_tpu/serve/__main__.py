@@ -34,6 +34,11 @@ def main(argv=None):
     parser.add_argument('--evict-block', type=float, default=None,
                         help='evict the slowest consumer after a publish stays '
                              'blocked this long (default 10s)')
+    parser.add_argument('--telemetry', choices=('off', 'counters', 'spans'),
+                        default=None,
+                        help="daemon telemetry level; 'spans' records the "
+                             'causal span tree clients fetch via the trace '
+                             'control op (default: keep the process default)')
     parser.add_argument('-v', '--verbose', action='store_true')
     args = parser.parse_args(argv)
 
@@ -52,7 +57,8 @@ def main(argv=None):
         ring_bytes=args.ring_bytes or DEFAULT_SERVE_RING_BYTES,
         idle_timeout_s=None if idle is not None and idle <= 0 else idle,
         evict_block_s=(args.evict_block if args.evict_block is not None
-                       else DEFAULT_EVICT_BLOCK_S))
+                       else DEFAULT_EVICT_BLOCK_S),
+        telemetry=args.telemetry)
     service.start()
     try:
         service.serve_forever()

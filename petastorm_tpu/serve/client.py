@@ -75,10 +75,15 @@ def _spawn_daemon(service_dir, spawn_args):
                       ('workers_count', '--workers-count'),
                       ('ring_bytes', '--ring-bytes'),
                       ('idle_timeout_s', '--idle-timeout'),
-                      ('evict_block_s', '--evict-block')):
+                      ('evict_block_s', '--evict-block'),
+                      ('telemetry', '--telemetry')):
         value = spawn_args.get(key)
         if value is not None:
             argv += [flag, str(value)]
+    if spawn_args.get('telemetry') is None and obs.spans_on():
+        # a tracing client spawns a tracing daemon: otherwise the served
+        # batch's tree has a client half only
+        argv += ['--telemetry', 'spans']
     env = dict(os.environ)
     pkg_parent = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
@@ -175,11 +180,17 @@ class _ServedPoolFacade(object):
     (``get_results`` / ``last_result_seq`` / ``done_callback``) over a
     broadcast-ring consumer slot."""
 
-    def __init__(self, ring, token, daemon_pid, tenant_id, monitor=None):
+    def __init__(self, ring, token, daemon_pid, tenant_id, monitor=None,
+                 trace_ns=None):
         self._ring = ring
         self._token = token
         self._daemon_pid = daemon_pid
         self._tenant_id = tenant_id
+        # the daemon broker's trace-mint namespace (attach reply): with it
+        # every frame's trace root derives from the seq in the ring header,
+        # so client-side spans join the daemon-side tree with zero extra
+        # wire bytes
+        self._trace_ns = trace_ns
         self._serializer = NumpyBlockSerializer()
         self._stopped = False
         self._ended = False
@@ -188,92 +199,104 @@ class _ServedPoolFacade(object):
         self.monitor = monitor
         self.batches_received = 0
         self.bytes_received = 0
+        self.last_result_trace = None
+
+    def _note_result(self, seq):
+        """Bookkeeping shared by every payload-carrying frame kind."""
+        self.last_result_seq = seq
+        if self._trace_ns is not None and seq is not None and obs.spans_on():
+            self.last_result_trace = obs.trace_root(self._trace_ns, seq)
+        self.batches_received += 1
 
     def get_results(self):
+        with obs.stage('pool_wait', cat='pool') as sp:
+            payload = self._get_results()
+            # the frame's identity is only known after the read, so the wait
+            # span joins the batch's tree retroactively
+            sp.link(self.last_result_trace)
+            return payload
+
+    def _get_results(self):
         from petastorm_tpu.native.shm_ring import BcastConsumerGone
-        with obs.stage('pool_wait', cat='pool'):
-            while True:
-                if self._ended:
+        while True:
+            if self._ended:
+                raise EmptyResultError()
+            try:
+                view = self._ring.read_view(self._token,
+                                            stop_check=lambda: self._stopped,
+                                            timeout_s=_LIVENESS_PERIOD_S)
+            except BcastConsumerGone as e:
+                if e.evicted:
+                    raise ConsumerEvictedError(
+                        'this consumer was evicted by the serve daemon (it '
+                        'lagged far enough to stall the fleet) — consume '
+                        'faster, raise serve ring_bytes, or re-attach '
+                        '(docs/serve.md)', tenant_id=self._tenant_id)
+                raise ServeError('serve consumer slot was released '
+                                 '(detached elsewhere?)')
+            if view is None:
+                if self._stopped:
                     raise EmptyResultError()
+                if not _pid_alive(self._daemon_pid):
+                    raise ServeDaemonDiedError(
+                        'serve daemon (pid {}) died with this consumer '
+                        'attached; re-run make_reader(serve=...) to spawn '
+                        'a replacement'.format(self._daemon_pid))
+                continue
+            kind, seq, payload = ring_unpack(view)
+            if kind == SERVE_DATA:
+                if self.monitor is not None:
+                    self.monitor.on_deliver(seq)
+                self._note_result(seq)
+                self.bytes_received += len(payload)
+                return self._serializer.deserialize(payload)
+            elif kind == SERVE_COLS:
+                # the zero-copy plane: the fused decode wrote the batch
+                # straight into the blob; build typed views over the
+                # COW mapping from the layout descriptor
+                import pickle
+                desc = pickle.loads(bytes(payload))
+                if self.monitor is not None:
+                    self.monitor.on_deliver(seq)
+                self._note_result(seq)
+                self.bytes_received += desc['size']
+                mv = _map_blob(desc['path'], desc['size'], self._tenant_id)
+                import numpy as np
+                block = {}
+                for name, dtype_str, shape, off, nbytes in desc['cols']:
+                    block[name] = np.frombuffer(
+                        mv[off:off + nbytes],
+                        dtype=np.dtype(dtype_str)).reshape(shape)
+                return block
+            elif kind == SERVE_BLOB:
+                # the batch sits in a shared /dev/shm blob: COW-map it
+                # (writable numpy views, zero upfront copy); the daemon
+                # reclaims the file once the fleet's cursors passed this
+                # frame (plus a grace covering exactly this window)
+                size_s, path = bytes(payload).decode().split('|', 1)
+                if self.monitor is not None:
+                    self.monitor.on_deliver(seq)
+                self._note_result(seq)
+                self.bytes_received += int(size_s)
+                return self._serializer.deserialize(
+                    _map_blob(path, int(size_s), self._tenant_id))
+            elif kind == SERVE_DONE:
+                if self.done_callback is not None and seq is not None:
+                    self.done_callback(seq)
+            elif kind == SERVE_END:
+                if self.monitor is not None:
+                    self.monitor.on_consumer_end()
+                self._ended = True
+                raise EmptyResultError()
+            elif kind == SERVE_ERROR:
+                import pickle
                 try:
-                    view = self._ring.read_view(self._token,
-                                                stop_check=lambda: self._stopped,
-                                                timeout_s=_LIVENESS_PERIOD_S)
-                except BcastConsumerGone as e:
-                    if e.evicted:
-                        raise ConsumerEvictedError(
-                            'this consumer was evicted by the serve daemon (it '
-                            'lagged far enough to stall the fleet) — consume '
-                            'faster, raise serve ring_bytes, or re-attach '
-                            '(docs/serve.md)', tenant_id=self._tenant_id)
-                    raise ServeError('serve consumer slot was released '
-                                     '(detached elsewhere?)')
-                if view is None:
-                    if self._stopped:
-                        raise EmptyResultError()
-                    if not _pid_alive(self._daemon_pid):
-                        raise ServeDaemonDiedError(
-                            'serve daemon (pid {}) died with this consumer '
-                            'attached; re-run make_reader(serve=...) to spawn '
-                            'a replacement'.format(self._daemon_pid))
-                    continue
-                kind, seq, payload = ring_unpack(view)
-                if kind == SERVE_DATA:
-                    if self.monitor is not None:
-                        self.monitor.on_deliver(seq)
-                    self.last_result_seq = seq
-                    self.batches_received += 1
-                    self.bytes_received += len(payload)
-                    return self._serializer.deserialize(payload)
-                elif kind == SERVE_COLS:
-                    # the zero-copy plane: the fused decode wrote the batch
-                    # straight into the blob; build typed views over the
-                    # COW mapping from the layout descriptor
-                    import pickle
-                    desc = pickle.loads(bytes(payload))
-                    if self.monitor is not None:
-                        self.monitor.on_deliver(seq)
-                    self.last_result_seq = seq
-                    self.batches_received += 1
-                    self.bytes_received += desc['size']
-                    mv = _map_blob(desc['path'], desc['size'], self._tenant_id)
-                    import numpy as np
-                    block = {}
-                    for name, dtype_str, shape, off, nbytes in desc['cols']:
-                        block[name] = np.frombuffer(
-                            mv[off:off + nbytes],
-                            dtype=np.dtype(dtype_str)).reshape(shape)
-                    return block
-                elif kind == SERVE_BLOB:
-                    # the batch sits in a shared /dev/shm blob: COW-map it
-                    # (writable numpy views, zero upfront copy); the daemon
-                    # reclaims the file once the fleet's cursors passed this
-                    # frame (plus a grace covering exactly this window)
-                    size_s, path = bytes(payload).decode().split('|', 1)
-                    if self.monitor is not None:
-                        self.monitor.on_deliver(seq)
-                    self.last_result_seq = seq
-                    self.batches_received += 1
-                    self.bytes_received += int(size_s)
-                    return self._serializer.deserialize(
-                        _map_blob(path, int(size_s), self._tenant_id))
-                elif kind == SERVE_DONE:
-                    if self.done_callback is not None and seq is not None:
-                        self.done_callback(seq)
-                elif kind == SERVE_END:
-                    if self.monitor is not None:
-                        self.monitor.on_consumer_end()
-                    self._ended = True
-                    raise EmptyResultError()
-                elif kind == SERVE_ERROR:
-                    import pickle
-                    try:
-                        err = pickle.loads(bytes(payload))
-                    except Exception:  # noqa: BLE001 - a garbled report must still fail loudly
-                        err = ServeError('serve daemon reported an unreadable error')
-                    raise ServeError('serve daemon stream failed: {}'.format(err))
-                else:
-                    logger.warning('dropping serve frame with unknown kind %r', kind)
+                    err = pickle.loads(bytes(payload))
+                except Exception:  # noqa: BLE001 - a garbled report must still fail loudly
+                    err = ServeError('serve daemon reported an unreadable error')
+                raise ServeError('serve daemon stream failed: {}'.format(err))
+            else:
+                logger.warning('dropping serve frame with unknown kind %r', kind)
 
     def stop(self):
         self._stopped = True
@@ -310,7 +333,8 @@ class ServedReader(object):
         self._ring = BcastRing.attach(reply['ring_name'])
         self._facade = _ServedPoolFacade(self._ring, reply['token'],
                                          reply['daemon_pid'], self.tenant_id,
-                                         monitor=monitor)
+                                         monitor=monitor,
+                                         trace_ns=reply.get('trace_ns'))
         self._results_queue_reader = results_queue_reader_factory(
             self.transformed_schema)
         self.last_row_consumed = False
@@ -365,6 +389,12 @@ class ServedReader(object):
             diag['serve_evictions'] = stats.get('evictions', 0)
         return diag
 
+    @property
+    def last_trace(self):
+        """Virtual-root TraceContext of the most recently delivered batch
+        (derived client-side from the frame seq + the daemon's trace_ns)."""
+        return self._facade.last_result_trace
+
     def service_stats(self):
         """The daemon's full stats document, or None when it is unreachable."""
         if self._conn is None:
@@ -375,6 +405,25 @@ class ServedReader(object):
             return reply.get('stats') if reply.get('ok') else None
         except (OSError, EOFError, ValueError):
             return None
+
+    def service_trace_events(self, absorb=True):
+        """Fetch a snapshot of the daemon's span ring (ventilate + worker +
+        daemon pool-wait spans) so a client can reconstruct a served batch's
+        full cross-process tree. With ``absorb`` (default) the events merge
+        into this process's ring; the list is returned either way. Returns []
+        when the daemon is unreachable."""
+        if self._conn is None:
+            return []
+        try:
+            self._conn.send({'op': 'trace'})
+            reply = self._conn.recv()
+        except (OSError, EOFError, ValueError):
+            return []
+        events = reply.get('events') if reply.get('ok') else None
+        events = events or []
+        if absorb:
+            obs.absorb_trace_events(events)
+        return events
 
     def stop(self):
         if self._stopped:

@@ -155,8 +155,11 @@ class ReaderService(object):
                  blob_threshold_bytes=DEFAULT_SERVE_BLOB_THRESHOLD,
                  blob_budget_bytes=DEFAULT_BLOB_BUDGET_BYTES,
                  blob_gc_grace_s=DEFAULT_BLOB_GC_GRACE_S,
-                 monitor=None):
+                 monitor=None, telemetry=None):
         self.service_dir = os.path.abspath(service_dir)
+        # applied at start(): 'spans' makes served batches causally traceable
+        # end to end (the daemon-side tree is fetched via the 'trace' op)
+        self._telemetry = telemetry
         self._pool_type = pool_type
         self._workers_count = workers_count
         self._ring_bytes = ring_bytes
@@ -190,6 +193,7 @@ class ReaderService(object):
 
     def start(self):
         os.makedirs(os.path.join(self.service_dir, 'streams'), exist_ok=True)
+        obs.configure(self._telemetry)  # None keeps the ambient level
         from petastorm_tpu.reader import _make_pool
         # the fleet is resilient by default: a poison item quarantines (loud,
         # counted) instead of killing every tenant's stream
@@ -346,6 +350,10 @@ class ReaderService(object):
             return {'ok': self.detach(tenant_id)}
         if op == 'stats':
             return {'ok': True, 'stats': self.stats()}
+        if op == 'trace':
+            # a SNAPSHOT, not a drain: many tenants may ask, and a drain
+            # would hand each one a disjoint slice of the daemon's ring
+            return {'ok': True, 'events': obs.get_ring().snapshot()}
         if op == 'shutdown':
             threading.Thread(target=self.shutdown, daemon=True).start()
             return {'ok': True}
@@ -400,6 +408,10 @@ class ReaderService(object):
         return {'ok': True, 'tenant_id': tenant_id, 'stream_id': stream_id,
                 'ring_name': stream.ring_name, 'token': token,
                 'daemon_pid': os.getpid(),
+                # the broker's trace-mint namespace: with it, a client derives
+                # every frame's trace root from the seq already in the ring
+                # header — causal linkage costs zero extra wire bytes
+                'trace_ns': self._ventilator.trace_ns,
                 'client_plan': stream.plan.client_plan()}
 
     def _create_stream(self, stream_id, spec):

@@ -14,6 +14,13 @@ as the shm ring the results ride back on).
 The inner worker receives this worker's ``publish_func`` unchanged, so the
 PR 6 in-place fused publish path (``publish.reserve_block``) keeps working
 under multiplexing.
+
+Causal tracing needs no code here: the daemon's pool installs each item's
+``TraceContext`` around ``process()`` (``obs.use_trace``), so the delegated
+inner worker's spans parent into the item's tree automatically, and clients
+derive each frame's trace root from the ``FairShareVentilator``'s
+``trace_ns`` (attach reply) + the ring header's seq — see
+docs/observability.md "Causal tracing".
 """
 
 from __future__ import annotations

@@ -34,8 +34,8 @@ logger = logging.getLogger(__name__)
 class DummyPool(object):
     def __init__(self, workers_count=1, results_queue_size=None,
                  on_error='raise', max_item_retries=None, protocol_monitor=None):
-        self._results = deque()  # (MSG_DATA, seq, payload) | (MSG_DONE, seq, None)
-        self._pending = deque()  # (dispatch, args, kwargs, attempts) (_seq rides kwargs)
+        self._results = deque()  # (MSG_DATA, seq, payload, ctx) | (MSG_DONE, seq, None, None)
+        self._pending = deque()  # (dispatch, args, kwargs, attempts, ctx) (_seq rides kwargs)
         self._pending_lock = threading.Lock()
         # serializes worker.process against join()'s worker.shutdown: the
         # consumer thread may be mid-read inside native code (mmapped pages)
@@ -51,6 +51,7 @@ class DummyPool(object):
         self._current_seq = None
         self._current_dispatch = None
         self._current_published = False
+        self._current_trace = None
         self._dispatch_ids = DispatchIds()
         self._ventilated_items = 0
         self._completed_items = 0
@@ -63,6 +64,8 @@ class DummyPool(object):
         # checkpoint plumbing (see thread_pool.py)
         self.last_result_seq = None
         self.done_callback = None
+        # trace linkage: virtual-root TraceContext of the last payload
+        self.last_result_trace = None
         # opt-in protocol conformance monitor (docs/protocol.md). The dummy
         # pool runs worker.process on the consumer thread, so payloads enter
         # the results deque BEFORE the item's completion bookkeeping — the
@@ -87,15 +90,18 @@ class DummyPool(object):
         self._current_published = True
         if self.protocol_monitor is not None and self._current_dispatch is not None:
             self.protocol_monitor.on_message('data', self._current_dispatch, live=True)
-        self._results.append((MSG_DATA, self._current_seq, data))
+        self._results.append((MSG_DATA, self._current_seq, data, self._current_trace))
 
     def ventilate(self, *args, **kwargs):
+        # the ventilator's mint block is still active here: the context rides
+        # the pending tuple — no extra queue traffic
+        ctx = obs.current_trace()
         with self._pending_lock:
             self._ventilated_items += 1
             d = self._dispatch_ids.next()
             if self.protocol_monitor is not None:
                 self.protocol_monitor.on_dispatch(d, dict(kwargs).get('_seq'))
-            self._pending.append((d, args, kwargs, 0))
+            self._pending.append((d, args, kwargs, 0, ctx))
 
     def _process_one(self):
         """Run one pending task on THIS thread. Returns False when none were
@@ -103,11 +109,12 @@ class DummyPool(object):
         with self._pending_lock:
             if not self._pending:
                 return False
-            d, args, orig_kwargs, attempts = self._pending.popleft()
+            d, args, orig_kwargs, attempts, ctx = self._pending.popleft()
         kwargs = dict(orig_kwargs)
         self._current_seq = kwargs.pop('_seq', None)
         self._current_dispatch = d
         self._current_published = False
+        self._current_trace = ctx
         completed = True
         delivered = False
         try:
@@ -116,8 +123,9 @@ class DummyPool(object):
                 if worker is None:
                     return False  # joined concurrently: nothing left to run
                 faults.on_item(kwargs)
-                worker.process(*args, **kwargs)
-            self._results.append((MSG_DONE, self._current_seq, None))
+                with obs.use_trace(ctx):
+                    worker.process(*args, **kwargs)
+            self._results.append((MSG_DONE, self._current_seq, None, None))
             delivered = True
         except Exception as e:  # noqa: BLE001 - routed through the error policy
             completed, delivered = self._handle_item_failure(e, d, args, orig_kwargs,
@@ -142,7 +150,7 @@ class DummyPool(object):
             # requeue_published counterexample); complete delivered instead
             logger.warning('Item seq=%s failed AFTER publishing; completing the '
                            'item rather than re-running it: %s', seq, exc)
-            self._results.append((MSG_DONE, seq, None))
+            self._results.append((MSG_DONE, seq, None, None))
             return True, True
         if self._policy.should_retry_error(attempts):
             logger.warning('Item seq=%s failed (attempt %d/%d); requeueing: %s',
@@ -151,7 +159,9 @@ class DummyPool(object):
                 nd = self._dispatch_ids.next()
                 if self.protocol_monitor is not None:
                     self.protocol_monitor.on_requeue(d, nd)
-                self._pending.append((nd, args, orig_kwargs, attempts))
+                # retries keep the original TraceContext (same item, same tree)
+                self._pending.append((nd, args, orig_kwargs, attempts,
+                                      self._current_trace))
                 self._items_requeued += 1
             obs.count('items_requeued')
             return False, False
@@ -175,9 +185,10 @@ class DummyPool(object):
         """Pop queued entries until a payload is found; process completion
         sentinels on the way. Returns the payload or None."""
         while self._results:
-            kind, seq, payload = self._results.popleft()
+            kind, seq, payload, ctx = self._results.popleft()
             if kind == MSG_DATA:
                 self.last_result_seq = seq
+                self.last_result_trace = obs.root_of(ctx)
                 return payload
             if seq is not None and self.done_callback is not None:
                 self.done_callback(seq)
@@ -188,8 +199,10 @@ class DummyPool(object):
         # thread inside get_results, so the pool-wait timer here CONTAINS the
         # worker stage timers — which is exactly what the stall report's
         # proportional split over worker busy time expects.
-        with obs.stage('pool_wait', cat='pool'):
-            return self._get_results()
+        with obs.stage('pool_wait', cat='pool') as sp:
+            payload = self._get_results()
+            sp.link(self.last_result_trace)
+            return payload
 
     def _get_results(self):
         while True:

@@ -251,6 +251,9 @@ class ProcessPool(object):
         # the ventilator-assigned item seq through the in-flight records
         self.last_result_seq = None
         self.done_callback = None
+        # trace linkage: virtual-root TraceContext of the last payload,
+        # resolved from the in-flight record (no trace bytes on the ring)
+        self.last_result_trace = None
         # pid -> latest cumulative metrics snapshot from that worker process
         # (consumer thread only; merged by Reader.diagnostics)
         self._telemetry_by_pid = {}
@@ -517,17 +520,21 @@ class ProcessPool(object):
 
     def ventilate(self, *args, **kwargs):
         seq = kwargs.pop('_seq', None)
+        # ventilate runs inside the ventilator's mint block: the captured
+        # TraceContext rides the existing ventilation tuple into the worker
+        # process — same single send, zero extra channel messages
+        ctx = obs.current_trace()
         with self._state_lock:
             self._ventilated_items += 1
             d = self._dispatch_ids.next()
             self._inflight[d] = {'seq': seq, 'args': args, 'kwargs': kwargs,
-                                 'attempts': 0, 'published': False}
+                                 'attempts': 0, 'published': False, 'trace': ctx}
             if self.protocol_monitor is not None:
                 # inside the lock: id allocation and the dispatch event must
                 # be atomic or concurrent ventilates report out of order
                 self.protocol_monitor.on_dispatch(d, seq)
         with self._vent_lock:
-            self._ventilator_send.send_pyobj((d, args, kwargs))
+            self._ventilator_send.send_pyobj((d, args, kwargs, ctx))
 
     def _requeue(self, d, rec):
         """Re-dispatch an in-flight item under a NEW dispatch id (any straggler
@@ -547,7 +554,9 @@ class ProcessPool(object):
                 self.protocol_monitor.on_requeue(d, nd)
         obs.count('items_requeued')
         with self._vent_lock:
-            self._ventilator_send.send_pyobj((nd, rec['args'], rec['kwargs']))
+            # the retry keeps the original TraceContext (same logical item)
+            self._ventilator_send.send_pyobj((nd, rec['args'], rec['kwargs'],
+                                              rec.get('trace')))
 
     def _complete(self, d, rec, delivered):
         """Exactly-once completion accounting for one logical item:
@@ -570,8 +579,12 @@ class ProcessPool(object):
             self.done_callback(rec['seq'])
 
     def get_results(self, timeout_s=None):
-        with obs.stage('pool_wait', cat='pool'):
-            return self._get_results(timeout_s)
+        with obs.stage('pool_wait', cat='pool') as sp:
+            payload = self._get_results(timeout_s)
+            # the item is only known once its frame arrives, so the wait span
+            # joins its tree retroactively
+            sp.link(self.last_result_trace)
+            return payload
 
     def _get_results(self, timeout_s=None):
         timeout_s = timeout_s if timeout_s is not None else self._results_timeout_s
@@ -616,6 +629,10 @@ class ProcessPool(object):
                 if rec is not None:
                     rec['published'] = True
                 self.last_result_seq = rec['seq'] if rec is not None else None
+                # derived from the inflight record — the data frame itself
+                # carries no trace bytes
+                self.last_result_trace = obs.root_of(
+                    rec.get('trace')) if rec is not None else None
                 if kind == MSG_DATA:
                     return self._serializer.deserialize(payload)
                 return self._serializer.deserialize(_read_blob(bytes(payload).decode()))
@@ -1356,14 +1373,19 @@ def _worker_bootstrap(worker_id, main_pid, setup_blob, vent_addr, result_addr, c
                 if finished['flag'] or control_recv.recv() == CONTROL_FINISHED:
                     break
             if vent_recv in events:
-                dispatch, args, kwargs = vent_recv.recv_pyobj()
+                dispatch, args, kwargs, trace_ctx = vent_recv.recv_pyobj()
                 current['seq'] = dispatch
                 # claim beacon FIRST: if this item kills the process, the
                 # supervisor knows exactly what to requeue
                 send_heartbeat(dispatch, blocking=True)
                 try:
                     faults.on_item(kwargs)
-                    worker.process(*args, **kwargs)
+                    # the item's TraceContext (minted in the main process)
+                    # becomes this thread's active context: worker stages
+                    # land in the item's cross-process span tree, and the
+                    # events ship back on the existing MSG_METRICS piggyback
+                    with obs.use_trace(trace_ctx):
+                        worker.process(*args, **kwargs)
                     send(MSG_DONE, current['seq'])
                     flush_telemetry()
                 except Exception:  # noqa: BLE001 - forwarded to the main process

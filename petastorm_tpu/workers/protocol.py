@@ -19,6 +19,12 @@ Protocol summary (full semantics in ``docs/protocol.md``):
   ``busy=<dispatch id>``) BEFORE processing; the item's ``MSG_DONE`` /
   ``MSG_ERROR`` implicitly releases the claim (the channel is FIFO, so the
   claim always precedes its item's completion).
+* At spans level, the item's ``TraceContext`` rides the SAME records the
+  dispatch id does — a reserved slot in the task/result tuples and dispatch
+  frames, ``None`` below spans level — and the worker-side span events ship
+  home on the existing ``MSG_METRICS`` piggyback. Causal tracing
+  (docs/observability.md "Causal tracing") adds no message kinds and no
+  extra queue traffic; ``tests/test_tracing.py`` pins this structurally.
 """
 
 from __future__ import annotations

@@ -30,8 +30,10 @@ from petastorm_tpu import faults, observability as obs
 from petastorm_tpu.errors import EmptyResultError, WorkerTerminationRequested
 # in-process pools speak the same canonical message-kind vocabulary as the
 # wire protocol (workers/protocol.py): results-queue records are
-# (kind, seq, payload, dispatch_id) tuples, dispatch ids are allocated by the
-# shared monotonic allocator, and PT801 rejects local kind definitions
+# (kind, seq, payload, dispatch_id, trace_ctx) tuples, dispatch ids are
+# allocated by the shared monotonic allocator, and PT801 rejects local kind
+# definitions. The trace_ctx slot carries the item's TraceContext on MSG_DATA
+# — context rides the existing record, never an extra message
 from petastorm_tpu.workers.protocol import MSG_DATA, MSG_DONE, MSG_ERROR, DispatchIds
 from petastorm_tpu.workers.supervision import (ErrorPolicy, attach_remote_context,
                                                format_exception_tb, quarantine_record)
@@ -80,6 +82,9 @@ class ThreadPool(object):
         # consumed (used by results-queue readers to mark empty items delivered)
         self.last_result_seq = None
         self.done_callback = None
+        # trace linkage: virtual-root TraceContext of the item whose payload
+        # get_results last returned (None below spans level)
+        self.last_result_trace = None
 
     @property
     def workers_count(self):
@@ -134,6 +139,11 @@ class ThreadPool(object):
 
     def ventilate(self, *args, **kwargs):
         seq = kwargs.pop('_seq', None)
+        # ventilate runs inside the ventilator's mint block, so the active
+        # context here IS this item's identity; it rides the existing task
+        # tuple — no extra queue traffic (the structural-overhead guard in
+        # tests/test_tracing.py counts on this)
+        ctx = obs.current_trace()
         with self._counter_lock:
             self._ventilated_items += 1
             d = self._dispatch_ids.next()
@@ -141,20 +151,24 @@ class ThreadPool(object):
                 # under the lock: allocation + dispatch event must be atomic
                 # or concurrent ventilates report ids out of order
                 self.protocol_monitor.on_dispatch(d, seq)
-        self._task_queue.put((d, seq, args, kwargs, 0))
+        self._task_queue.put((d, seq, args, kwargs, 0, ctx))
 
     def get_results(self):
         """Block until a result is available; raise :class:`EmptyResultError` when
         all ventilated items are processed and no more will be ventilated."""
         # the pool-wait stage timer is what the stall report decomposes the
         # loader's reader_wait_s against (docs/observability.md)
-        with obs.stage('pool_wait', cat='pool'):
-            return self._get_results()
+        with obs.stage('pool_wait', cat='pool') as sp:
+            payload = self._get_results()
+            # the item is only known once its frame arrives, so the wait span
+            # joins its tree retroactively
+            sp.link(self.last_result_trace)
+            return payload
 
     def _get_results(self):
         while True:
             try:
-                kind, seq, payload, d = self._results_queue.get(block=False)
+                kind, seq, payload, d, ctx = self._results_queue.get(block=False)
             except queue.Empty:
                 if self._all_done():
                     if self.protocol_monitor is not None and not self._stop_event.is_set():
@@ -164,13 +178,14 @@ class ThreadPool(object):
                         self.protocol_monitor.on_drained(ventilated, completed)
                     raise EmptyResultError()
                 try:
-                    kind, seq, payload, d = self._results_queue.get(timeout=0.05)
+                    kind, seq, payload, d, ctx = self._results_queue.get(timeout=0.05)
                 except queue.Empty:
                     continue
             if kind == MSG_DATA:
                 if self.protocol_monitor is not None:
                     self.protocol_monitor.on_message('data', d, live=True)
                 self.last_result_seq = seq
+                self.last_result_trace = obs.root_of(ctx)
                 return payload
             elif kind == MSG_DONE:
                 if self.protocol_monitor is not None:
@@ -267,7 +282,8 @@ class ThreadPool(object):
     def _publish(self, data):
         self._tls.published = True
         self._stop_aware_put((MSG_DATA, getattr(self._tls, 'seq', None), data,
-                              getattr(self._tls, 'dispatch', None)))
+                              getattr(self._tls, 'dispatch', None),
+                              getattr(self._tls, 'trace', None)))
 
     def _stop_aware_put(self, item):
         """Bounded put that aborts when the pool is stopping, so workers never
@@ -280,7 +296,7 @@ class ThreadPool(object):
                 continue
         raise WorkerTerminationRequested()
 
-    def _handle_item_failure(self, worker, d, seq, args, kwargs, attempts):
+    def _handle_item_failure(self, worker, d, seq, args, kwargs, attempts, ctx):
         """Apply the on_error policy to one failed item, on the worker thread.
         ``attempts`` counts this failure. May raise WorkerTerminationRequested
         (propagated by the loop)."""
@@ -294,7 +310,7 @@ class ThreadPool(object):
             logger.warning('Worker %d failed on item seq=%s AFTER publishing; '
                            'completing the item rather than re-running it: %s',
                            worker.worker_id, seq, exc)
-            self._stop_aware_put((MSG_DONE, seq, True, d))
+            self._stop_aware_put((MSG_DONE, seq, True, d, None))
             return
         if self._policy.should_retry_error(attempts):
             logger.warning('Worker %d failed on item seq=%s (attempt %d/%d); requeueing: %s',
@@ -306,7 +322,9 @@ class ThreadPool(object):
                 if self.protocol_monitor is not None:
                     self.protocol_monitor.on_requeue(d, nd)
             obs.count('items_requeued')
-            self._task_queue.put((nd, seq, args, kwargs, attempts))
+            # the retry keeps the original TraceContext: it is the same item,
+            # and its (eventual) spans must land in the same tree
+            self._task_queue.put((nd, seq, args, kwargs, attempts, ctx))
             return
         if self._policy.quarantines():
             record = quarantine_record(seq, attempts, 'error', error=exc,
@@ -321,15 +339,15 @@ class ThreadPool(object):
             # undelivered completion sentinel: the item counts complete for
             # epoch/flow-control/tenant-budget accounting but is never marked
             # delivered (the delivered flag, not a dropped seq, encodes that)
-            self._stop_aware_put((MSG_DONE, seq, False, d))
+            self._stop_aware_put((MSG_DONE, seq, False, d, None))
             return
         logger.exception('Worker %d failed processing an item', worker.worker_id)
         attach_remote_context(exc, format_exception_tb(exc),
                               worker_id=worker.worker_id, seq=seq)
-        self._stop_aware_put((MSG_ERROR, None, exc, d))
+        self._stop_aware_put((MSG_ERROR, None, exc, d, None))
         # undelivered sentinel: flow control counts the item but it is
         # NOT marked delivered — a checkpoint will re-read it
-        self._stop_aware_put((MSG_DONE, seq, False, d))
+        self._stop_aware_put((MSG_DONE, seq, False, d, None))
 
     def _worker_loop(self, worker):
         profiler = None
@@ -344,25 +362,30 @@ class ThreadPool(object):
                     continue
                 if task is _RETIRE:
                     return  # deliberate slot retire (worker.shutdown in finally)
-                d, seq, args, kwargs, attempts = task
+                d, seq, args, kwargs, attempts, ctx = task
                 self._tls.seq = seq
                 self._tls.dispatch = d
                 self._tls.published = False
+                self._tls.trace = ctx
                 try:
                     if profiler is not None:
                         profiler.enable()
                     try:
                         faults.on_item(kwargs)
-                        worker.process(*args, **kwargs)
+                        # worker stages (read/decode/transform) open under the
+                        # item's context and land in its span tree
+                        with obs.use_trace(ctx):
+                            worker.process(*args, **kwargs)
                     finally:
                         if profiler is not None:
                             profiler.disable()
-                    self._stop_aware_put((MSG_DONE, seq, True, d))
+                    self._stop_aware_put((MSG_DONE, seq, True, d, None))
                 except WorkerTerminationRequested:
                     return
                 except Exception:  # noqa: BLE001 - routed through the error policy
                     try:
-                        self._handle_item_failure(worker, d, seq, args, kwargs, attempts + 1)
+                        self._handle_item_failure(worker, d, seq, args, kwargs,
+                                                  attempts + 1, ctx)
                     except WorkerTerminationRequested:
                         return
         finally:

@@ -19,6 +19,7 @@ Improvements over the reference (SURVEY.md §5 checkpoint/reproducibility gaps):
 from __future__ import annotations
 
 import logging
+import os
 import threading
 from collections import OrderedDict
 
@@ -96,6 +97,11 @@ class ConcurrentVentilator(VentilatorBase):
         self._max_ventilation_queue_size = (max_ventilation_queue_size
                                             if max_ventilation_queue_size is not None
                                             else max(1, len(self._items_to_ventilate)))
+
+        # trace-mint namespace: '<ns>:<seq>' is each tagged item's trace id
+        # (docs/observability.md "trace context"); a fresh nonce per ventilator
+        # keeps ids unique across readers/epoch restarts in the same process
+        self.trace_ns = os.urandom(4).hex()
 
         self._in_flight = 0
         self._in_flight_cv = threading.Condition()
@@ -260,10 +266,16 @@ class ConcurrentVentilator(VentilatorBase):
                 item = self._items_to_ventilate[index]
                 # stage_ventilate_* counters + (at spans level) one event per
                 # dispatched work item, on the ventilator thread's track
-                with obs.stage('ventilate', cat='ventilator'):
-                    if self._tag_items:
-                        self._ventilate_fn(**dict(item, _seq=seq))
-                    else:
+                if self._tag_items:
+                    # mint the item's TraceContext: the ventilate span becomes
+                    # the virtual root's first child, and pool.ventilate
+                    # (running inside the block) captures the context so it
+                    # travels to workers on the existing channels
+                    with obs.mint_trace(self.trace_ns, seq):
+                        with obs.stage('ventilate', cat='ventilator'):
+                            self._ventilate_fn(**dict(item, _seq=seq))
+                else:
+                    with obs.stage('ventilate', cat='ventilator'):
                         self._ventilate_fn(**item)
 
             with self._in_flight_cv:
@@ -374,6 +386,10 @@ class FairShareVentilator(VentilatorBase):
     def __init__(self, ventilate_fn, on_tenant_done=None):
         self._ventilate_fn = ventilate_fn
         self._on_tenant_done = on_tenant_done
+        # trace-mint namespace; the serve daemon hands it to clients in the
+        # attach reply so they can derive each frame's trace root from the
+        # seq already present in the ring header (zero extra wire bytes)
+        self.trace_ns = os.urandom(4).hex()
         self._cv = threading.Condition()
         self._tenants = {}          # tenant_id -> _TenantQueue
         self._order = []            # round-robin order of tenant ids
@@ -565,5 +581,8 @@ class FairShareVentilator(VentilatorBase):
                 if self._stop_requested:
                     return
                 tq, item, seq = picked
-            with obs.stage('ventilate', cat='ventilator'):
-                self._ventilate_fn(**dict(item, _seq=seq))
+            # mint: seq is globally unique here, so '<ns>:<seq>' uniquely
+            # names the item across every tenant sharing this broker
+            with obs.mint_trace(self.trace_ns, seq):
+                with obs.stage('ventilate', cat='ventilator'):
+                    self._ventilate_fn(**dict(item, _seq=seq))

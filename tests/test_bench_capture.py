@@ -108,6 +108,29 @@ def test_main_emits_headline_line(monkeypatch, capsys):
     assert len(rec['spin_ms']) == 7 and rec['host_speed_spread'] == 0.0
     assert rec['spread'] == 0.0 and rec['excluded_mad_outliers'] == []
     assert rec['duty'] == {'skipped': True, 'reason': 'stubbed'}
+    # default capture runs at counters level: no critical-path block
+    assert rec['critical_path'] is None
+
+
+def test_critical_path_section_spans_level():
+    """At spans level the headline embeds the causal-tracing summary; below
+    it the block stays None (no half-filled attributions)."""
+    from petastorm_tpu import observability as obs
+    saved = obs.current_config()
+    obs.configure('spans')
+    try:
+        obs.get_ring().clear()
+        with obs.mint_trace('feedc0de', 3):
+            with obs.stage('ventilate', cat='ventilator'):
+                pass
+        section = bench._critical_path_section('spans')
+        assert section['traced_batches'] == 1
+        assert section['slowest'][0]['trace'] == 'feedc0de:3'
+        assert bench._critical_path_section('counters') is None
+        assert bench._critical_path_section(None) is None
+    finally:
+        obs.configure(saved)
+        obs.get_ring().clear()
 
 
 def test_select_runs_excludes_contended():
