@@ -11,6 +11,9 @@ times):
   same bytes.
 * **single-tenant overhead** — one served consumer vs one plain in-process
   reader, same settings.
+* **zero-copy delivery** — one process-pool consumer with the copying
+  shm deserialize vs ``zero_copy=True`` borrowed views into the ring
+  (docs/native.md, "Zero-copy views and slot lifetimes").
 
 Consumers are real processes (spawned with this file as the entry point —
 row/batch assembly must not share a GIL), reading columnar blocks (the TPU
@@ -48,6 +51,12 @@ def _consumer_main(argv):
     parser = argparse.ArgumentParser()
     parser.add_argument('--url', required=True)
     parser.add_argument('--serve', default=None)
+    parser.add_argument('--pool', default=None,
+                        help="reader_pool_type for the private reader "
+                             "('process' exercises the shm transport)")
+    parser.add_argument('--zero-copy', action='store_true',
+                        help='deliver batches as views into the shm ring '
+                             '(process pool only)')
     parser.add_argument('--rows', type=int, default=ROWS_PER_CONSUMER)
     parser.add_argument('--warmup-rows', type=int, default=WARMUP_ROWS)
     args = parser.parse_args(argv)
@@ -56,6 +65,10 @@ def _consumer_main(argv):
     kwargs = dict(output='columnar', num_epochs=None, seed=0, workers_count=3)
     if args.serve:
         kwargs['serve'] = args.serve
+    if args.pool:
+        kwargs['reader_pool_type'] = args.pool
+    if args.zero_copy:
+        kwargs['zero_copy'] = True
     rows = 0
     warmed = 0
     t0 = None
@@ -80,23 +93,28 @@ def _consumer_main(argv):
     return 0
 
 
-def _spawn_consumer(url, serve=None, rows=None):
+def _spawn_consumer(url, serve=None, rows=None, pool=None, zero_copy=False):
     argv = [sys.executable, os.path.abspath(__file__), '--consumer',
             '--url', url, '--rows', str(rows or ROWS_PER_CONSUMER),
             '--warmup-rows', str(WARMUP_ROWS)]
     if serve:
         argv += ['--serve', serve]
+    if pool:
+        argv += ['--pool', pool]
+    if zero_copy:
+        argv += ['--zero-copy']
     env = dict(os.environ, JAX_PLATFORMS='cpu',
                PYTHONPATH=REPO_ROOT + os.pathsep + os.environ.get('PYTHONPATH', ''))
     return subprocess.Popen(argv, stdout=subprocess.PIPE, text=True, env=env,
                             cwd=REPO_ROOT)
 
 
-def _run_fleet(url, k, serve=None, timeout_s=600):
+def _run_fleet(url, k, serve=None, timeout_s=600, pool=None, zero_copy=False):
     """K concurrent consumer processes; returns (per-consumer results,
     aggregate samples/s over the overlapping window)."""
     t0 = time.perf_counter()
-    procs = [_spawn_consumer(url, serve=serve) for _ in range(k)]
+    procs = [_spawn_consumer(url, serve=serve, pool=pool, zero_copy=zero_copy)
+             for _ in range(k)]
     results = []
     for p in procs:
         out, _ = p.communicate(timeout=timeout_s)
@@ -141,6 +159,9 @@ def main(argv=None):
     parser.add_argument('--url', default=None,
                         help='measure this dataset instead of the hello-world '
                              'bench store (smoke tests use a tiny one)')
+    parser.add_argument('--rounds', type=int, default=3,
+                        help='rounds per single-reader phase (the median is '
+                             'reported; smoke tests pass 1)')
     args, _unknown = parser.parse_known_args(argv)
     ks = [args.consumers] if args.consumers else [2, 3]
     ROWS_PER_CONSUMER = args.rows
@@ -156,10 +177,16 @@ def main(argv=None):
 
     spin = _spin_ms()
 
-    # 1) single plain reader (in-process baseline)
-    _res, single_rate, _ = _run_fleet(url, 1)
-    print(json.dumps({'metric': 'serve_single_plain', 'rate': single_rate}),
-          flush=True)
+    # 1) single plain reader (in-process baseline). Median of 3: this rate is
+    # the denominator of single_tenant_ratio and swings ±15% run-to-run on a
+    # busy 1-core host, which would whip the ratio around.
+    plain_rates = []
+    for _round in range(args.rounds):
+        _res, rate_p, _ = _run_fleet(url, 1)
+        plain_rates.append(rate_p)
+    single_rate = statistics.median(plain_rates)
+    print(json.dumps({'metric': 'serve_single_plain', 'rate': single_rate,
+                      'rounds': plain_rates}), flush=True)
 
     sweep = {}
     for k in ks:
@@ -183,12 +210,39 @@ def main(argv=None):
                     'served_vs_independent': round(served_agg / indep_agg, 3)
                     if indep_agg else None}
 
-    # 4) single served consumer (the serve='auto' overhead number)
+    # 4) single served consumer (the serve='auto' overhead number); median of
+    # 3 consumer rounds under one daemon, symmetric with the plain baseline
     service_dir2 = tempfile.mkdtemp(prefix='pstpu-serve-bench1-')
-    _res1, served1_rate, _ = _with_daemon(
-        url, service_dir2, lambda: _run_fleet(url, 1, serve=service_dir2))
-    print(json.dumps({'metric': 'serve_single_tenant', 'rate': served1_rate}),
-          flush=True)
+
+    def _served_single_rounds():
+        rates = []
+        for _round in range(args.rounds):
+            _res1, rate_s, _ = _run_fleet(url, 1, serve=service_dir2)
+            rates.append(rate_s)
+        return rates
+
+    served1_rounds = _with_daemon(url, service_dir2, _served_single_rounds)
+    served1_rate = statistics.median(served1_rounds)
+    print(json.dumps({'metric': 'serve_single_tenant', 'rate': served1_rate,
+                      'rounds': served1_rounds}), flush=True)
+
+    # 5) zero-copy sweep: one process-pool consumer, copying deserialize vs
+    # borrowed views into the shm ring (make_reader(..., zero_copy=True)).
+    # Median of 3 interleaved rounds: this pair is the headline claim and
+    # single-run noise on a 1-core host exceeds the effect size.
+    copy_rates, zc_rates = [], []
+    for _round in range(args.rounds):
+        _resc, rate_c, _ = _run_fleet(url, 1, pool='process')
+        copy_rates.append(rate_c)
+        _resz, rate_z, _ = _run_fleet(url, 1, pool='process', zero_copy=True)
+        zc_rates.append(rate_z)
+    pool_copy_rate = statistics.median(copy_rates)
+    pool_zc_rate = statistics.median(zc_rates)
+    print(json.dumps({'metric': 'pool_copy_single', 'rate': pool_copy_rate,
+                      'rounds': copy_rates}), flush=True)
+    print(json.dumps({'metric': 'pool_zero_copy_single', 'rate': pool_zc_rate,
+                      'rounds': zc_rates}), flush=True)
+    zc_ratio = round(pool_zc_rate / pool_copy_rate, 3) if pool_copy_rate else None
 
     ratios = {k: v['served_vs_independent'] for k, v in sweep.items()}
     headline = {
@@ -201,6 +255,9 @@ def main(argv=None):
         'meets_bar': any(v is not None and v >= 1.5 for v in ratios.values()),
         'single_served_rate': served1_rate,
         'single_tenant_ratio': round(served1_rate / single_rate, 3) if single_rate else None,
+        'pool_copy_rate': pool_copy_rate,
+        'pool_zero_copy_rate': pool_zc_rate,
+        'zero_copy_ratio': zc_ratio,
         'spin_ms': round(spin, 1),
         'host_cores': os.cpu_count(),
         'note': ('aggregate = total rows / slowest consumer span. This host '
@@ -210,7 +267,14 @@ def main(argv=None):
                  'K=2 ratio near 2d/(d+s)~1.3 and the single-tenant ratio '
                  'near d/(d+s)~0.65; K=3 clears 1.5x because the dedup '
                  'saves two decodes against one copy. On multi-core hosts '
-                 'the copy overlaps with decode and both ratios rise.'),
+                 'the copy overlaps with decode and both ratios rise. '
+                 'zero_copy_ratio ~1.0 on THIS dataset is expected: its '
+                 '~14MB image batches spill to the COW-mapped blob plane, '
+                 'which both modes view-deliver; zero_copy eliminates the '
+                 'per-message copy only for ring-resident batches (and now '
+                 'lifetime-tracks the blob views either way). Single-reader '
+                 'phases report the median of 3 rounds; fleet phases are '
+                 'single-shot and swing ~±15% run-to-run on this host.'),
     }
     print(json.dumps(headline), flush=True)
     return 0

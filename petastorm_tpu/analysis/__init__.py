@@ -61,6 +61,15 @@ Rule families (see ``docs/analysis.md`` for bad/good examples):
   division-form or an explicit guard required); ``memcpy``/pointer-advance
   code must be dominated by a check naming the destination's capacity
   (``analysis/cpp_safety.py`` — the PR 6 review-bug classes, mechanized).
+* **PT1100–PT1103** shared-plane borrow-checking — views into ring slots,
+  blob mappings, and chunk mirrors (``try_read_zero_copy``, ``_map_blob``,
+  ``mmap_chunk``, pagescan column views) are *borrows* with a producer-owned
+  lifetime. PT1100: a borrow stored into longer-lived state without
+  registering with the lifetime registry; PT1101: a function returning a
+  borrow without a ``:borrows:`` docstring section; PT1102: a borrow
+  crossing a pickle/queue/zmq/ring boundary uncopied; PT1103: a borrow's
+  manual release reachable only on some paths (``analysis/lifetime.py``,
+  the static half of ``native/lifetime.py``).
 
 Suppress a single finding with ``# noqa: PT###`` (reason encouraged) on its
 line; absorb pre-existing findings with an ``analysis_baseline.json`` (see
@@ -81,6 +90,7 @@ from petastorm_tpu.analysis.exceptions import (BaseExceptionContainmentChecker,
 from petastorm_tpu.analysis.hashability import HashabilityChecker
 from petastorm_tpu.analysis.jax_purity import JaxPurityChecker
 from petastorm_tpu.analysis.lifecycle import ResourceLifecycleChecker
+from petastorm_tpu.analysis.lifetime import LifetimeChecker
 from petastorm_tpu.analysis.locks import LockDisciplineChecker
 from petastorm_tpu.analysis.protocol_lints import ProtocolLintChecker
 from petastorm_tpu.analysis.serve_lints import ServeActuatorChecker
@@ -103,6 +113,7 @@ ALL_CHECKERS = (
     ServeActuatorChecker,
     AbiConformanceChecker,
     CppSafetyChecker,
+    LifetimeChecker,
 )
 
 #: every individual rule id the registered checkers can emit — the linter
@@ -144,7 +155,8 @@ __all__ = [
     'AutotuneActionChecker', 'Baseline',
     'BaseExceptionContainmentChecker', 'Checker', 'CppSafetyChecker',
     'ExceptionHygieneChecker', 'Finding',
-    'HashabilityChecker', 'JaxPurityChecker', 'LockDisciplineChecker',
+    'HashabilityChecker', 'JaxPurityChecker', 'LifetimeChecker',
+    'LockDisciplineChecker',
     'NativeBufferChecker', 'ProtocolLintChecker', 'ResourceLifecycleChecker', 'ServeActuatorChecker',
     'SourceFile', 'TelemetrySpanChecker', 'TraceContextChecker',
     'collect_sources', 'load_baseline', 'run_analysis', 'run_checkers',

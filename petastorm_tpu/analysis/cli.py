@@ -30,6 +30,15 @@ show suppressed findings too; text output prints only open ones.
 ``--select``/``--ignore`` take comma-separated rule-id prefixes and make
 staged rollouts possible: ship new rule families dark with ``--ignore PT9``,
 or gate a single family with ``--select PT9``.
+
+``--changed`` scans only files git considers modified (tracked files
+differing from HEAD, staged or not, plus untracked non-ignored files) —
+the edit-loop mode. ``--cache DIR`` keeps a content-addressed per-file
+result store so untouched files cost one ``stat`` on re-runs; the
+invalidation contract (file bytes + sibling native sources + the analysis
+package itself) lives in :mod:`petastorm_tpu.analysis.cache` and
+docs/analysis.md. Both compose with every other flag: select/ignore/
+baseline are re-applied per run, never baked into cached entries.
 """
 
 from __future__ import annotations
@@ -79,6 +88,18 @@ def build_parser():
                         help='comma-separated rule-id prefixes to suppress '
                              '(applied after --select) — stage a new family '
                              'dark with e.g. --ignore PT8')
+    parser.add_argument('--changed', action='store_true',
+                        help='scan only files git reports as changed vs HEAD '
+                             '(plus untracked) under the given paths — the '
+                             'edit-loop mode; a clean git state exits 0 '
+                             'without scanning anything')
+    parser.add_argument('--cache', metavar='DIR',
+                        help='content-addressed per-file result cache: '
+                             'untouched files are served from DIR instead of '
+                             're-analyzed (invalidation contract: the file, '
+                             'its sibling .cpp/.cc sources, and the analysis '
+                             'package itself — see docs/analysis.md; deleting '
+                             'DIR is always safe)')
     parser.add_argument('--rules', action='store_true',
                         help='list the rule families and exit')
     return parser
@@ -121,8 +142,33 @@ def main(argv=None):
         return EXIT_USAGE
     baseline = load_baseline(args.baseline) if args.baseline else None
     keep_suppressed = args.format == 'json' and not args.write_baseline
-    findings = run_analysis(paths, baseline=baseline, select=select,
-                            ignore=ignore, keep_suppressed=keep_suppressed)
+    if args.changed or args.cache:
+        from petastorm_tpu.analysis.cache import (ResultCache,
+                                                  changed_file_entries,
+                                                  iter_file_entries,
+                                                  run_analysis_incremental)
+        try:
+            entries = (changed_file_entries(paths) if args.changed
+                       else iter_file_entries(paths))
+        except RuntimeError as e:
+            print('error: {}'.format(e), file=sys.stderr)
+            return EXIT_USAGE
+        cache = ResultCache(args.cache) if args.cache else None
+        findings = run_analysis_incremental(
+            entries, cache=cache, baseline=baseline, select=select,
+            ignore=ignore, keep_suppressed=keep_suppressed)
+        if args.changed:
+            print('{} changed file{} scanned'.format(
+                len(entries), '' if len(entries) == 1 else 's'),
+                file=sys.stderr)
+        if cache is not None:
+            print('cache: {} hit{}, {} miss{}'.format(
+                cache.hits, '' if cache.hits == 1 else 's',
+                cache.misses, '' if cache.misses == 1 else 'es'),
+                file=sys.stderr)
+    else:
+        findings = run_analysis(paths, baseline=baseline, select=select,
+                                ignore=ignore, keep_suppressed=keep_suppressed)
     open_findings = [f for f in findings if f.status == 'open']
 
     if args.write_baseline:

@@ -57,6 +57,7 @@ from collections import OrderedDict
 import numpy as np
 
 from petastorm_tpu import observability as obs
+from petastorm_tpu.native.lifetime import registry as lifetime_registry
 
 logger = logging.getLogger(__name__)
 
@@ -156,9 +157,11 @@ class ChunkStore(object):
         self._lock = threading.Lock()
         self._counters = {k: 0 for k in _COUNTER_KEYS}
         self._last_flush = 0.0
-        # digest -> (weakref to np.memmap, chunk size). A live weakref IS the
-        # pin: views over the mapping keep the memmap object alive, and the
-        # evictor skips pinned chunks.
+        # digest -> (weakref to np.memmap, chunk size, lifetime Slot). Views
+        # over the mapping keep the memmap object alive; the memmap is
+        # adopted into the slot (native/lifetime.py), so "pinned" is exactly
+        # "the slot has live borrows" and blocked evictions land in the
+        # process-wide lifetime_blocked_reclaims counter.
         self._mmaps = {}
         # digest -> np.memmap: bounded LRU of strong refs so hot chunks stay
         # mapped across batches; the evictor pops an entry before judging the
@@ -253,8 +256,8 @@ class ChunkStore(object):
         with self._lock:
             for k in _COUNTER_KEYS:
                 agg[k] += self._counters[k]
-            for ref, size in self._mmaps.values():
-                if ref() is not None:
+            for _ref, size, slot in self._mmaps.values():
+                if slot.live:
                     pinned_n += 1
                     pinned_bytes += size
         agg['chunks_pinned'] = pinned_n
@@ -337,7 +340,11 @@ class ChunkStore(object):
         """A read-only ``np.memmap`` over the chunk's local mirror, fetching
         on miss. The caller's arrays pin the mapping simply by referencing it;
         the store additionally keeps the hottest mappings in a bounded
-        strong-ref pool so a warm re-read is a dict lookup, not a syscall."""
+        strong-ref pool so a warm re-read is a dict lookup, not a syscall.
+
+        :borrows: the returned memmap aliases the on-disk mirror; eviction is
+            refused (``lifetime_blocked_reclaims``) while it or any array
+            built over it is alive."""
         digest = self.digest(key)
         with self._lock:
             mm = self._strong.get(digest)
@@ -358,8 +365,14 @@ class ChunkStore(object):
             # repopulate once — the refetched bytes are identical
             path, _, _ = self.ensure(key, length, fetch_fn)
             mm = np.memmap(path, dtype=np.uint8, mode='r')
+        # the memmap (an ndarray) is the one borrow: arrays built over it keep
+        # it alive through their buffers, so its finalizer firing means no
+        # view can reference the mirror anymore
+        slot = lifetime_registry().open_slot(label='chunk-mirror')
+        slot.adopt(mm)
+        slot.seal()
         with self._lock:
-            self._mmaps[digest] = (weakref.ref(mm), length)
+            self._mmaps[digest] = (weakref.ref(mm), length, slot)
             self._strong[digest] = mm
             self._strong.move_to_end(digest)
             while len(self._strong) > _STRONG_POOL_SIZE:
@@ -368,18 +381,28 @@ class ChunkStore(object):
 
     # -- eviction ------------------------------------------------------------
 
-    def _release_and_check_pinned(self, digest):
-        """Release the store's own strong-pool ref for ``digest``, then report
-        whether the mapping is still alive — i.e. pinned by a live batch's
-        views, the only pin eviction must respect. Prunes dead weakrefs."""
+    def _try_evict_entry(self, digest, full):
+        """Release the store's own strong-pool ref for ``digest``, then — if
+        no live batch pins the mapping — unlink the chunk file, ATOMICALLY
+        under the store lock. Holding the lock across pin-check + unlink
+        closes the race where a concurrent :meth:`mmap_chunk` re-registers
+        the digest between the two steps and its freshly pinned chunk is
+        unlinked out from under the recency accounting (the mapping itself
+        stays POSIX-valid either way — this is about honest bookkeeping).
+        Returns True when the file was evicted. A refused reclaim counts in
+        the process-wide ``lifetime_blocked_reclaims``."""
         with self._lock:
             self._strong.pop(digest, None)
             entry = self._mmaps.get(digest)
-            if entry is None:
-                return False
-            if entry[0]() is None:
+            if entry is not None:
+                if not entry[2].try_reclaim():
+                    return False  # pinned by a live batch's views
                 del self._mmaps[digest]
+            try:
+                os.unlink(full)
+            except OSError:
                 return False
+            self._bumped.pop(digest, None)
             return True
 
     def _evict_if_needed(self):
@@ -406,18 +429,13 @@ class ChunkStore(object):
         for _mtime, size, digest, full in entries:
             if total <= self._size_limit:
                 break
-            if self._release_and_check_pinned(digest):
-                # a live batch still references this mapping: unlinking would
-                # not free disk until the views drop anyway, and the size
-                # accounting must stay honest — skip, on record
+            if not self._try_evict_entry(digest, full):
+                # a live batch still references this mapping (or the file
+                # vanished under us): unlinking would not free disk until the
+                # views drop anyway, and the size accounting must stay honest
+                # — skip, on record
                 skipped += 1
                 continue
-            try:
-                os.unlink(full)
-            except OSError:
-                continue
-            with self._lock:
-                self._bumped.pop(digest, None)
             total -= size
             evicted_n += 1
             evicted_b += size

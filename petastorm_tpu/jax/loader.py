@@ -498,6 +498,13 @@ class JaxDataLoader(object):
             'reader_wait_s': round(self._reader_wait_s, 4),
             'reader_wait_fraction': wait_fraction,
         })
+        # zero-copy borrow accounting (docs/native.md): the loader's shuffle
+        # buffer and prefetched batches are exactly the borrows that keep
+        # shm-ring slots / blob maps pinned, so the live count belongs next
+        # to the stall metrics. Refreshed here in case the reader's own
+        # diagnostics did not carry the family (e.g. a bare facade).
+        from petastorm_tpu.native.lifetime import registry as lifetime_registry
+        out.update(lifetime_registry().counters())
         return out
 
     @property

@@ -180,7 +180,10 @@ class NativeParquetFile(object):
 
     def _zerocopy_columns(self, i, columns):
         """``{name: ChunkedArray}`` for the columns servable as views over the
-        mmapped file (first-party page scan — see native/pagescan.py)."""
+        mmapped file (first-party page scan — see native/pagescan.py).
+
+        :borrows: the arrays alias the pool's long-lived file mapping; each
+            holds it alive through ``pa.py_buffer``'s base."""
         if os.environ.get('PSTPU_DISABLE_PAGESCAN'):
             return {}
         if self._ensure_pq_meta() is False:

@@ -57,6 +57,10 @@ struct RingHandle {
   uint64_t pending_pad = 0;   // pad marker + dead bytes emitted before the slot
   uint64_t pending_max = 0;   // reserved payload capacity
   bool pending = false;
+  // consumer-side zero-copy peek cursor (single consumer: plain field).
+  // Invariant head <= peek_head <= tail; bytes in [head, peek_head) are lent
+  // out as views and only pstpu_ring_release retires them to the producer.
+  uint64_t peek_head = 0;
 };
 
 thread_local std::string g_error;
@@ -356,6 +360,137 @@ int64_t pstpu_ring_read(void* h, void* buf, uint64_t buf_cap) {
   copy_out(r, head + 8, static_cast<uint8_t*>(buf), len_le);
   r->hdr->head.store(head + 8 + len_le, std::memory_order_release);
   return static_cast<int64_t>(len_le);
+}
+
+// Zero-copy take of the next message (lifetime-tracked consumer views,
+// docs/native.md). Without advancing the SHARED head, locate the next unread
+// message past the handle's local peek cursor and advance that cursor over
+// it. out[0] = payload address inside the mapped data area, out[1] = payload
+// length, out[2] = span (pads + header + payload bytes) the matching
+// pstpu_ring_release must retire once every consumer view of the payload
+// died. Returns 1 when out holds a contiguous message, 2 when the next
+// message wraps the physical end (out[1]/out[2] still filled; the caller
+// copies it out via pstpu_ring_peek_copy), 0 when empty, -1 when out_count
+// is too small. Only reserve-committed messages are contiguous by
+// construction (pad markers); plain writes wrap byte-wise, hence status 2.
+long long pstpu_ring_peek(void* h, unsigned long long* out,
+                          unsigned long long out_count) {
+  if (out_count < 3) {
+    set_error("pstpu_ring_peek needs a 3-slot out array");
+    return -1;
+  }
+  auto* r = static_cast<RingHandle*>(h);
+  const uint64_t cap = r->hdr->capacity;
+  const uint64_t tail = r->hdr->tail.load(std::memory_order_acquire);
+  const uint64_t head = r->hdr->head.load(std::memory_order_relaxed);
+  if (r->peek_head < head) r->peek_head = head;  // resync after copy reads
+  uint64_t pos = r->peek_head;
+  while (pos != tail) {
+    uint64_t len_le = 0;
+    copy_out(r, pos, reinterpret_cast<uint8_t*>(&len_le), 8);
+    if (len_le & kPadFlag) {
+      pos += 8 + (len_le & ~kPadFlag);
+      continue;
+    }
+    if (len_le > cap) {
+      set_error("ring message length exceeds capacity (corrupt header)");
+      return -1;
+    }
+    const uint64_t idx = (pos + 8) % cap;
+    out[1] = len_le;
+    out[2] = (pos + 8 + len_le) - r->peek_head;
+    if (idx + len_le > cap) {
+      out[0] = 0;  // physically wrapped: no contiguous view exists
+      return 2;
+    }
+    out[0] = reinterpret_cast<unsigned long long>(r->data + idx);
+    r->peek_head = pos + 8 + len_le;
+    return 1;
+  }
+  return 0;
+}
+
+// Copy-out companion of pstpu_ring_peek for wrapped messages: copies the
+// next message past the peek cursor into dst and advances the cursor;
+// *span_out = the span pstpu_ring_release must retire. Returns the payload
+// length, -1 when empty, -2 when dst_cap is too small (cursor unmoved).
+long long pstpu_ring_peek_copy(void* h, void* dst, unsigned long long dst_cap,
+                               unsigned long long* span_out) {
+  auto* r = static_cast<RingHandle*>(h);
+  const uint64_t tail = r->hdr->tail.load(std::memory_order_acquire);
+  const uint64_t head = r->hdr->head.load(std::memory_order_relaxed);
+  if (r->peek_head < head) r->peek_head = head;
+  uint64_t pos = r->peek_head;
+  while (pos != tail) {
+    uint64_t len_le = 0;
+    copy_out(r, pos, reinterpret_cast<uint8_t*>(&len_le), 8);
+    if (len_le & kPadFlag) {
+      pos += 8 + (len_le & ~kPadFlag);
+      continue;
+    }
+    if (len_le > dst_cap) return -2;
+    copy_out(r, pos + 8, static_cast<uint8_t*>(dst), len_le);
+    if (span_out) *span_out = (pos + 8 + len_le) - r->peek_head;
+    r->peek_head = pos + 8 + len_le;
+    return static_cast<long long>(len_le);
+  }
+  return -1;
+}
+
+// Non-consuming probe that respects the peek cursor: 1 when a payload
+// message exists PAST max(peek_head, head), else 0. pstpu_ring_next_len
+// probes from the shared head, so under zero-copy peeks it keeps reporting
+// already-delivered (but not yet released) messages — drain/close logic
+// needs "unread", not "unreleased".
+int pstpu_ring_has_unread(void* h) {
+  auto* r = static_cast<RingHandle*>(h);
+  const uint64_t tail = r->hdr->tail.load(std::memory_order_acquire);
+  const uint64_t head = r->hdr->head.load(std::memory_order_relaxed);
+  uint64_t pos = r->peek_head < head ? head : r->peek_head;
+  while (pos != tail) {
+    uint64_t len_le = 0;
+    copy_out(r, pos, reinterpret_cast<uint8_t*>(&len_le), 8);
+    if (!(len_le & kPadFlag)) return 1;
+    pos += 8 + (len_le & ~kPadFlag);
+  }
+  return 0;
+}
+
+// Retire span_bytes of peeked-and-released messages: the producer may reuse
+// those bytes from here on. Spans MUST be released in take order (the Python
+// RingBorrowLedger serializes out-of-order finalizers into FIFO releases).
+// Returns 0, or -1 when the release would pass the peek cursor (caller bug:
+// the bytes are still lent out).
+int pstpu_ring_release(void* h, unsigned long long span_bytes) {
+  auto* r = static_cast<RingHandle*>(h);
+  const uint64_t head = r->hdr->head.load(std::memory_order_relaxed);
+  const uint64_t limit = r->peek_head < head ? head : r->peek_head;
+  if (head + span_bytes > limit) {
+    set_error("ring release span passes the peek cursor");
+    return -1;
+  }
+  r->hdr->head.store(head + span_bytes, std::memory_order_release);
+  return 0;
+}
+
+// Debug guard (PSTPU_LIFETIME_GUARD=1): remap the fully page-covered bytes
+// of [addr, addr+len) to PROT_NONE (prot_none=1) or back to read/write (0),
+// so a use-after-release faults loudly instead of reading recycled bytes.
+// Returns the number of bytes whose protection changed (0 when the range
+// spans no full page), -1 on mprotect failure.
+long long pstpu_guard_protect(void* addr, unsigned long long len,
+                              int prot_none) {
+  const uint64_t page = static_cast<uint64_t>(sysconf(_SC_PAGESIZE));
+  const uint64_t a = reinterpret_cast<uint64_t>(addr);
+  const uint64_t start = (a + page - 1) & ~(page - 1);
+  const uint64_t end = (a + len) & ~(page - 1);
+  if (end <= start) return 0;
+  const int prot = prot_none ? PROT_NONE : (PROT_READ | PROT_WRITE);
+  if (mprotect(reinterpret_cast<void*>(start), end - start, prot) != 0) {
+    set_error(std::string("mprotect failed: ") + std::strerror(errno));
+    return -1;
+  }
+  return static_cast<long long>(end - start);
 }
 
 // Unmap; the creator also unlinks the shm name.

@@ -172,6 +172,22 @@ def _load_library():
         lib.pstpu_ring_next_len.argtypes = [ctypes.c_void_p]
         lib.pstpu_ring_read.restype = ctypes.c_int64
         lib.pstpu_ring_read.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64]
+        # zero-copy consumer views + slot-lifetime guard (docs/native.md)
+        lib.pstpu_ring_peek.restype = ctypes.c_longlong
+        lib.pstpu_ring_peek.argtypes = [ctypes.c_void_p,
+                                        ctypes.POINTER(ctypes.c_ulonglong),
+                                        ctypes.c_ulonglong]
+        lib.pstpu_ring_peek_copy.restype = ctypes.c_longlong
+        lib.pstpu_ring_peek_copy.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                             ctypes.c_ulonglong,
+                                             ctypes.POINTER(ctypes.c_ulonglong)]
+        lib.pstpu_ring_has_unread.restype = ctypes.c_int
+        lib.pstpu_ring_has_unread.argtypes = [ctypes.c_void_p]
+        lib.pstpu_ring_release.restype = ctypes.c_int
+        lib.pstpu_ring_release.argtypes = [ctypes.c_void_p, ctypes.c_ulonglong]
+        lib.pstpu_guard_protect.restype = ctypes.c_longlong
+        lib.pstpu_guard_protect.argtypes = [ctypes.c_void_p, ctypes.c_ulonglong,
+                                            ctypes.c_int]
         lib.pstpu_ring_close.argtypes = [ctypes.c_void_p]
         # broadcast (single-producer, multi-consumer) ring — the serve
         # daemon's fan-out transport
@@ -388,14 +404,17 @@ class ShmRing(object):
         self._lib.pstpu_ring_abort(self._handle)
 
     def has_message(self):
-        """True when a committed message is waiting. NON-consuming probe
-        (``pstpu_ring_next_len`` only reports the next message's length) —
-        the supervisor uses it to tell when a dead worker's ring has drained
-        without stealing the message from the consumer loop. A closed ring
-        reports empty (callers may hold a reference past close)."""
+        """True when an UNREAD committed message is waiting. NON-consuming
+        probe — the supervisor uses it to tell when a dead worker's ring has
+        drained without stealing the message from the consumer loop. Probes
+        past the zero-copy peek cursor, so messages already delivered as
+        borrowed views (but not yet released) do not count as pending;
+        without peeks it is identical to probing from the shared head. A
+        closed ring reports empty (callers may hold a reference past
+        close)."""
         if not self._handle:
             return False
-        return self._lib.pstpu_ring_next_len(self._handle) >= 0
+        return self._lib.pstpu_ring_has_unread(self._handle) == 1
 
     def try_read(self):
         """One message as bytes, or None when the ring is empty."""
@@ -415,6 +434,46 @@ class ShmRing(object):
             return None  # raced/buffer mismatch: treat as empty, caller re-polls
         # per-message ctypes buffer: always writable, owned by the view chain
         return memoryview(buf)[:got]  # noqa: PT500 - fresh writable buffer per message
+
+    def try_read_zero_copy(self):
+        """One message as ``(view, span_bytes, borrowed)`` without retiring
+        its ring bytes, or None when the ring is empty.
+
+        :borrows: ``borrowed=True`` views point STRAIGHT into the ring's
+            mapped data area — the producer may not reuse those bytes until
+            the caller retires ``span_bytes`` through :meth:`release` (in
+            take order; ``native/lifetime.RingBorrowLedger`` does the
+            bookkeeping). Physically wrapped messages (plain writes wrap
+            byte-wise; only reserve-committed messages are contiguous) come
+            back as an owned copy with ``borrowed=False`` — the span still
+            must be released, but the view's lifetime is the caller's.
+        """
+        out = (ctypes.c_ulonglong * 3)()
+        status = self._lib.pstpu_ring_peek(self._handle, out, 3)
+        if status <= 0:
+            return None  # empty (or corrupt header: surfaced by has_message)
+        if status == 1:
+            n = int(out[1])
+            view = memoryview(  # noqa: PT500 - borrow by design; ledger-released
+                (ctypes.c_char * n).from_address(int(out[0]))).cast('B')
+            return view, int(out[2]), True
+        # wrapped message: copy it out of the ring (span still ledgered)
+        buf = ctypes.create_string_buffer(int(out[1]))
+        span = ctypes.c_ulonglong(0)
+        got = self._lib.pstpu_ring_peek_copy(self._handle, buf, int(out[1]),
+                                             ctypes.byref(span))
+        if got < 0:
+            return None
+        return memoryview(buf)[:got], int(span.value), False  # noqa: PT500 - fresh buffer
+
+    def release(self, span_bytes):
+        """Retire ``span_bytes`` of zero-copy-taken messages back to the
+        producer (FIFO order only — see :meth:`try_read_zero_copy`)."""
+        if not self._handle:
+            return
+        if self._lib.pstpu_ring_release(self._handle, span_bytes) != 0:
+            raise ValueError('ring release failed: {}'.format(
+                self._lib.pstpu_ring_last_error().decode()))
 
     def close(self):
         if self._handle:

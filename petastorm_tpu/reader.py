@@ -44,7 +44,8 @@ _VENTILATE_EXTRA_ROWGROUPS = 2
 
 
 def _make_pool(reader_pool_type, workers_count, results_queue_size, serializer=None,
-               on_error='raise', max_item_retries=None, protocol_monitor=None):
+               on_error='raise', max_item_retries=None, protocol_monitor=None,
+               zero_copy=False):
     """Pool construction incl. IPC serializer selection. The reference picks a
     columnar serializer only for its batch readers (reference reader.py:269);
     here EVERY worker publishes column blocks, so the raw-buffer
@@ -54,6 +55,11 @@ def _make_pool(reader_pool_type, workers_count, results_queue_size, serializer=N
     views over the IPC message (zero-copy receive: shm-ring bytearray, blob
     copy-on-write mmap; the zmq fallback copies once to match) — the same
     mutate-in-place affordance thread-pool blocks have.
+    ``zero_copy`` (process pool, shm transport) goes further: batches are
+    delivered as lifetime-tracked views straight into the ring slot, skipping
+    the per-message consumer copy (docs/native.md). Thread/dummy pools hand
+    over in-process arrays already — for them the flag is a documented no-op,
+    not an error, so callers can set it uniformly.
     ``on_error``/``max_item_retries`` (docs/robustness.md) are implemented by
     every pool type, so failure behavior is pool-independent."""
     policy = {'on_error': _resolve_error_policy(on_error, max_item_retries),
@@ -62,7 +68,8 @@ def _make_pool(reader_pool_type, workers_count, results_queue_size, serializer=N
         return ThreadPool(workers_count, results_queue_size, **policy)
     if reader_pool_type == 'process':
         return ProcessPool(workers_count, results_queue_size,
-                           serializer=serializer or NumpyBlockSerializer(), **policy)
+                           serializer=serializer or NumpyBlockSerializer(),
+                           zero_copy=zero_copy, **policy)
     if reader_pool_type == 'dummy':
         return DummyPool(**policy)
     raise ValueError('Unknown reader_pool_type {!r} (expected thread/process/dummy)'.format(
@@ -133,7 +140,8 @@ def make_reader(dataset_url,
                 autotune=None,
                 on_error='raise', max_item_retries=None,
                 protocol_monitor=None,
-                serve=None, serve_weight=1):
+                serve=None, serve_weight=1,
+                zero_copy=False):
     """Reader for datasets written by :func:`materialize_dataset` — rows decoded
     through the stored Unischema's codecs (reference reader.py:50-174).
 
@@ -232,6 +240,16 @@ def make_reader(dataset_url,
     :param serve_weight: this consumer's fair-share weight in the daemon's
         scheduler (>= 1; a weight-2 tenant's stream gets twice the decode
         share of a weight-1 tenant's under contention).
+    :param zero_copy: ``reader_pool_type='process'`` with the shm transport —
+        deliver batches as numpy views STRAIGHT into the shared-memory ring
+        slot instead of copying each message out (docs/native.md). Every view
+        is lifetime-tracked (``petastorm_tpu.native.lifetime``): the slot's
+        ring bytes are recycled only after the batch's arrays are garbage
+        collected, so holding a batch applies backpressure rather than
+        corrupting it. Values are bit-identical to the copy path. Thread and
+        dummy pools already hand over in-process arrays — the flag is a
+        no-op for them. Ignored with ``serve=`` (the served blob path maps
+        batches zero-copy by default, with the same lifetime tracking).
     """
     if serve:
         return _make_served(dataset_url, batch_reader=False,
@@ -284,7 +302,8 @@ def make_reader(dataset_url,
 
     cache = _make_cache(cache_type, cache_location, cache_size_limit, cache_row_size_estimate)
     pool = _make_pool(reader_pool_type, workers_count, results_queue_size,
-                      on_error=error_policy, protocol_monitor=protocol_monitor)
+                      on_error=error_policy, protocol_monitor=protocol_monitor,
+                      zero_copy=zero_copy)
     return Reader(dataset_url, schema,
                   worker_class=RowGroupDecoderWorker,
                   results_queue_reader_factory=results_queue_reader_factory,
@@ -388,7 +407,8 @@ def make_batch_reader(dataset_url,
                       autotune=None,
                       on_error='raise', max_item_retries=None,
                       protocol_monitor=None,
-                      serve=None, serve_weight=1):
+                      serve=None, serve_weight=1,
+                      zero_copy=False):
     """Columnar reader for ANY Parquet store (reference reader.py:177-289):
     yields one namedtuple of numpy column arrays per row group
     (``batched_output=True``). Schema is inferred from the Arrow schema unless
@@ -418,6 +438,10 @@ def make_batch_reader(dataset_url,
 
     ``serve``/``serve_weight``: read through the per-host shared reader
     service (docs/serve.md) — identical semantics to :func:`make_reader`.
+
+    ``zero_copy``: lifetime-tracked batch views straight out of the process
+    pool's shm ring (docs/native.md) — identical semantics to
+    :func:`make_reader`.
     """
     if serve:
         return _make_served(dataset_url, batch_reader=True,
@@ -445,7 +469,8 @@ def make_batch_reader(dataset_url,
                                                       retry_policy=storage_retry_policy)
     cache = _make_cache(cache_type, cache_location, cache_size_limit, cache_row_size_estimate)
     pool = _make_pool(reader_pool_type, workers_count, results_queue_size,
-                      on_error=error_policy, protocol_monitor=protocol_monitor)
+                      on_error=error_policy, protocol_monitor=protocol_monitor,
+                      zero_copy=zero_copy)
     results_queue_reader_factory = _columnar_results_reader_factory(
         'columnar', batch_size, drop_last, None)
     return Reader(dataset_url, schema,

@@ -154,10 +154,16 @@ def connect_service(service_dir, spawn_args=None, timeout_s=_SPAWN_TIMEOUT_S):
 
 
 def _map_blob(path, size, tenant_id):
-    """COW-map a served batch blob: writable views with zero upfront copy;
-    the mapping (not the name) keeps the pages alive past the daemon's
-    reclaim. A vanished blob means this consumer fell behind the fleet's GC
-    horizon — surfaced like an eviction, never as a hang or torn data."""
+    """COW-map a served batch blob, returning ``(memoryview, slot)``:
+    writable views with zero upfront copy; the mapping (not the name) keeps
+    the pages alive past the daemon's reclaim. A vanished blob means this
+    consumer fell behind the fleet's GC horizon — surfaced like an eviction,
+    never as a hang or torn data.
+
+    :borrows: the view borrows the mapping; the caller adopts the batch's
+        arrays into ``slot`` (``native/lifetime.py``) and seals it, so the
+        map closes exactly when the batch dies and the live window shows up
+        in ``lifetime_live_borrows``."""
     import mmap
     try:
         with open(path, 'rb') as f:
@@ -172,7 +178,16 @@ def _map_blob(path, size, tenant_id):
             'it (consumer far behind the fleet): {} — consume faster or '
             'raise the daemon blob budget (docs/serve.md)'.format(path, e),
             tenant_id=tenant_id)
-    return memoryview(mm)[:size]  # noqa: PT500 - fresh COW mapping per batch
+
+    def _close():
+        try:
+            mm.close()
+        except BufferError:
+            pass  # a straggler export closes it when the GC drops the chain
+
+    from petastorm_tpu.native.lifetime import registry as lifetime_registry
+    slot = lifetime_registry().open_slot(on_release=_close, label='serve-blob')
+    return memoryview(mm)[:size], slot  # noqa: PT500 - registered with the lifetime registry
 
 
 class _ServedPoolFacade(object):
@@ -260,13 +275,15 @@ class _ServedPoolFacade(object):
                     self.monitor.on_deliver(seq)
                 self._note_result(seq)
                 self.bytes_received += desc['size']
-                mv = _map_blob(desc['path'], desc['size'], self._tenant_id)
+                mv, slot = _map_blob(desc['path'], desc['size'], self._tenant_id)
                 import numpy as np
                 block = {}
                 for name, dtype_str, shape, off, nbytes in desc['cols']:
                     block[name] = np.frombuffer(
                         mv[off:off + nbytes],
                         dtype=np.dtype(dtype_str)).reshape(shape)
+                slot.adopt(block)
+                slot.seal()
                 return block
             elif kind == SERVE_BLOB:
                 # the batch sits in a shared /dev/shm blob: COW-map it
@@ -278,8 +295,11 @@ class _ServedPoolFacade(object):
                     self.monitor.on_deliver(seq)
                 self._note_result(seq)
                 self.bytes_received += int(size_s)
-                return self._serializer.deserialize(
-                    _map_blob(path, int(size_s), self._tenant_id))
+                mv, slot = _map_blob(path, int(size_s), self._tenant_id)
+                result = self._serializer.deserialize(mv)
+                slot.adopt(result)
+                slot.seal()
+                return result
             elif kind == SERVE_DONE:
                 if self.done_callback is not None and seq is not None:
                     self.done_callback(seq)
@@ -303,8 +323,11 @@ class _ServedPoolFacade(object):
 
     @property
     def diagnostics(self):
-        return {'serve_batches_received': self.batches_received,
-                'serve_bytes_received': self.bytes_received}
+        from petastorm_tpu.native.lifetime import registry as lifetime_registry
+        out = {'serve_batches_received': self.batches_received,
+               'serve_bytes_received': self.bytes_received}
+        out.update(lifetime_registry().counters())
+        return out
 
 
 class ServedReader(object):

@@ -102,6 +102,10 @@ class _BlobPublish(object):
         self._disabled = False
 
     def _new_blob(self, total):
+        """Fresh writable mapping + path for a ``total``-byte blob.
+
+        :borrows: the caller owns the mapping and must close it (and unlink
+            the path on failure) — both exits in :meth:`__call__` do."""
         import mmap
         fd, path = tempfile.mkstemp(prefix='sb', dir=self._blob_dir)
         try:

@@ -252,3 +252,50 @@ def replay_ring_cycles(ring_mod, name_suffix):
             raise AssertionError('never-fit reservation did not raise')
     finally:
         ring.close()
+
+
+def replay_lifetime_cycles(ring_mod, name_suffix):
+    """Zero-copy peek/release cycles through a (possibly sanitized) shm ring
+    build: borrowed in-place views, wrapped-message copies, out-of-order
+    consumer releases retired FIFO by the ledger, peek-aware ``has_message``
+    probes, and the drain-deferred close. Every byte of every borrowed view
+    is read back while live — under ASan an over-read of the mapped data
+    area aborts the replay."""
+    from petastorm_tpu.native.lifetime import RingBorrowLedger, SlotRegistry
+
+    ring = ring_mod.ShmRing.create('/pstpu_lt_{}'.format(name_suffix), 8192)
+    registry = SlotRegistry()
+    try:
+        ledger = RingBorrowLedger(ring, registry_=registry)
+        for round_no in range(40):
+            payloads = [bytes([(round_no + i) % 251]) * (i * 53 % 900 + 16)
+                        for i in range(4)]
+            for p in payloads:
+                assert ring.try_write(p)
+            taken = []
+            while True:
+                item = ring.try_read_zero_copy()
+                if item is None:
+                    break
+                view, span, borrowed = item
+                slot = ledger.take(view, span, borrowed)
+                taken.append((bytes(view), slot))  # full read of the view
+            assert [p for p, _ in taken] == payloads
+            assert not ring.has_message()  # peeked past: nothing pending
+            # rotate the release order per round; the ledger must retire
+            # spans FIFO regardless
+            order = [(i + round_no) % len(taken) for i in range(len(taken))]
+            for i in order:
+                taken[i][1].release_now()
+            assert ledger.live == 0
+        assert registry.counters()['lifetime_live_borrows'] == 0
+        # deferred close: a live borrow blocks the munmap until it dies
+        assert ring.try_write(b'q' * 64)
+        view, span, borrowed = ring.try_read_zero_copy()
+        slot = ledger.take(view, span, borrowed)
+        closed = []
+        assert not ledger.close_when_drained(lambda: closed.append(1))
+        slot.release_now()
+        assert closed == [1]
+    finally:
+        ring.close()  # idempotent: the drained ledger may have closed it

@@ -148,3 +148,15 @@ class PublishThenErrorWorker(WorkerBase):
                 return  # already failed once; succeed this attempt
             os.close(fd)
             raise ValueError('post-publish failure on {}'.format(item))
+
+
+class NumpyBatchWorker(WorkerBase):
+    """Publishes one deterministic numpy column-dict per item — the
+    zero-copy parity tests replay the same items through copy and
+    zero-copy pools and demand bit-identical arrays."""
+
+    def process(self, n):
+        import numpy as np
+        self.publish({'x': np.arange(n, dtype=np.int64),
+                      'y': (np.arange(n, dtype=np.float64) * 0.5).reshape(n, 1),
+                      'tag': np.full(n, n % 7, dtype=np.uint8)})

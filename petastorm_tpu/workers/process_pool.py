@@ -52,6 +52,8 @@ import zmq
 from petastorm_tpu import faults, observability as obs
 from petastorm_tpu.errors import (EmptyResultError, PoisonItemError,
                                   TimeoutWaitingForResultError, WorkerPoolDepletedError)
+from petastorm_tpu.native.lifetime import (RingBorrowLedger,
+                                           registry as lifetime_registry)
 # every wire constant (message kinds, ring framing, dispatch ids) comes from
 # the canonical protocol module — lint rule PT801 rejects local redefinitions.
 # MSG_HEARTBEAT is the supervision piggyback (claim + liveness beacons);
@@ -144,18 +146,32 @@ def _sweep_stale_blob_dirs(shm_root):
 
 
 def _read_blob(path):
-    """Map a blob file copy-on-write and unlink it: the returned memoryview's
-    consumers (numpy views) keep the mapping — and thus the pages — alive; the
-    name disappears immediately, so nothing leaks even if deserialization
-    fails. ACCESS_COPY gives WRITABLE views without an upfront copy — the
-    uniform process-pool contract (the shm ring's per-message bytearray is
-    writable too, and the zmq fallback copies to match): writability must not
-    depend on which channel a payload happened to ride."""
+    """Map a blob file copy-on-write and unlink it, returning
+    ``(memoryview, slot)``: the view's consumers (numpy views) keep the
+    mapping — and thus the pages — alive; the name disappears immediately, so
+    nothing leaks even if deserialization fails. ACCESS_COPY gives WRITABLE
+    views without an upfront copy — the uniform process-pool contract (the
+    shm ring's per-message bytearray is writable too, and the zmq fallback
+    copies to match): writability must not depend on which channel a payload
+    happened to ride.
+
+    :borrows: the returned view borrows the mapping; the caller adopts the
+        deserialized arrays into ``slot`` and seals it, so the map is closed
+        (and counted in ``lifetime_live_borrows`` while alive) exactly when
+        the batch dies."""
     import mmap
     with open(path, 'rb') as f:
         mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_COPY)
     os.unlink(path)
-    return memoryview(mm)
+
+    def _close():
+        try:
+            mm.close()
+        except BufferError:
+            pass  # a straggler export closes it when the GC drops the chain
+
+    slot = lifetime_registry().open_slot(on_release=_close, label='pool-blob')
+    return memoryview(mm), slot  # noqa: PT500 - registered with the lifetime registry
 
 
 class ProcessPool(object):
@@ -164,7 +180,7 @@ class ProcessPool(object):
                  blob_threshold_bytes=_DEFAULT_BLOB_THRESHOLD,
                  on_error='raise', max_item_retries=None,
                  supervision=True, heartbeat_interval_s=_DEFAULT_HEARTBEAT_S,
-                 protocol_monitor=None):
+                 protocol_monitor=None, zero_copy=False):
         """``results_timeout_s``: raise if no worker message arrives within this
         many seconds (None = block indefinitely, matching ThreadPool).
         ``transport``: 'shm' (first-party C++ shared-memory rings) | 'zmq' |
@@ -186,7 +202,13 @@ class ProcessPool(object):
         instance, truthy for a fresh one, or None to honor the
         ``PSTPU_PROTOCOL_MONITOR`` env var; any observed event sequence the
         protocol spec rejects raises
-        :class:`~petastorm_tpu.errors.ProtocolViolation`."""
+        :class:`~petastorm_tpu.errors.ProtocolViolation`.
+        ``zero_copy``: deliver MSG_DATA batches as views straight into the
+        shm ring's slot instead of a per-message copy; every view is
+        lifetime-tracked through ``native/lifetime.py`` (the slot's ring
+        bytes are only reused once the batch's arrays die — docs/native.md,
+        "Zero-copy views and slot lifetimes"). shm transport only; the zmq
+        fallback already hands out owned buffers."""
         self._workers_count = workers_count
         self._results_hwm = results_queue_size
         from petastorm_tpu.serializers import PickleSerializer
@@ -200,6 +222,9 @@ class ProcessPool(object):
         self._transport = transport
         self._ring_bytes = ring_bytes
         self._blob_threshold = blob_threshold_bytes
+        # zero-copy consumer views: only meaningful over the shm transport
+        self._zero_copy = bool(zero_copy) and transport == 'shm'
+        self._ring_ledgers = {}  # id(ring) -> RingBorrowLedger (consumer side)
         self._policy = (on_error if isinstance(on_error, ErrorPolicy)
                         else ErrorPolicy(on_error, **({} if max_item_retries is None
                                                       else {'max_item_retries': max_item_retries})))
@@ -480,9 +505,12 @@ class ProcessPool(object):
         return self._workers_count
 
     def _poll_message(self, timeout_ms):
-        """Next (kind, seq, payload_bytes) from the results transport, or None
-        after ``timeout_ms``. shm: round-robin over the per-worker rings
-        (including dead workers' retired rings until they drain)."""
+        """Next (kind, seq, payload_bytes, slot) from the results transport,
+        or None after ``timeout_ms``. shm: round-robin over the per-worker
+        rings (including dead workers' retired rings until they drain).
+        ``slot`` is the lifetime-registry slot of a zero-copy borrowed
+        payload (None for owned payloads): the caller adopts the
+        deserialized arrays into it and seals it."""
         if self._transport == 'zmq':
             if not self._results_receive.poll(timeout_ms):
                 return None
@@ -492,7 +520,7 @@ class ProcessPool(object):
                 # read-only; the ring and blob channels hand out writable
                 # views, and the contract must not depend on the transport
                 payload = bytearray(payload)
-            return kind, (int(seq_bytes) if seq_bytes else None), payload
+            return kind, (int(seq_bytes) if seq_bytes else None), payload, None
         deadline = time.monotonic() + timeout_ms / 1000.0
         idle = self._idle_wait
         while True:
@@ -500,15 +528,15 @@ class ProcessPool(object):
                 for ring in self._rings:
                     if ring is None:
                         continue
-                    view = ring.try_read_view()
-                    if view is not None:
+                    msg = self._ring_take(ring)
+                    if msg is not None:
                         idle.reset()
-                        return ring_unpack(view)
+                        return msg
                 for ring in self._retired_rings:
-                    view = ring.try_read_view()
-                    if view is not None:
+                    msg = self._ring_take(ring)
+                    if msg is not None:
                         idle.reset()
-                        return ring_unpack(view)
+                        return msg
             if time.monotonic() >= deadline:
                 return None
             # spin→yield→sleep escalation (shm_ring.IdleWait): the first
@@ -517,6 +545,56 @@ class ProcessPool(object):
             # longer burn cores while the producers are quiet, and the spins
             # land in the ring_idle_spins counter
             idle.wait()
+
+    def _ring_take(self, ring):
+        """One (kind, seq, payload, slot) off ``ring``, or None when empty.
+        Caller holds ``_ring_lock`` (the C ring is single-consumer).
+
+        :borrows: in zero-copy mode a MSG_DATA ``payload`` aliases the ring
+            slot; ``slot`` is its ledger entry and MUST be adopted or
+            released — dropping both wedges the FIFO release ledger.
+
+        Copy mode: every message lands in a fresh per-message buffer.
+        Zero-copy mode: MSG_DATA payloads stay views into the ring slot,
+        accounted through the ring's :class:`RingBorrowLedger` — the slot's
+        bytes are retired to the producer only when the delivered batch's
+        arrays die (FIFO, whatever order the finalizers run in). Non-data
+        kinds are copied out and their span released immediately: they are
+        consumed inside the dispatch loop, so borrowing them buys nothing.
+        """
+        if not self._zero_copy:
+            view = ring.try_read_view()
+            return None if view is None else ring_unpack(view) + (None,)
+        item = ring.try_read_zero_copy()
+        if item is None:
+            return None
+        view, span, borrowed = item
+        ledger = self._ring_ledgers.get(id(ring))
+        if ledger is None:
+            ledger = self._ring_ledgers[id(ring)] = RingBorrowLedger(ring)
+        slot = ledger.take(view, span, borrowed)
+        kind, d, payload = ring_unpack(view)
+        if not borrowed:
+            # wrapped message: the view is an owned copy; retire the span
+            slot.release_now()
+            return kind, d, payload, None
+        if kind != MSG_DATA:
+            # copy the (small) control payload out of the ring, then retire
+            payload = memoryview(bytearray(payload))
+            slot.release_now()
+            return kind, d, payload, None
+        return kind, d, payload, slot
+
+    def _close_ring(self, ring):
+        """Close a consumer-side ring, deferring the munmap while zero-copy
+        borrows into its slots are alive (closing under a live view would
+        turn a stale batch read into a segfault). No-op deferral when the
+        ring never handed out a borrow."""
+        ledger = self._ring_ledgers.pop(id(ring), None)
+        if ledger is None:
+            ring.close()
+        else:
+            ledger.close_when_drained(ring.close)
 
     def ventilate(self, *args, **kwargs):
         seq = kwargs.pop('_seq', None)
@@ -611,7 +689,7 @@ class ProcessPool(object):
                 if deadline is not None and time.monotonic() > deadline:
                     raise TimeoutWaitingForResultError(self._timeout_message(timeout_s))
                 continue
-            kind, d, payload = msg
+            kind, d, payload, slot = msg
             if kind == MSG_DATA or kind == MSG_BLOB:
                 with self._state_lock:
                     rec = self._inflight.get(d) if d is not None else None
@@ -625,6 +703,8 @@ class ProcessPool(object):
                             os.unlink(bytes(payload).decode())
                         except OSError:
                             pass
+                    if slot is not None:
+                        slot.release_now()  # dropped borrow must not wedge the ring
                     continue
                 if rec is not None:
                     rec['published'] = True
@@ -634,8 +714,18 @@ class ProcessPool(object):
                 self.last_result_trace = obs.root_of(
                     rec.get('trace')) if rec is not None else None
                 if kind == MSG_DATA:
-                    return self._serializer.deserialize(payload)
-                return self._serializer.deserialize(_read_blob(bytes(payload).decode()))
+                    result = self._serializer.deserialize(payload)
+                    if slot is not None:
+                        # zero-copy delivery: the batch's arrays ARE ring-slot
+                        # views; their finalizers retire the span (lifetime.py)
+                        slot.adopt(result)
+                        slot.seal()
+                    return result
+                blob_view, blob_slot = _read_blob(bytes(payload).decode())
+                result = self._serializer.deserialize(blob_view)
+                blob_slot.adopt(result)
+                blob_slot.seal()
+                return result
             elif kind == MSG_DONE:
                 self._clear_claim(d)
                 with self._state_lock:
@@ -860,7 +950,7 @@ class ProcessPool(object):
             with self._ring_lock:
                 ring, self._rings[worker_id] = self._rings[worker_id], None
             if ring is not None:
-                ring.close()
+                self._close_ring(ring)
             self._processes[worker_id] = None
             self._respawn_failures[worker_id] = _MAX_RESPAWN_FAILURES
             logger.error('Respawning worker %d failed (%s); shedding the slot. '
@@ -881,7 +971,10 @@ class ProcessPool(object):
         with self._ring_lock:
             for ring in list(self._retired_rings):
                 if not ring.has_message():
-                    ring.close()
+                    # has_message() respects the zero-copy peek cursor, so an
+                    # all-delivered ring counts as drained even while borrows
+                    # are live; _close_ring defers the munmap until they die
+                    self._close_ring(ring)
                     self._retired_rings.remove(ring)
                 else:
                     return False
@@ -1051,8 +1144,14 @@ class ProcessPool(object):
                     for ring in self._rings + self._retired_rings:
                         if ring is None:
                             continue
-                        while ring.try_read() is not None:
-                            pass
+                        while True:
+                            drained = self._ring_take(ring)
+                            if drained is None:
+                                break
+                            if drained[3] is not None:
+                                # shutdown drain discards the payload; retire
+                                # the span immediately (nothing borrowed it)
+                                drained[3].release_now()
             time.sleep(0.05)
         for p in self._processes:
             if p is None:
@@ -1065,7 +1164,7 @@ class ProcessPool(object):
         with self._ring_lock:
             for ring in self._rings + self._retired_rings:
                 if ring is not None:
-                    ring.close()
+                    self._close_ring(ring)
             self._rings = []
             self._retired_rings = []
         for sock in (self._ventilator_send, self._results_receive, self._control_send):
@@ -1097,14 +1196,17 @@ class ProcessPool(object):
             completed = self._completed_items
             requeued = self._items_requeued
             quarantined = len(self._quarantined)
-        return {'workers_count': self._workers_count,
-                'items_ventilated': ventilated,
-                'items_completed': completed,
-                'items_in_flight': ventilated - completed,
-                'results_queue_depth': 0,
-                'worker_restarts': self._worker_restarts,
-                'items_requeued': requeued,
-                'items_quarantined': quarantined}
+        out = {'workers_count': self._workers_count,
+               'items_ventilated': ventilated,
+               'items_completed': completed,
+               'items_in_flight': ventilated - completed,
+               'results_queue_depth': 0,
+               'worker_restarts': self._worker_restarts,
+               'items_requeued': requeued,
+               'items_quarantined': quarantined,
+               'zero_copy': self._zero_copy}
+        out.update(lifetime_registry().counters())
+        return out
 
     @property
     def results_qsize(self):
@@ -1268,10 +1370,15 @@ def _worker_bootstrap(worker_id, main_pid, setup_blob, vent_addr, result_addr, c
                 os.unlink(path)
                 _note_blob_failure(e)
                 return False
-            buf = serializer.write_parts_into(parts, mm)
-            buf.release()  # the mmap refuses to close with live views
-            mm.close()
-            os.close(fd)
+            try:
+                buf = serializer.write_parts_into(parts, mm)
+                buf.release()  # the mmap refuses to close with live views
+            finally:
+                try:
+                    mm.close()
+                except BufferError:
+                    pass  # a failed fill left live views; GC closes the map
+                os.close(fd)
         except BaseException:
             try:
                 os.unlink(path)

@@ -260,14 +260,19 @@ class ThreadPool(object):
             completed = self._completed_items
             requeued = self._items_requeued
             quarantined = len(self._quarantined)
-        return {'workers_count': self._workers_count,
-                'items_ventilated': ventilated,
-                'items_completed': completed,
-                'items_in_flight': ventilated - completed,
-                'results_queue_depth': self._results_queue.qsize(),
-                'worker_restarts': 0,
-                'items_requeued': requeued,
-                'items_quarantined': quarantined}
+        out = {'workers_count': self._workers_count,
+               'items_ventilated': ventilated,
+               'items_completed': completed,
+               'items_in_flight': ventilated - completed,
+               'results_queue_depth': self._results_queue.qsize(),
+               'worker_restarts': 0,
+               'items_requeued': requeued,
+               'items_quarantined': quarantined}
+        # the lifetime_* family is process-global (chunkstore mirrors, serve
+        # blobs): surfaced by every pool type for one uniform schema
+        from petastorm_tpu.native.lifetime import registry as lifetime_registry
+        out.update(lifetime_registry().counters())
+        return out
 
     def telemetry_snapshots(self):
         """Worker metrics already live in this process's registry."""

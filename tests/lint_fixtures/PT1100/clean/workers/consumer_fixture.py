@@ -1,0 +1,17 @@
+"""Clean twin: the parked borrow is handed to the lifetime registry, whose
+slot refcount now blocks reclamation until the stored view dies."""
+
+from petastorm_tpu.native.lifetime import registry as lifetime_registry
+
+
+class StashingConsumer(object):
+    def __init__(self):
+        self._last_view = None
+
+    def poll(self, ring):
+        view = ring.try_read_zero_copy()
+        slot = lifetime_registry().open_slot(label='stash')
+        slot.adopt(view)
+        slot.seal()
+        self._last_view = view  # registered: reclaim waits on the refcount
+        return bytes(view)

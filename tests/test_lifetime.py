@@ -1,0 +1,339 @@
+"""Runtime half of the shared-plane borrow checker (native/lifetime.py):
+slot refcounts via finalizers, blocked/forced reclamation, the ring's FIFO
+release ledger, zero-copy delivery parity, and the PROT_NONE guard.
+
+Served-reader parity rides on tests/test_serve.py — the serve blob path
+adopts every delivered batch into a registry slot by default, so its
+row-equality tests exercise the borrowed path end to end. The static half
+(PT1100–PT1103) is proven in tests/test_static_analysis.py; the SEEDED
+use-after-release defect is caught both there (the PT1100 fixture) and here
+(``test_guard_faults_use_after_release``)."""
+
+import gc
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from petastorm_tpu.native.lifetime import (COUNTER_KEYS, RingBorrowLedger,
+                                           SlotRegistry, buffer_region,
+                                           registry)
+from petastorm_tpu.native.shm_ring import ShmRing
+
+
+# ---------------------------------------------------------------------------
+# Slot units
+# ---------------------------------------------------------------------------
+
+def test_last_borrow_death_fires_release_once():
+    reg = SlotRegistry()
+    fired = []
+    slot = reg.open_slot(on_release=lambda: fired.append(1))
+    a = np.arange(8)
+    b = {'nested': [a[2:]]}  # derived view: base rides along
+    slot.adopt(a)
+    slot.adopt(b)
+    slot.seal()
+    assert slot.live == 2 and fired == []
+    del a
+    gc.collect()
+    assert fired == []  # the slice in b keeps its base alive
+    del b
+    gc.collect()
+    assert fired == [1]
+    assert reg.counters()['lifetime_live_borrows'] == 0
+
+
+def test_seal_with_no_borrows_releases_immediately():
+    reg = SlotRegistry()
+    fired = []
+    slot = reg.open_slot(on_release=lambda: fired.append(1))
+    slot.seal()
+    assert fired == [1] and slot.released
+
+
+def test_release_now_is_idempotent_and_reclaim_agrees():
+    reg = SlotRegistry()
+    fired = []
+    slot = reg.open_slot(on_release=lambda: fired.append(1))
+    slot.release_now()
+    slot.release_now()
+    assert fired == [1]
+    assert slot.try_reclaim() is True  # already gone: reclaimer proceeds
+    assert fired == [1]
+    assert reg.counters()['lifetime_blocked_reclaims'] == 0
+
+
+def test_try_reclaim_refuses_while_borrows_live():
+    reg = SlotRegistry()
+    slot = reg.open_slot()
+    arr = np.zeros(4)
+    slot.adopt(arr)
+    slot.seal()
+    assert slot.try_reclaim() is False
+    assert reg.counters()['lifetime_blocked_reclaims'] == 1
+    del arr
+    gc.collect()
+    assert slot.try_reclaim() is True
+
+
+def test_force_reclaim_over_live_borrow_counts_guard_fault(monkeypatch):
+    monkeypatch.delenv('PSTPU_LIFETIME_GUARD', raising=False)
+    reg = SlotRegistry()
+    fired = []
+    slot = reg.open_slot(on_release=lambda: fired.append(1))
+    arr = np.zeros(4)
+    slot.adopt(arr)
+    slot.seal()
+    slot.force_reclaim()
+    assert fired == [1]
+    assert reg.counters()['lifetime_guard_faults'] == 1
+    del arr  # the late finalizer must not double-fire
+    gc.collect()
+    assert fired == [1]
+
+
+def test_buffer_region_resolves_arrays_and_views():
+    arr = np.arange(16, dtype=np.uint8)
+    addr, nbytes = buffer_region(arr)
+    assert addr == arr.ctypes.data and nbytes == 16
+    assert buffer_region(memoryview(arr)) == (addr, 16)
+    assert buffer_region(object()) is None
+
+
+def test_pool_diagnostics_carry_the_lifetime_family():
+    from petastorm_tpu.test_util.stub_workers import IdentityWorker
+    from petastorm_tpu.workers import ThreadPool
+    pool = ThreadPool(1)
+    pool.start(IdentityWorker)
+    try:
+        assert set(COUNTER_KEYS) <= set(pool.diagnostics)
+    finally:
+        pool.stop(); pool.join()
+
+
+# ---------------------------------------------------------------------------
+# RingBorrowLedger: FIFO retirement over arbitrary finalizer order
+# ---------------------------------------------------------------------------
+
+def _fresh_ring(capacity=1 << 16):
+    return ShmRing.create('/pstpu_lt_{}_{}'.format(os.getpid(), _fresh_ring.n),
+                          capacity)
+
+
+_fresh_ring.n = 0
+
+
+@pytest.fixture
+def ring():
+    _fresh_ring.n += 1
+    r = _fresh_ring()
+    yield r
+    r.close()
+
+
+def _take_all(ring, ledger):
+    """[(payload_copy, slot)] for every pending message, borrowed or not."""
+    out = []
+    while True:
+        item = ring.try_read_zero_copy()
+        if item is None:
+            return out
+        view, span, borrowed = item
+        slot = ledger.take(view, span, borrowed)
+        out.append((bytes(view), slot))
+
+
+def test_ledger_retires_fifo_despite_out_of_order_release(ring):
+    reg = SlotRegistry()
+    ledger = RingBorrowLedger(ring, registry_=reg)
+    for i in range(3):
+        assert ring.try_write(bytes([i]) * 64)
+    taken = _take_all(ring, ledger)
+    assert [p[0] for p, _ in taken] == [0, 1, 2]
+    # release the LAST take first: the head may not move past unreleased
+    # earlier spans, so the ring still looks full to the producer
+    taken[2][1].release_now()
+    taken[1][1].release_now()
+    assert ledger.live == 1
+    taken[0][1].release_now()
+    assert ledger.live == 0
+    # all spans retired: the ring accepts a capacity-straining write again
+    assert ring.try_write(b'z' * 1024)
+
+
+def test_ledger_defers_close_until_drained(ring):
+    reg = SlotRegistry()
+    ledger = RingBorrowLedger(ring, registry_=reg)
+    assert ring.try_write(b'x' * 32)
+    (_, slot), = _take_all(ring, ledger)
+    closed = []
+    assert ledger.close_when_drained(lambda: closed.append(1)) is False
+    assert closed == [] and reg.counters()['lifetime_blocked_reclaims'] == 1
+    slot.release_now()
+    assert closed == [1]
+
+
+def test_ledger_closes_immediately_when_empty(ring):
+    ledger = RingBorrowLedger(ring, registry_=SlotRegistry())
+    closed = []
+    assert ledger.close_when_drained(lambda: closed.append(1)) is True
+    assert closed == [1]
+
+
+def test_has_message_skips_peeked_but_unreleased(ring):
+    ledger = RingBorrowLedger(ring, registry_=SlotRegistry())
+    assert ring.try_write(b'a' * 16) and ring.try_write(b'b' * 16)
+    assert ring.has_message()
+    taken = _take_all(ring, ledger)
+    assert len(taken) == 2
+    # both delivered (still unreleased): nothing is PENDING anymore
+    assert not ring.has_message()
+    for _, slot in taken:
+        slot.release_now()
+    assert not ring.has_message()
+
+
+def test_ledger_release_order_fuzz(ring):
+    """Randomized release orders never wedge the FIFO ledger or corrupt
+    payloads (hypothesis-gated; skipped where hypothesis is absent)."""
+    hyp = pytest.importorskip('hypothesis')
+    from hypothesis import strategies as st
+
+    @hyp.given(st.permutations(range(8)), st.integers(16, 512))
+    @hyp.settings(max_examples=25, deadline=None)
+    def run(order, size):
+        reg = SlotRegistry()
+        ledger = RingBorrowLedger(ring, registry_=reg)
+        payloads = [bytes([i]) * size for i in range(8)]
+        for p in payloads:
+            assert ring.try_write(p)
+        taken = _take_all(ring, ledger)
+        assert [p for p, _ in taken] == payloads
+        for i in order:
+            taken[i][1].release_now()
+        assert ledger.live == 0
+        assert reg.counters()['lifetime_live_borrows'] == 0
+        assert not ring.has_message()
+
+    run()
+
+
+# ---------------------------------------------------------------------------
+# zero-copy delivery parity: same bits as the copy path
+# ---------------------------------------------------------------------------
+
+def _drain_sorted(pool):
+    from petastorm_tpu.workers import EmptyResultError
+    out = []
+    while True:
+        try:
+            out.append(pool.get_results())
+        except EmptyResultError:
+            return sorted(out, key=lambda b: b['x'].shape[0])
+
+
+def _batch_bits(batch):
+    return {k: (v.dtype.str, v.shape, v.tobytes()) for k, v in batch.items()}
+
+
+def test_process_pool_zero_copy_parity():
+    from petastorm_tpu.serializers import NumpyBlockSerializer
+    from petastorm_tpu.test_util.stub_workers import NumpyBatchWorker
+    from petastorm_tpu.workers import ProcessPool
+    # the registry is process-global and other suites legitimately hold
+    # long-lived borrows (pagescan's pinned mmaps), so assert the DELTA
+    gc.collect()
+    base_live = registry().counters()['lifetime_live_borrows']
+    results = {}
+    for zc in (False, True):
+        pool = ProcessPool(2, serializer=NumpyBlockSerializer(),
+                           transport='shm', zero_copy=zc)
+        pool.start(NumpyBatchWorker)
+        try:
+            for n in range(1, 13):
+                pool.ventilate(n)
+            batches = _drain_sorted(pool)
+            assert pool.diagnostics['zero_copy'] is zc
+        finally:
+            pool.stop(); pool.join()
+        results[zc] = [_batch_bits(b) for b in batches]
+        del batches  # the bits are copies; drop the borrowed arrays
+    assert results[True] == results[False]
+    gc.collect()
+    assert registry().counters()['lifetime_live_borrows'] == base_live
+
+
+def test_zero_copy_batch_survives_pool_shutdown():
+    """A consumer may hold the delivered arrays past stop/join: the ledger
+    defers the ring unmap, so the bytes stay valid and intact."""
+    from petastorm_tpu.serializers import NumpyBlockSerializer
+    from petastorm_tpu.test_util.stub_workers import NumpyBatchWorker
+    from petastorm_tpu.workers import ProcessPool
+    pool = ProcessPool(1, serializer=NumpyBlockSerializer(),
+                       transport='shm', zero_copy=True)
+    pool.start(NumpyBatchWorker)
+    pool.ventilate(9)
+    batch = _drain_sorted(pool)[0]
+    want = _batch_bits(batch)
+    pool.stop(); pool.join()
+    assert _batch_bits(batch) == want  # still readable after join
+    del batch
+    gc.collect()
+
+
+def test_make_reader_zero_copy_thread_noop(synthetic_dataset):
+    """zero_copy is a no-op for in-process pools: identical rows, no
+    borrows."""
+    from petastorm_tpu import make_reader
+    with make_reader(synthetic_dataset.url, reader_pool_type='thread',
+                     workers_count=2, shuffle_row_groups=False,
+                     zero_copy=True) as reader:
+        ids = sorted(row.id for row in reader)
+    assert ids == sorted(r['id'] for r in synthetic_dataset.data)
+
+
+# ---------------------------------------------------------------------------
+# the PROT_NONE guard: use-after-release faults loudly
+# ---------------------------------------------------------------------------
+
+_GUARD_PROBE = textwrap.dedent('''
+    import mmap
+    import numpy as np
+    from petastorm_tpu.native.lifetime import SlotRegistry, buffer_region
+    mm = mmap.mmap(-1, 4096)
+    arr = np.frombuffer(mm, dtype=np.uint8)
+    reg = SlotRegistry()
+    slot = reg.open_slot(guard_region=buffer_region(arr), label='probe')
+    view = arr[:64]
+    slot.adopt(view)
+    slot.seal()
+    slot.force_reclaim()  # live borrow: counted + PROT_NONE under the guard
+    assert reg.counters()['lifetime_guard_faults'] == 1
+    print('PRE-TOUCH', flush=True)
+    print(int(view[0]))  # use-after-release: must DIE here under the guard
+    print('POST-TOUCH', flush=True)
+''')
+
+
+def _run_guard_probe(guard):
+    env = dict(os.environ, PSTPU_LIFETIME_GUARD='1' if guard else '0',
+               PYTHONPATH=os.pathsep.join(sys.path))
+    return subprocess.run([sys.executable, '-c', _GUARD_PROBE],
+                          capture_output=True, text=True, env=env, timeout=60)
+
+
+def test_guard_faults_use_after_release():
+    res = _run_guard_probe(guard=True)
+    assert 'PRE-TOUCH' in res.stdout
+    assert 'POST-TOUCH' not in res.stdout
+    assert res.returncode != 0  # SIGSEGV/SIGBUS, not a clean exit
+
+
+def test_no_guard_means_no_fault():
+    res = _run_guard_probe(guard=False)
+    assert res.returncode == 0, res.stderr
+    assert 'POST-TOUCH' in res.stdout

@@ -205,10 +205,16 @@ def test_unified_pool_diagnostics_schema():
     from petastorm_tpu.workers import DummyPool, ProcessPool, ThreadPool
     expected = {'workers_count', 'items_ventilated', 'items_completed',
                 'items_in_flight', 'results_queue_depth',
-                'worker_restarts', 'items_requeued', 'items_quarantined'}
+                'worker_restarts', 'items_requeued', 'items_quarantined',
+                # process-global shared-plane borrow accounting
+                # (docs/native.md): one family across every pool type
+                'lifetime_live_borrows', 'lifetime_blocked_reclaims',
+                'lifetime_guard_faults'}
     pools = [DummyPool(), ThreadPool(2), ProcessPool(2)]
     for pool in pools:
-        assert set(pool.diagnostics) == expected, type(pool).__name__
+        # the process pool additionally reports its delivery mode
+        extras = {'zero_copy'} if isinstance(pool, ProcessPool) else set()
+        assert set(pool.diagnostics) == expected | extras, type(pool).__name__
         assert pool.telemetry_snapshots() == []
         assert all(isinstance(v, int) for v in pool.diagnostics.values())
 

@@ -87,8 +87,41 @@ native_corpus.replay_corrupt_chunk_regressions(lib)
 
 assert shm_ring.is_available(), 'sanitized shm ring failed to load'
 native_corpus.replay_ring_cycles(shm_ring, str(os.getpid()))
+native_corpus.replay_lifetime_cycles(shm_ring, str(os.getpid()))
 
 print('SANITIZED-REPLAY-OK')
+'''
+
+_USE_AFTER_RELEASE_DRIVER = '''\
+"""Deliberate use-after-release under the sanitized build + PROT_NONE guard:
+a borrowed ring view is force-reclaimed out from under the consumer, and the
+next touch MUST die (SIGSEGV via the guard page) instead of reading recycled
+bytes. The parent test asserts this driver does NOT exit cleanly."""
+import os
+import sys
+
+assert os.environ.get('PSTPU_LIFETIME_GUARD') == '1'
+
+from petastorm_tpu.native import build
+build.build_shm(quiet=True)
+import numpy as np
+from petastorm_tpu.native import shm_ring
+from petastorm_tpu.native.lifetime import RingBorrowLedger, SlotRegistry
+
+ring = shm_ring.ShmRing.create('/pstpu_uar_{}'.format(os.getpid()), 64 * 1024)
+# an 8 KiB payload guarantees at least one fully-covered page to protect
+assert ring.try_write(b'v' * 8192)
+view, span, borrowed = ring.try_read_zero_copy()
+assert borrowed, 'expected an in-place borrowed view'
+ledger = RingBorrowLedger(ring, registry_=SlotRegistry())
+slot = ledger.take(view, span, borrowed)
+arr = np.frombuffer(view, dtype=np.uint8)  # the consumer's delivered array
+slot.adopt(arr)
+slot.seal()
+slot.force_reclaim()  # reclaimer escalates over the live borrow -> PROT_NONE
+print('PRE-TOUCH', flush=True)
+print(int(arr.sum()))  # sweeps the guarded page: must die HERE
+print('POST-TOUCH', flush=True)
 '''
 
 
@@ -139,3 +172,18 @@ def test_sanitized_fuzz_replay(sanitizer_env, tmp_path):
     assert 'SANITIZED-REPLAY-OK' in proc.stdout
     for marker in ('AddressSanitizer', 'runtime error'):
         assert marker not in proc.stderr, proc.stderr
+
+
+def test_sanitized_use_after_release_is_caught(sanitizer_env, tmp_path):
+    """The runtime twin of the PT1100 fixture's seeded defect: touching a
+    force-reclaimed borrow dies loudly (guard page) under the sanitized
+    build — it must NEVER complete and read recycled ring bytes."""
+    driver = tmp_path / 'use_after_release.py'
+    driver.write_text(_USE_AFTER_RELEASE_DRIVER)
+    env = dict(sanitizer_env, PSTPU_LIFETIME_GUARD='1')
+    proc = subprocess.run([sys.executable, str(driver)], env=env,
+                          capture_output=True, text=True, timeout=560)
+    assert 'PRE-TOUCH' in proc.stdout, proc.stdout + proc.stderr
+    assert 'POST-TOUCH' not in proc.stdout, \
+        'use-after-release read recycled bytes undetected:\n' + proc.stdout
+    assert proc.returncode != 0

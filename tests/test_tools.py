@@ -335,7 +335,7 @@ def test_bench_serve_smoke(tmp_path, capsys):
     write_petastorm_dataset(url, schema, ({'x': i} for i in range(200)),
                             rows_per_row_group=20)
     bench_serve.main(['--url', url, '--consumers', '2',
-                      '--rows', '150', '--warmup-rows', '40'])
+                      '--rows', '150', '--warmup-rows', '40', '--rounds', '1'])
     lines = [l for l in capsys.readouterr().out.splitlines() if l.startswith('{')]
     recs = [_json.loads(l) for l in lines]
     headline = [r for r in recs if r.get('metric') == 'serve_bench']
@@ -345,4 +345,7 @@ def test_bench_serve_smoke(tmp_path, capsys):
     assert h['sweep']['2']['served_aggregate'] > 0
     assert h['sweep']['2']['independent_aggregate'] > 0
     assert h['single_served_rate'] > 0
+    assert h['pool_copy_rate'] > 0
+    assert h['pool_zero_copy_rate'] > 0
+    assert h['zero_copy_ratio'] is not None
     assert isinstance(h['meets_bar'], bool)
