@@ -77,6 +77,71 @@ def build_sequence_store(url, rows, feature_dim):
     return schema
 
 
+def _run_chaos(args):
+    """The ``--chaos`` lane: elastic pod churn with real process death.
+
+    Spawns host subprocesses (``petastorm_tpu.elastic._hostproc``) over one
+    shared coordination directory, SIGKILLs one once the pod has committed
+    ``--chaos-kill-after`` row groups, immediately joins a replacement, and
+    waits for the survivors. The emitted ``pod_chaos`` line carries the
+    scoreboard-derived ground truth: committed/double-committed counts, the
+    final generation, and per-host commit shares — on a healthy protocol
+    ``double_committed`` is 0 and ``committed`` equals the row-group count.
+    """
+    import subprocess
+
+    tmpdir = tempfile.mkdtemp(prefix='bench_pod_chaos_')
+    url = 'file://' + os.path.join(tmpdir, 'store')
+    build_sequence_store(url, args.rows, args.feature_dim)
+    coord = os.path.join(tmpdir, 'coord')
+    env = dict(os.environ, JAX_PLATFORMS='cpu',
+               PYTHONPATH=REPO_ROOT + os.pathsep + os.environ.get('PYTHONPATH', ''))
+
+    def spawn(host):
+        return subprocess.Popen(
+            [sys.executable, '-m', 'petastorm_tpu.elastic._hostproc',
+             '--url', url, '--coord', coord, '--host', host,
+             '--out', os.path.join(tmpdir, host + '.jsonl'),
+             '--field', 'ts', '--seed', '13', '--lease-s', '1.0',
+             '--sleep-per-row', '0.002'], env=env)
+
+    from petastorm_tpu.faults import HostChurnPlan, drive_host_churn
+    initial = max(2, min(args.hosts, 4))
+    procs = {'host{}'.format(h): spawn('host{}'.format(h))
+             for h in range(initial)}
+    plan = HostChurnPlan(kill_host='host1',
+                         kill_after_commits=args.chaos_kill_after,
+                         join_host='host{}'.format(initial))
+    timeline = drive_host_churn(
+        coord, procs, plan,
+        spawn_joiner=lambda: spawn(plan.join_host), timeout_s=300)
+    rcs = {h: p.wait(timeout=300) for h, p in procs.items()}
+
+    commits = {}
+    commits_dir = os.path.join(coord, 'commits')
+    for name in sorted(os.listdir(commits_dir)):
+        with open(os.path.join(commits_dir, name)) as f:
+            for line in f:
+                rec = json.loads(line)
+                commits.setdefault((rec['epoch'], rec['item']), []).append(rec)
+    double = sum(1 for v in commits.values() if len(v) > 1)
+    per_host = {}
+    for v in commits.values():
+        per_host[v[0]['host']] = per_host.get(v[0]['host'], 0) + 1
+    generations = len(os.listdir(os.path.join(coord, 'generations')))
+    survivors_ok = all(rc == 0 for h, rc in rcs.items() if h != plan.kill_host)
+    print(json.dumps({'metric': 'pod_chaos', 'hosts': initial,
+                      'killed': timeline['killed'], 'joined': timeline['joined'],
+                      'commits_at_kill': timeline['commits_at_kill'],
+                      'committed': len(commits), 'double_committed': double,
+                      'per_host_commits': per_host,
+                      'generations': generations,
+                      'survivor_exit_codes_ok': survivors_ok}), flush=True)
+    if double or not survivors_ok:
+        return 1
+    return 0
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument('--hosts', type=int, default=4)
@@ -93,7 +158,19 @@ def main(argv=None):
                         help='write one host-stamped telemetry JSONL per '
                              '(simulated) host into DIR — the input format of '
                              'petastorm-tpu-diagnose --pod (docs/observability.md)')
+    parser.add_argument('--chaos', action='store_true',
+                        help='elastic churn lane (docs/parallelism.md): run '
+                             'the pod as REAL host subprocesses with '
+                             'elastic=True, SIGKILL one mid-epoch and join a '
+                             'replacement, then assert exactly-once pod-wide '
+                             'coverage from the commit scoreboard. No '
+                             'devices needed; emits a pod_chaos JSON line.')
+    parser.add_argument('--chaos-kill-after', type=int, default=4,
+                        help='commit count that triggers the --chaos kill')
     args = parser.parse_args(argv)
+
+    if args.chaos:
+        return _run_chaos(args)
 
     _ensure_devices(args.devices)
 
@@ -222,4 +299,4 @@ def main(argv=None):
 
 
 if __name__ == '__main__':
-    main()
+    sys.exit(main() or 0)

@@ -13,6 +13,7 @@ from petastorm_tpu.autotune import AutotuneConfig  # noqa: F401
 from petastorm_tpu.errors import NoDataAvailableError  # noqa: F401
 from petastorm_tpu.transform import TransformSpec  # noqa: F401
 
-from petastorm_tpu.reader import make_reader, make_batch_reader  # noqa: F401
+from petastorm_tpu.reader import (make_reader, make_batch_reader,  # noqa: F401
+                                  merge_resume_states)
 
 __version__ = '0.1.0'

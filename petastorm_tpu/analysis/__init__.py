@@ -70,6 +70,12 @@ Rule families (see ``docs/analysis.md`` for bad/good examples):
   crossing a pickle/queue/zmq/ring boundary uncopied; PT1103: a borrow's
   manual release reachable only on some paths (``analysis/lifetime.py``,
   the static half of ``native/lifetime.py``).
+* **PT1200** elastic shard-map determinism — shard maps must be pure
+  functions of ``(seed, epoch, members)``: wall-clock reads, module-global
+  RNG draws, RNG constructors without an explicit seed, and iteration over
+  raw sets are all rejected inside ``elastic/shardmap.py``. Two hosts that
+  derive different maps for the same generation double-read or drop row
+  groups with no error anywhere (``analysis/elastic_lints.py``).
 
 Suppress a single finding with ``# noqa: PT###`` (reason encouraged) on its
 line; absorb pre-existing findings with an ``analysis_baseline.json`` (see
@@ -85,6 +91,7 @@ from petastorm_tpu.analysis.buffers import NativeBufferChecker
 from petastorm_tpu.analysis.core import (Baseline, Checker, Finding, SourceFile,
                                          collect_sources, load_baseline, run_checkers)
 from petastorm_tpu.analysis.cpp_safety import CppSafetyChecker
+from petastorm_tpu.analysis.elastic_lints import ElasticDeterminismChecker
 from petastorm_tpu.analysis.exceptions import (BaseExceptionContainmentChecker,
                                                ExceptionHygieneChecker)
 from petastorm_tpu.analysis.hashability import HashabilityChecker
@@ -114,6 +121,7 @@ ALL_CHECKERS = (
     AbiConformanceChecker,
     CppSafetyChecker,
     LifetimeChecker,
+    ElasticDeterminismChecker,
 )
 
 #: every individual rule id the registered checkers can emit — the linter
@@ -154,7 +162,7 @@ __all__ = [
     'ALL_CHECKERS', 'ALL_RULE_CODES', 'AbiConformanceChecker',
     'AutotuneActionChecker', 'Baseline',
     'BaseExceptionContainmentChecker', 'Checker', 'CppSafetyChecker',
-    'ExceptionHygieneChecker', 'Finding',
+    'ElasticDeterminismChecker', 'ExceptionHygieneChecker', 'Finding',
     'HashabilityChecker', 'JaxPurityChecker', 'LifetimeChecker',
     'LockDisciplineChecker',
     'NativeBufferChecker', 'ProtocolLintChecker', 'ResourceLifecycleChecker', 'ServeActuatorChecker',

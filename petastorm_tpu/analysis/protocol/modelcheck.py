@@ -259,6 +259,12 @@ def main(argv=None):
                              '(multi-consumer broadcast-ring invariants, '
                              'docs/serve.md) instead of the supervision '
                              'protocol; --mutate then takes a serve mutation')
+    parser.add_argument('--elastic', action='store_true',
+                        help='check the elastic resharding protocol (pod '
+                             'host join/leave mid-epoch, exactly-once '
+                             'handoff; docs/parallelism.md) instead of the '
+                             'supervision protocol; --mutate then takes an '
+                             'elastic mutation')
     parser.add_argument('--workers', type=int, default=DEFAULT_SCOPE['workers'])
     parser.add_argument('--items', type=int, default=DEFAULT_SCOPE['items'])
     parser.add_argument('--crashes', type=int, default=DEFAULT_SCOPE['crashes'])
@@ -269,8 +275,10 @@ def main(argv=None):
     parser.add_argument('--no-publish', action='store_true',
                         help='do not model the payload message as a separate '
                              'step (smaller space, weaker delivery invariant)')
+    from petastorm_tpu.analysis.protocol import elastic_spec as EL
     from petastorm_tpu.analysis.protocol import serve_spec as SV
-    parser.add_argument('--mutate', choices=S.MUTATIONS + SV.MUTATIONS,
+    parser.add_argument('--mutate',
+                        choices=S.MUTATIONS + SV.MUTATIONS + EL.MUTATIONS,
                         default=None,
                         help='seed one protocol defect; the checker must then '
                              'produce a counterexample')
@@ -283,9 +291,14 @@ def main(argv=None):
     parser.add_argument('--json', action='store_true')
     try:
         args = parser.parse_args(argv)
+        if args.serve and args.elastic:
+            raise ValueError('--serve and --elastic are mutually exclusive')
         if args.serve:
             cfg = SV.ServeSpecConfig(mutation=args.mutate,
                                      **SV.DEFAULT_SERVE_SCOPE)
+        elif args.elastic:
+            cfg = EL.ElasticSpecConfig(mutation=args.mutate,
+                                       **EL.DEFAULT_ELASTIC_SCOPE)
         else:
             cfg = S.SpecConfig(workers=args.workers, items=args.items,
                                crashes=args.crashes, retries=args.retries,
@@ -297,12 +310,15 @@ def main(argv=None):
         print('error: {}'.format(e), file=sys.stderr)
         return 2
 
-    if args.serve:
-        result = SV.check(cfg, budget_s=args.budget_s, max_states=args.max_states)
+    if args.serve or args.elastic:
+        module = SV if args.serve else EL
+        result = module.check(cfg, budget_s=args.budget_s,
+                              max_states=args.max_states)
         if args.json:
             print(json.dumps(result.to_dict(), indent=2))
         else:
-            print('serve scope: {}'.format(cfg.describe()))
+            print('{} scope: {}'.format('serve' if args.serve else 'elastic',
+                                        cfg.describe()))
             print('explored {} canonical states, {} transitions, depth {}, '
                   '{} terminal, in {:.2f}s'.format(
                       result.states, result.transitions, result.depth,
@@ -314,7 +330,7 @@ def main(argv=None):
                     print('  {:>3}. {!r}'.format(i + 1, label))
             elif result.exhausted:
                 print('exhausted: all invariants hold ({})'.format(
-                    ', '.join(SV.INVARIANTS)))
+                    ', '.join(module.INVARIANTS)))
             else:
                 print('NOT exhausted: budget ran out — verdict covers only '
                       'the explored prefix')

@@ -268,6 +268,118 @@ class ServeMonitor(object):
             self._consumer_ended = True
 
 
+class ElasticMonitor(object):
+    """Runtime conformance monitor for the elastic resharding protocol
+    (``docs/parallelism.md`` "Elastic pod sharding"; spec in
+    ``analysis/protocol/elastic_spec.py``). Each host checks its observable
+    projection of the pod-wide protocol:
+
+    * the generation number is strictly monotonic (``on_reshard``);
+    * no row group is claimed after it was committed, and no row group is
+      claimed while another host's un-expired lease pins it in flight;
+    * no row group is committed twice, and every commit follows a claim by
+      the committing host (a commit without a claim is the signature of a
+      lease being honored after it was handed off);
+    * a lease expiry releases the departed host's claims for adoption;
+      a (re)join clears its expired status.
+
+    Violations raise :class:`~petastorm_tpu.errors.ProtocolViolation`.
+    """
+
+    def __init__(self, name='elastic'):
+        self._name = name
+        self._lock = threading.Lock()
+        self._generation = 0
+        self._claims = {}       # item -> claiming host
+        self._delivered = set()
+        self._expired = set()
+        self.events_checked = 0
+
+    def _fail(self, message):
+        raise ProtocolViolation('[elastic monitor: {}] {}'.format(self._name,
+                                                                  message))
+
+    def on_join(self, host):
+        with self._lock:
+            self.events_checked += 1
+            self._expired.discard(host)
+
+    def on_lease_expire(self, host):
+        with self._lock:
+            self.events_checked += 1
+            self._expired.add(host)
+            # the departed host's claims become adoptable exactly now
+            for item, holder in list(self._claims.items()):
+                if holder == host:
+                    del self._claims[item]
+
+    def on_reshard(self, generation, members=()):
+        with self._lock:
+            self.events_checked += 1
+            if generation <= self._generation:
+                self._fail('generation regressed: {} -> {} — shard maps '
+                           'must advance monotonically or two hosts can '
+                           'disagree about ownership forever'
+                           .format(self._generation, generation))
+            self._generation = generation
+
+    def on_claim(self, host, item):
+        with self._lock:
+            self.events_checked += 1
+            if item in self._delivered:
+                self._fail('host {} claimed row group {!r} which was already '
+                           'committed — re-reading it would deliver the '
+                           'group twice'.format(host, item))
+            holder = self._claims.get(item)
+            if holder is not None and holder != host:
+                self._fail('host {} claimed row group {!r} while host {} '
+                           'still holds it under a live lease — in-flight '
+                           'groups move only after lease expiry'
+                           .format(host, item, holder))
+            self._claims[item] = host
+
+    def on_deliver(self, host, item):
+        with self._lock:
+            self.events_checked += 1
+            if item in self._delivered:
+                self._fail('row group {!r} committed twice (second commit by '
+                           'host {})'.format(item, host))
+            holder = self._claims.pop(item, None)
+            if holder is None:
+                self._fail('host {} committed row group {!r} without a live '
+                           'claim — its lease was already handed off'
+                           .format(host, item))
+            if holder != host:
+                self._fail('host {} committed row group {!r} claimed by host '
+                           '{}'.format(host, item, holder))
+            self._delivered.add(item)
+
+    @property
+    def snapshot(self):
+        with self._lock:
+            return {'generation': self._generation,
+                    'claims': dict(self._claims),
+                    'delivered': len(self._delivered),
+                    'expired': sorted(self._expired),
+                    'events_checked': self.events_checked}
+
+
+def elastic_monitor_from_env(explicit, name):
+    """Resolve an elastic ``monitor`` argument exactly like
+    :func:`monitor_from_env`, honoring ``PSTPU_ELASTIC_MONITOR`` (with
+    ``PSTPU_PROTOCOL_MONITOR`` as the umbrella opt-in)."""
+    import os
+    if explicit is None:
+        env = os.environ.get('PSTPU_ELASTIC_MONITOR',
+                             os.environ.get('PSTPU_PROTOCOL_MONITOR', ''))
+        explicit = env not in ('', '0')
+    if not explicit:
+        return None
+    if isinstance(explicit, ElasticMonitor):
+        return explicit
+    return ElasticMonitor(name=name)
+
+
 def serve_monitor_from_env(explicit, name):
     """Resolve a serve-side ``monitor`` argument exactly like
     :func:`monitor_from_env`, honoring ``PSTPU_SERVE_MONITOR`` (with
@@ -300,5 +412,6 @@ def monitor_from_env(explicit, name):
     return ProtocolMonitor(name=name)
 
 
-__all__ = ['ProtocolMonitor', 'ProtocolViolation', 'ServeMonitor',
-           'monitor_from_env', 'serve_monitor_from_env']
+__all__ = ['ElasticMonitor', 'ProtocolMonitor', 'ProtocolViolation',
+           'ServeMonitor', 'elastic_monitor_from_env', 'monitor_from_env',
+           'serve_monitor_from_env']

@@ -1,0 +1,122 @@
+"""Elastic pod sharding: survive host join/leave mid-epoch.
+
+``make_reader(elastic=True)`` (or an explicit :class:`ElasticConfig`)
+replaces static ``cur_shard``/``shard_count`` arithmetic with a lease-based
+membership registry, a generation-numbered shard map, and an exactly-once
+resharding protocol, all coordinated through a shared filesystem directory
+— no coordinator process, no network channel (``docs/parallelism.md``,
+"Elastic pod sharding").
+
+The protocol is model-checked (``petastorm-tpu-modelcheck --elastic``,
+spec in :mod:`petastorm_tpu.analysis.protocol.elastic_spec`) and watched
+at runtime by :class:`~petastorm_tpu.analysis.protocol.monitor.
+ElasticMonitor`; shard-map purity is lint-enforced (PT1200).
+"""
+
+from __future__ import annotations
+
+import os
+
+from petastorm_tpu.elastic.membership import (DEFAULT_LEASE_RETRY,
+                                              MembershipRegistry)
+from petastorm_tpu.elastic.shardmap import (ShardMap, global_order, owner_of,
+                                            stable_hash)
+
+
+def default_host_id():
+    """A stable identity for this host: the JAX process index when a
+    distributed runtime is initialized, else machine + pid (unique enough
+    for single-machine pods and tests)."""
+    try:
+        from petastorm_tpu.parallel.mesh import reader_shard_for_process
+        index, count = reader_shard_for_process()
+        if count > 1:
+            return 'host{}'.format(index)
+    except Exception:       # noqa: PT300 - jax absent/uninitialized: fall back
+        pass
+    try:
+        node = os.uname().nodename
+    except (AttributeError, OSError):
+        node = 'host'
+    return '{}-{}'.format(node, os.getpid())
+
+
+class ElasticConfig(object):
+    """Configuration for an elastic reader.
+
+    :param coord_dir: shared coordination directory all pod hosts can
+        reach (NFS/GCS-fuse mount). ``None`` derives ``<dataset>/_elastic``
+        from the dataset path — fine whenever the dataset itself lives on
+        a shared writable filesystem.
+    :param host_id: this host's stable identity; ``None`` derives it from
+        ``jax.process_index()`` (falling back to machine+pid)
+    :param lease_s: membership lease duration — the worst-case time a dead
+        host pins its in-flight row groups
+    :param poll_s: membership/scoreboard scan period (default ``lease_s/4``)
+    :param monitor: an :class:`~petastorm_tpu.analysis.protocol.monitor.
+        ElasticMonitor` (or ``None`` to resolve from ``PSTPU_ELASTIC_MONITOR``)
+    :param retry: a :class:`~petastorm_tpu.retry.RetryPolicy` for all lease
+        and scoreboard I/O (default: bounded short-backoff policy) — slow
+        shared-fs metadata ops retry instead of false-positiving a death
+    """
+
+    __slots__ = ('coord_dir', 'host_id', 'lease_s', 'poll_s', 'monitor',
+                 'retry')
+
+    def __init__(self, coord_dir=None, host_id=None, lease_s=5.0,
+                 poll_s=None, monitor=None, retry=None):
+        if lease_s <= 0:
+            raise ValueError('lease_s must be positive, got {!r}'
+                             .format(lease_s))
+        if poll_s is None:
+            poll_s = max(lease_s / 4.0, 0.02)
+        if poll_s <= 0:
+            raise ValueError('poll_s must be positive, got {!r}'
+                             .format(poll_s))
+        self.coord_dir = coord_dir
+        self.host_id = host_id
+        self.lease_s = float(lease_s)
+        self.poll_s = float(poll_s)
+        self.monitor = monitor
+        self.retry = retry
+
+    def retry_policy(self):
+        return self.retry if self.retry is not None else DEFAULT_LEASE_RETRY
+
+    def describe(self):
+        return ('coord_dir={} host={} lease_s={} poll_s={}'
+                .format(self.coord_dir, self.host_id, self.lease_s,
+                        self.poll_s))
+
+
+def resolve_elastic(value, dataset_path=None):
+    """Normalize ``make_reader``'s ``elastic=`` argument into a fully
+    resolved :class:`ElasticConfig` (filling in the derived coordination
+    directory, host identity, and env-resolved monitor)."""
+    if value is True:
+        cfg = ElasticConfig()
+    elif isinstance(value, ElasticConfig):
+        cfg = value
+    else:
+        raise ValueError('elastic= must be True or an ElasticConfig, got '
+                         '{!r}'.format(value))
+    coord_dir = cfg.coord_dir
+    if coord_dir is None:
+        if dataset_path is None:
+            raise ValueError('elastic=True needs a dataset on a local/shared '
+                             'path to derive the coordination directory; '
+                             'pass ElasticConfig(coord_dir=...) explicitly')
+        coord_dir = os.path.join(dataset_path, '_elastic')
+    host_id = cfg.host_id if cfg.host_id is not None else default_host_id()
+    from petastorm_tpu.analysis.protocol.monitor import elastic_monitor_from_env
+    monitor = elastic_monitor_from_env(cfg.monitor,
+                                       name='elastic:{}'.format(host_id))
+    resolved = ElasticConfig(coord_dir=coord_dir, host_id=str(host_id),
+                             lease_s=cfg.lease_s, poll_s=cfg.poll_s,
+                             monitor=monitor, retry=cfg.retry)
+    return resolved
+
+
+__all__ = ['DEFAULT_LEASE_RETRY', 'ElasticConfig', 'MembershipRegistry',
+           'ShardMap', 'default_host_id', 'global_order', 'owner_of',
+           'resolve_elastic', 'stable_hash']

@@ -1,0 +1,83 @@
+"""One elastic pod host as a subprocess — the chaos-test / bench target.
+
+``python -m petastorm_tpu.elastic._hostproc --url ... --coord ... --host h0
+--out h0.jsonl`` opens an elastic reader, consumes rows, and appends one JSON
+line per epoch plus a final ``{"event": "exit"}`` line to ``--out``. The
+driver (``tests/test_elastic.py``, ``bench_pod.py --chaos``) SIGKILLs one of
+these mid-epoch and starts another to exercise the handoff protocol with
+real process death — the coordination directory's commit logs and done
+markers are the ground truth the driver asserts over.
+
+``--sleep-per-row`` throttles consumption so an epoch stays open long enough
+for the driver to kill/join deterministically.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def _parse_args(argv):
+    parser = argparse.ArgumentParser(prog='pstpu-elastic-host')
+    parser.add_argument('--url', required=True)
+    parser.add_argument('--coord', required=True)
+    parser.add_argument('--host', required=True)
+    parser.add_argument('--out', required=True)
+    parser.add_argument('--seed', type=int, default=0)
+    parser.add_argument('--lease-s', type=float, default=1.0)
+    parser.add_argument('--poll-s', type=float, default=None)
+    parser.add_argument('--num-epochs', type=int, default=1)
+    parser.add_argument('--sleep-per-row', type=float, default=0.0)
+    parser.add_argument('--field', default='id')
+    parser.add_argument('--no-shuffle', action='store_true')
+    parser.add_argument('--ready-file', default=None,
+                        help='touched once the reader is up and iterating')
+    return parser.parse_args(argv)
+
+
+def main(argv=None):
+    args = _parse_args(argv if argv is not None else sys.argv[1:])
+    from petastorm_tpu import make_reader
+    from petastorm_tpu.elastic import ElasticConfig
+
+    cfg = ElasticConfig(coord_dir=args.coord, host_id=args.host,
+                        lease_s=args.lease_s, poll_s=args.poll_s)
+    out = open(args.out, 'a')
+
+    def emit(record):
+        out.write(json.dumps(record) + '\n')
+        out.flush()
+
+    emit({'event': 'start', 'host': args.host, 'pid': os.getpid()})
+    reader = make_reader(args.url, schema_fields=[args.field],
+                         reader_pool_type='dummy', seed=args.seed,
+                         shuffle_row_groups=not args.no_shuffle,
+                         num_epochs=args.num_epochs, elastic=cfg)
+    if args.ready_file:
+        with open(args.ready_file, 'w') as fh:
+            fh.write(str(os.getpid()))
+    try:
+        values = []
+        for row in reader:
+            values.append(getattr(row, args.field))
+            if args.sleep_per_row:
+                time.sleep(args.sleep_per_row)
+        status = reader.elastic_coordinator.status()
+        emit({'event': 'done', 'host': args.host, 'rows': len(values),
+              'values': [int(v) for v in values],
+              'generation': status['generation'],
+              'members': list(status['members'])})
+    finally:
+        reader.stop()
+        reader.join()
+    emit({'event': 'exit', 'host': args.host})
+    out.close()
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -1,0 +1,599 @@
+"""Elastic resharding coordinator + the ventilator that drives it.
+
+The coordinator owns one host's view of the shared coordination directory:
+
+* ``members/`` — heartbeat leases (:mod:`petastorm_tpu.elastic.membership`)
+* ``generations/NNNNNNNN.json`` — the generation log. Each file pins one
+  generation's sorted member list; files are created with ``O_EXCL`` so
+  exactly one proposal wins each number and the sequence is monotonic by
+  construction. The *current* generation is the highest-numbered file.
+* ``epochs/NNNNNN/done/NNNNNNNN`` — the per-epoch scoreboard. A row group
+  is **committed** when its marker file exists; markers are created with
+  ``O_EXCL``, so exactly one host wins each commit no matter how racy the
+  handoff was — this is what makes delivery exactly-once by construction.
+* ``epochs/NNNNNN/inflight/<host>.json`` — each host's claimed-but-not-yet
+  -committed row groups. A *live* host's in-flight items are never claimed
+  by anyone else; a dead host's (lease expired or lease file gone) become
+  adoptable, which is counted as ``rowgroups_handed_off``.
+* ``commits/<host>.jsonl`` — an append-only audit log of the commits this
+  host won (epoch, item, global rank, generation). The union of all hosts'
+  logs is the pod's committed stream; chaos tests assert it covers every
+  row group exactly once and in the seeded global order.
+
+The resharding protocol, per poll: scan leases; if the alive set differs
+from the current generation's member set, propose generation N+1 with the
+alive set (``O_EXCL``; losers adopt the winner's file). Unstarted row
+groups re-partition under the new map instantly — ownership is the pure
+function :func:`~petastorm_tpu.elastic.shardmap.owner_of`, so no state
+migrates. In-flight row groups follow dispatch-id ownership: they stay
+pinned to the claiming host while its lease lives, and are adopted by
+their new owner only after the lease expires.
+"""
+
+from __future__ import annotations
+
+import errno
+import itertools
+import json
+import os
+import threading
+import time
+from collections import OrderedDict
+
+from petastorm_tpu import observability as obs
+from petastorm_tpu.elastic.membership import MembershipRegistry
+from petastorm_tpu.elastic.shardmap import ShardMap
+from petastorm_tpu.workers.ventilator import VentilatorBase
+
+
+def _atomic_write(path, payload, retry):
+    tmp = '{}.tmp.{}'.format(path, os.getpid())
+
+    def write_and_swap():
+        with open(tmp, 'w') as f:
+            f.write(payload)
+        os.rename(tmp, path)
+
+    retry.call(write_and_swap)
+
+
+class ElasticCoordinator(object):
+    """One host's protocol engine over the shared coordination directory.
+
+    Not thread-safe by itself; the elastic ventilator serializes calls on
+    its feeding thread, except :meth:`commit` which may run on the
+    consumer's results thread — commit only touches ``O_EXCL`` markers,
+    the append-only log, and lock-guarded caches.
+    """
+
+    def __init__(self, config, num_items, seed=None, shuffle=True):
+        self.config = config
+        self.num_items = int(num_items)
+        self.seed = seed
+        self.shuffle = bool(shuffle)
+        self.host_id = config.host_id
+        self.coord_dir = config.coord_dir
+        self.poll_s = config.poll_s
+        self.monitor = config.monitor
+        self._retry = config.retry_policy()
+        self.registry = MembershipRegistry(self.coord_dir, self.host_id,
+                                           lease_s=config.lease_s,
+                                           retry=self._retry)
+        self._generations_dir = os.path.join(self.coord_dir, 'generations')
+        self._epochs_dir = os.path.join(self.coord_dir, 'epochs')
+        self._commit_log = os.path.join(self.coord_dir, 'commits',
+                                        self.host_id + '.jsonl')
+        self._lock = threading.Lock()
+        self._generation = 0
+        self._members = ()
+        self._maps = {}             # (generation, epoch) -> ShardMap
+        self._last_alive = ()
+        self._counted_expired = set()
+        self._last_scan = 0.0
+        self._epoch_state = {}      # epoch -> dict(done=set, deferred=set,
+                                    #   dead_inflight=set, ventilated=set,
+                                    #   inflight=set, handed_off=set)
+        self._started = False
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self):
+        if self._started:
+            return
+        self._retry.call(os.makedirs, self._generations_dir, exist_ok=True)
+        self._retry.call(os.makedirs, self._epochs_dir, exist_ok=True)
+        self._retry.call(os.makedirs, os.path.dirname(self._commit_log),
+                         exist_ok=True)
+        self.registry.join()
+        if self.monitor is not None:
+            self.monitor.on_join(self.host_id)
+        self._started = True
+        self.poll(epoch=None, force=True)
+
+    def close(self):
+        if self._started:
+            self.registry.leave()
+            self._started = False
+
+    # -- generation log ----------------------------------------------------
+
+    def _gen_path(self, generation):
+        return os.path.join(self._generations_dir,
+                            '{:08d}.json'.format(generation))
+
+    def _read_current_generation(self):
+        try:
+            names = self._retry.call(os.listdir, self._generations_dir)
+        except OSError as e:
+            if getattr(e, 'errno', None) == errno.ENOENT:
+                return 0, ()
+            raise
+        numbers = sorted(int(n.split('.')[0]) for n in names
+                         if n.endswith('.json') and n.split('.')[0].isdigit())
+        if not numbers:
+            return 0, ()
+        generation = numbers[-1]
+        data = self._retry.call(self._read_json, self._gen_path(generation))
+        return generation, tuple(data.get('members') or ())
+
+    def _read_json(self, path):
+        with open(path, 'r') as f:
+            return json.loads(f.read())
+
+    def _propose_generation(self, generation, members):
+        """O_EXCL proposal: exactly one host defines each generation number;
+        losers just re-read the winner's file."""
+        payload = json.dumps({'generation': generation,
+                              'members': list(members),
+                              'proposed_by': self.host_id})
+        path = self._gen_path(generation)
+        try:
+            fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            return False
+        except OSError:
+            return False
+        try:
+            os.write(fd, payload.encode('utf-8'))
+        finally:
+            os.close(fd)
+        return True
+
+    # -- membership / resharding poll -------------------------------------
+
+    def poll(self, epoch=None, force=False):
+        """Refresh the membership + scoreboard view (rate-limited to one
+        filesystem scan per ``poll_s``); advance the generation when the
+        alive set drifted from the current generation's member set."""
+        now = time.time()
+        if not force and (now - self._last_scan) < self.poll_s:
+            return
+        self._last_scan = now
+
+        infos = self.registry.scan(now=now)
+        alive = set(m.host for m in infos if m.alive)
+        alive.add(self.host_id)     # our own lease is renewed by our thread
+        alive = tuple(sorted(alive))
+        expired = tuple(sorted(m.host for m in infos if m.expired))
+
+        for host in expired:
+            if host not in self._counted_expired:
+                self._counted_expired.add(host)
+                obs.count('elastic_lease_expirations')
+                if self.monitor is not None:
+                    self.monitor.on_lease_expire(host)
+        for host in alive:
+            if host in self._counted_expired:
+                self._counted_expired.discard(host)   # rejoined
+        if self.monitor is not None:
+            for host in alive:
+                if host not in self._last_alive and host != self.host_id:
+                    self.monitor.on_join(host)
+        self._last_alive = alive
+
+        current, members = self._read_current_generation()
+        if alive and members != alive:
+            with obs.stage('reshard', cat='elastic'):
+                self._propose_generation(current + 1, alive)
+                current, members = self._read_current_generation()
+
+        if current > self._generation and members:
+            self._generation = current
+            self._members = members
+            obs.count('reshard_generations')
+            obs.gauge_set('elastic_generation', current)
+            obs.gauge_set('elastic_member_count', len(members))
+            if self.monitor is not None:
+                self.monitor.on_reshard(current, members)
+
+        if epoch is not None:
+            self._refresh_epoch(epoch, alive)
+
+    def _refresh_epoch(self, epoch, alive):
+        state = self._epoch_state.get(epoch)
+        if state is None:
+            return
+        done = set()
+        try:
+            for name in self._retry.call(os.listdir, self._done_dir(epoch)):
+                if name.isdigit():
+                    done.add(int(name))
+        except OSError:
+            pass
+        deferred, dead_inflight = set(), set()
+        try:
+            names = self._retry.call(os.listdir, self._inflight_dir(epoch))
+        except OSError:
+            names = []
+        for name in sorted(names):
+            if not name.endswith('.json'):
+                continue
+            host = name[:-len('.json')]
+            if host == self.host_id:
+                continue
+            try:
+                data = self._retry.call(
+                    self._read_json, os.path.join(self._inflight_dir(epoch), name))
+            except (OSError, ValueError):
+                # unreadable peer inflight: assume it pins its items (the
+                # conservative direction — never adopt on an I/O hiccup)
+                continue
+            items = set(int(i) for i in data.get('items') or ())
+            if host in alive:
+                deferred |= items
+            else:
+                dead_inflight |= items
+        with self._lock:
+            state['done'] |= done
+            state['deferred'] = deferred - state['done']
+            state['dead_inflight'] = dead_inflight - state['done']
+
+    # -- per-epoch scoreboard ----------------------------------------------
+
+    def _epoch_dir(self, epoch):
+        return os.path.join(self._epochs_dir, '{:06d}'.format(epoch))
+
+    def _done_dir(self, epoch):
+        return os.path.join(self._epoch_dir(epoch), 'done')
+
+    def _inflight_dir(self, epoch):
+        return os.path.join(self._epoch_dir(epoch), 'inflight')
+
+    def _inflight_path(self, epoch):
+        return os.path.join(self._inflight_dir(epoch),
+                            self.host_id + '.json')
+
+    def begin_epoch(self, epoch):
+        self._retry.call(os.makedirs, self._done_dir(epoch), exist_ok=True)
+        self._retry.call(os.makedirs, self._inflight_dir(epoch), exist_ok=True)
+        with self._lock:
+            self._epoch_state.setdefault(epoch, {
+                'done': set(), 'deferred': set(), 'dead_inflight': set(),
+                'ventilated': set(), 'inflight': set(), 'handed_off': set()})
+        # bounded memory: forget scoreboards of long-finished epochs
+        with self._lock:
+            stale = sorted(self._epoch_state)[:-4]
+            for e in stale:
+                del self._epoch_state[e]
+        self.poll(epoch=epoch, force=True)
+
+    def shard_map(self, epoch):
+        key = (self._generation, epoch)
+        cached = self._maps.get(key)
+        if cached is None:
+            cached = ShardMap(self._generation, self._members, self.num_items,
+                              self.seed, epoch, shuffle=self.shuffle)
+            self._maps = {key: cached}   # only the live generation matters
+        return cached
+
+    def claimable_items(self, epoch):
+        """Row groups this host should ventilate next, in global emission
+        order: owned under the current map, not committed, not pinned by a
+        live peer's in-flight claim, not already ventilated locally."""
+        if not self._members or self.host_id not in self._members:
+            return []       # not (yet) part of the current generation
+        smap = self.shard_map(epoch)
+        with self._lock:
+            state = self._epoch_state[epoch]
+            blocked = state['done'] | state['deferred'] | state['ventilated']
+        return [item for item in smap.owned_items(self.host_id)
+                if item not in blocked]
+
+    def note_ventilated(self, epoch, item):
+        """Record a local claim just before dispatching ``item`` to the
+        pool: the in-flight file is the claim other hosts honor."""
+        with self._lock:
+            state = self._epoch_state[epoch]
+            state['ventilated'].add(item)
+            state['inflight'].add(item)
+            handed_off = (item in state['dead_inflight']
+                          and item not in state['handed_off'])
+            if handed_off:
+                state['handed_off'].add(item)
+            inflight = sorted(state['inflight'])
+        if handed_off:
+            obs.count('rowgroups_handed_off')
+        if self.monitor is not None:
+            self.monitor.on_claim(self.host_id, (epoch, item))
+        self._write_inflight(epoch, inflight)
+
+    def _write_inflight(self, epoch, items):
+        payload = json.dumps({'host': self.host_id,
+                              'generation': self._generation,
+                              'items': items})
+        try:
+            _atomic_write(self._inflight_path(epoch), payload, self._retry)
+        except OSError:
+            pass    # a lost claim write only risks duplicate *reads*, never
+                    # duplicate commits — the done marker stays exclusive
+
+    def is_done(self, epoch, item):
+        with self._lock:
+            return item in self._epoch_state[epoch]['done']
+
+    def commit(self, epoch, item):
+        """Try to win ``item``'s commit marker. True when this host's
+        delivery is THE delivery; False when a peer already committed it."""
+        path = os.path.join(self._done_dir(epoch), '{:08d}'.format(item))
+
+        def create_marker():
+            try:
+                fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            except FileExistsError:
+                return False
+            os.close(fd)
+            return True
+
+        try:
+            won = self._retry.call(create_marker)
+        except OSError:
+            won = False
+        with self._lock:
+            state = self._epoch_state.get(epoch)
+            if state is not None:
+                state['done'].add(item)
+                state['inflight'].discard(item)
+                inflight = sorted(state['inflight'])
+            else:
+                inflight = None
+        if won:
+            obs.count('elastic_commits')
+            if self.monitor is not None:
+                self.monitor.on_deliver(self.host_id, (epoch, item))
+            self._append_commit(epoch, item)
+        if inflight is not None:
+            self._write_inflight(epoch, inflight)
+        return won
+
+    def _append_commit(self, epoch, item):
+        smap = self.shard_map(epoch)
+        line = json.dumps({'epoch': epoch, 'item': item,
+                           'rank': smap.rank(item),
+                           'generation': self._generation,
+                           'host': self.host_id}) + '\n'
+        try:
+            with open(self._commit_log, 'a') as f:
+                f.write(line)
+                f.flush()
+        except OSError:
+            pass    # the audit log is diagnostic; markers are the truth
+
+    def epoch_complete(self, epoch):
+        with self._lock:
+            return len(self._epoch_state[epoch]['done']) >= self.num_items
+
+    def undone_items(self, epoch):
+        """Cluster-wide uncommitted row groups (the portable checkpoint
+        cursor: any single host's snapshot covers the whole pod)."""
+        with self._lock:
+            state = self._epoch_state.get(epoch)
+            done = set(state['done']) if state is not None else set()
+        return [i for i in range(self.num_items) if i not in done]
+
+    # -- introspection -----------------------------------------------------
+
+    @property
+    def generation(self):
+        return self._generation
+
+    @property
+    def members(self):
+        return self._members
+
+    def status(self):
+        return {'host': self.host_id, 'generation': self._generation,
+                'members': list(self._members),
+                'alive': list(self._last_alive)}
+
+
+class ElasticVentilator(VentilatorBase):
+    """Drop-in for :class:`~petastorm_tpu.workers.ventilator.
+    ConcurrentVentilator` that ventilates only the row groups this host
+    owns under the coordinator's live shard map.
+
+    Same pool-facing contract: tagged ``_seq`` dispatch under a minted
+    trace, ``processed_item`` releases the in-flight budget exactly once
+    per item, ``mark_delivered`` fires on final delivery — here it also
+    tries to win the item's global commit marker, which is what feeds the
+    exactly-once scoreboard. ``upcoming_items`` peeks the claimable head
+    for the chunk prefetcher; ``set_max_queue_size`` retargets the budget
+    for the autotuner.
+    """
+
+    def __init__(self, ventilate_fn, items_to_ventilate, coordinator,
+                 iterations=1, max_ventilation_queue_size=None):
+        if iterations is not None and (not isinstance(iterations, int)
+                                       or iterations < 1):
+            raise ValueError('iterations must be a positive integer or None, '
+                             'got {!r}'.format(iterations))
+        if coordinator.num_items != len(items_to_ventilate):
+            raise ValueError('coordinator covers {} items but {} were given'
+                             .format(coordinator.num_items,
+                                     len(items_to_ventilate)))
+        self._ventilate_fn = ventilate_fn
+        self._items = list(items_to_ventilate)
+        self._coord = coordinator
+        self._iterations = iterations
+        self._max_q = (max_ventilation_queue_size
+                       if max_ventilation_queue_size is not None
+                       else max(1, len(self._items)))
+        self.trace_ns = os.urandom(4).hex()
+        self._cv = threading.Condition()
+        self._in_flight = 0
+        self._seq = 0
+        self._undelivered = OrderedDict()   # seq -> (epoch, item)
+        self._pending_peek = []
+        self._epoch_base = 0
+        self._next_epoch = 0
+        self._current_epoch = 0
+        self._epochs_remaining = iterations
+        self._stop_requested = False
+        self._completed = len(self._items) == 0
+        self._thread = None
+
+    def start(self):
+        if self._thread is not None:
+            raise RuntimeError('Ventilator already started')
+        if self._completed:
+            return
+        self._coord.start()
+        self._thread = threading.Thread(target=self._ventilate_loop,
+                                        daemon=True,
+                                        name='pstpu-elastic-ventilator')
+        self._thread.start()
+
+    def processed_item(self, seq=None):
+        with self._cv:
+            self._in_flight -= 1
+            self._cv.notify()
+
+    def mark_delivered(self, seq):
+        if seq is None:
+            return
+        with self._cv:
+            info = self._undelivered.pop(seq, None)
+        if info is not None:
+            self._coord.commit(*info)
+
+    def state_dict(self):
+        """Portable snapshot: the CLUSTER-wide uncommitted row groups of
+        the current epoch (any one host's checkpoint covers the pod) plus
+        the remaining epoch count. ``rng_state`` is None — the elastic
+        shuffle is a pure function of ``(seed, epoch)``, so there is no
+        RNG stream to carry."""
+        with self._cv:
+            epoch = self._current_epoch
+            remaining = self._epochs_remaining
+        return {'replay_indices': sorted(self._coord.undone_items(epoch)),
+                'iterations_remaining': remaining,
+                'rng_state': None}
+
+    def set_max_queue_size(self, n):
+        with self._cv:
+            self._max_q = max(1, int(n))
+            self._cv.notify_all()
+
+    def upcoming_items(self, max_items):
+        with self._cv:
+            indices = self._pending_peek[:max_items]
+        return [self._items[i] for i in indices]
+
+    def completed(self):
+        return self._completed
+
+    def reset(self):
+        """Start a fresh run of the requested iterations. Epoch numbers
+        keep advancing across resets (the scoreboard is per-epoch, so a
+        reset must not collide with already-committed epochs)."""
+        if not self._completed:
+            raise RuntimeError('Cannot reset ventilator while ventilation '
+                               'is still in progress')
+        if self._thread is not None:
+            self._thread.join()
+        self._thread = None
+        self._stop_requested = False
+        self._completed = len(self._items) == 0
+        with self._cv:
+            self._epoch_base = self._next_epoch
+            self._epochs_remaining = self._iterations
+            self._in_flight = 0
+            self._undelivered.clear()
+            self._pending_peek = []
+        self.start()
+
+    def stop(self):
+        self._stop_requested = True
+        with self._cv:
+            self._cv.notify_all()
+        if self._thread is not None and self._thread is not threading.current_thread():
+            self._thread.join()
+        self._completed = True
+        self._coord.close()
+
+    # -- the feeding loop --------------------------------------------------
+
+    def _ventilate_loop(self):
+        epochs = (itertools.count() if self._iterations is None
+                  else range(self._iterations))
+        for epoch_in_run in epochs:
+            if self._stop_requested:
+                break
+            epoch = self._epoch_base + epoch_in_run
+            with self._cv:
+                self._current_epoch = epoch
+                self._next_epoch = epoch + 1
+                self._epochs_remaining = (
+                    None if self._iterations is None
+                    else self._iterations - epoch_in_run - 1)
+            self._run_epoch(epoch)
+        self._completed = True
+
+    def _run_epoch(self, epoch):
+        coord = self._coord
+        coord.begin_epoch(epoch)
+        while not self._stop_requested:
+            coord.poll(epoch=epoch)
+            if coord.epoch_complete(epoch):
+                return
+            claimable = coord.claimable_items(epoch)
+            with self._cv:
+                self._pending_peek = list(claimable)
+            if not claimable:
+                # nothing to do locally: peers are finishing their share,
+                # or in-flight groups are pinned by live leases
+                self._stop_wait(coord.poll_s)
+                continue
+            item = claimable[0]
+            with self._cv:
+                while (self._in_flight >= self._max_q
+                       and not self._stop_requested):
+                    self._cv.wait(timeout=0.1)
+                if self._stop_requested:
+                    return
+                self._in_flight += 1
+                seq = self._seq
+                self._seq += 1
+                self._undelivered[seq] = (epoch, item)
+            if coord.is_done(epoch, item):
+                # a peer committed it while we waited on the budget
+                with self._cv:
+                    self._undelivered.pop(seq, None)
+                    self._in_flight -= 1
+                    self._cv.notify()
+                continue
+            coord.note_ventilated(epoch, item)
+            with obs.mint_trace(self.trace_ns, seq):
+                with obs.stage('ventilate', cat='ventilator'):
+                    self._ventilate_fn(**dict(self._items[item], _seq=seq))
+
+    def _stop_wait(self, seconds):
+        deadline = time.time() + seconds
+        while not self._stop_requested:
+            remaining = deadline - time.time()
+            if remaining <= 0:
+                return
+            with self._cv:
+                self._cv.wait(timeout=min(remaining, 0.1))
+
+
+__all__ = ['ElasticCoordinator', 'ElasticVentilator']

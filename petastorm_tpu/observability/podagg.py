@@ -135,10 +135,16 @@ def pod_report(source, seconds=None, straggler_ratio=DEFAULT_STRAGGLER_RATIO,
     rows = []
     for series in hosts:
         win = host_window(series, seconds)
+        newest = series['snapshots'][-1]['diag'] if series['snapshots'] else {}
         entry = {'host': series['host'], 'identity': series['identity'],
                  'snapshots': len(series['snapshots']), 'window_s': None,
                  'rows_per_s': None, 'reader_wait_fraction': None,
-                 'bottleneck': None, 'hint': None}
+                 'bottleneck': None, 'hint': None,
+                 # elastic membership view (None = host not running elastic):
+                 # a host stuck on an old generation after a reshard is the
+                 # elastic analogue of a straggler (docs/parallelism.md)
+                 'elastic_generation': newest.get('elastic_generation'),
+                 'elastic_members': newest.get('elastic_member_count')}
         if win is not None:
             rep = _report.stall_report(win)
             entry.update({'window_s': win.get('window_s'),
@@ -150,8 +156,14 @@ def pod_report(source, seconds=None, straggler_ratio=DEFAULT_STRAGGLER_RATIO,
     rates = [r['rows_per_s'] for r in rows if r['rows_per_s']]
     med_rate = round(median(rates), 2) if rates else None
     skew = round(min(rates) / max(rates), 4) if len(rates) >= 2 and max(rates) else None
+    generations = {r['host']: r['elastic_generation'] for r in rows
+                   if r['elastic_generation'] is not None}
+    elastic = None
+    if generations:
+        elastic = {'generations': generations,
+                   'agreed': len(set(generations.values())) == 1}
     out = {'hosts': rows, 'median_rows_per_s': med_rate,
-           'throughput_skew': skew, 'straggler': None}
+           'throughput_skew': skew, 'straggler': None, 'elastic': elastic}
     if med_rate:
         slow = [r for r in rows
                 if r['rows_per_s'] is not None
@@ -188,15 +200,29 @@ def format_pod_report(report):
         len(report['hosts']),
         report['median_rows_per_s'] if report['median_rows_per_s'] is not None else '?',
         report['throughput_skew'] if report['throughput_skew'] is not None else '?')]
-    lines.append('{:<16s} {:>12s} {:>8s} {:>7s}  {}'.format(
-        'host', 'rows_per_s', 'wait', 'snaps', 'bottleneck'))
+    show_elastic = bool(report.get('elastic'))
+    lines.append('{:<16s} {:>12s} {:>8s} {:>7s}{}  {}'.format(
+        'host', 'rows_per_s', 'wait', 'snaps',
+        ' {:>9s}'.format('elastic') if show_elastic else '', 'bottleneck'))
     for r in report['hosts']:
-        lines.append('{:<16s} {:>12s} {:>8s} {:>7d}  {}'.format(
+        if show_elastic:
+            gen = r.get('elastic_generation')
+            cell = (' {:>9s}'.format('g{:.0f}/{:.0f}h'.format(
+                gen, r.get('elastic_members') or 0))
+                if gen is not None else ' {:>9s}'.format('-'))
+        else:
+            cell = ''
+        lines.append('{:<16s} {:>12s} {:>8s} {:>7d}{}  {}'.format(
             r['host'],
             '{:.2f}'.format(r['rows_per_s']) if r['rows_per_s'] is not None else '-',
             '{:.1%}'.format(r['reader_wait_fraction'])
             if r['reader_wait_fraction'] is not None else '-',
-            r['snapshots'], r['bottleneck'] or '-'))
+            r['snapshots'], cell, r['bottleneck'] or '-'))
+    if show_elastic and not report['elastic']['agreed']:
+        lines.append('ELASTIC: hosts disagree on the shard-map generation {} — '
+                     'a reshard is in progress, or a host cannot reach the '
+                     'coordination directory'.format(
+                         report['elastic']['generations']))
     s = report['straggler']
     if s is None:
         lines.append('no straggler: the pod is balanced within thresholds')

@@ -141,7 +141,8 @@ def make_reader(dataset_url,
                 on_error='raise', max_item_retries=None,
                 protocol_monitor=None,
                 serve=None, serve_weight=1,
-                zero_copy=False):
+                zero_copy=False,
+                elastic=None):
     """Reader for datasets written by :func:`materialize_dataset` — rows decoded
     through the stored Unischema's codecs (reference reader.py:50-174).
 
@@ -250,7 +251,23 @@ def make_reader(dataset_url,
         dummy pools already hand over in-process arrays — the flag is a
         no-op for them. Ignored with ``serve=`` (the served blob path maps
         batches zero-copy by default, with the same lifetime tracking).
+    :param elastic: elastic pod sharding (``docs/parallelism.md``, "Elastic
+        pod sharding"): ``True`` (defaults) or an
+        :class:`~petastorm_tpu.elastic.ElasticConfig` replaces the static
+        ``cur_shard``/``shard_count`` arithmetic with a lease-based
+        membership registry and a generation-numbered shard map coordinated
+        through a shared directory (default ``<dataset>/_elastic``). Hosts
+        may join or leave MID-EPOCH: survivors adopt a departed host's
+        unfinished row groups after its lease expires, filesystem
+        ``O_EXCL`` commit markers make delivery exactly-once pod-wide, and
+        the seeded global shuffle order depends only on ``(seed, epoch)``
+        — bit-identical with or without churn. Not supported with
+        ``elastic``: ``cur_shard``/``shard_count``, ``resume_state``
+        (the pod-wide commit scoreboard IS the read position), ``serve``.
     """
+    if serve and elastic:
+        raise ValueError('elastic is not supported with serve=: the shared '
+                         'daemon owns one static stream plan (docs/serve.md)')
     if serve:
         return _make_served(dataset_url, batch_reader=False,
                             schema_fields=schema_fields, seed=seed,
@@ -319,7 +336,8 @@ def make_reader(dataset_url,
                   chunk_cache=chunk_cache,
                   chunk_cache_size_limit=chunk_cache_size_limit,
                   telemetry=telemetry,
-                  autotune=autotune)
+                  autotune=autotune,
+                  elastic=elastic)
 
 
 def _make_served(dataset_url, batch_reader, schema_fields, seed,
@@ -408,7 +426,8 @@ def make_batch_reader(dataset_url,
                       on_error='raise', max_item_retries=None,
                       protocol_monitor=None,
                       serve=None, serve_weight=1,
-                      zero_copy=False):
+                      zero_copy=False,
+                      elastic=None):
     """Columnar reader for ANY Parquet store (reference reader.py:177-289):
     yields one namedtuple of numpy column arrays per row group
     (``batched_output=True``). Schema is inferred from the Arrow schema unless
@@ -442,7 +461,13 @@ def make_batch_reader(dataset_url,
     ``zero_copy``: lifetime-tracked batch views straight out of the process
     pool's shm ring (docs/native.md) — identical semantics to
     :func:`make_reader`.
+
+    ``elastic``: lease-based elastic pod sharding with exactly-once handoff
+    (docs/parallelism.md) — identical semantics to :func:`make_reader`.
     """
+    if serve and elastic:
+        raise ValueError('elastic is not supported with serve=: the shared '
+                         'daemon owns one static stream plan (docs/serve.md)')
     if serve:
         return _make_served(dataset_url, batch_reader=True,
                             schema_fields=schema_fields, seed=seed,
@@ -487,7 +512,8 @@ def make_batch_reader(dataset_url,
                   chunk_cache=chunk_cache,
                   chunk_cache_size_limit=chunk_cache_size_limit,
                   telemetry=telemetry,
-                  autotune=autotune)
+                  autotune=autotune,
+                  elastic=elastic)
 
 
 class Reader(object):
@@ -500,7 +526,7 @@ class Reader(object):
                  num_epochs=1, cur_shard=None, shard_count=None, cache=None,
                  transform_spec=None, ngram=None, columnar_ngram=False, resume_state=None,
                  storage_retry_policy=None, chunk_cache=None, chunk_cache_size_limit=None,
-                 telemetry=None, autotune=None):
+                 telemetry=None, autotune=None, elastic=None):
         if (cur_shard is None) != (shard_count is None):
             raise ValueError('cur_shard and shard_count must be specified together')
         if cur_shard is not None and not 0 <= cur_shard < shard_count:
@@ -508,6 +534,17 @@ class Reader(object):
                 cur_shard, shard_count))
         if shuffle_row_drop_partitions < 1:
             raise ValueError('shuffle_row_drop_partitions must be >= 1')
+        if elastic:
+            if cur_shard is not None or shard_count is not None:
+                raise ValueError(
+                    'elastic replaces static sharding: every host opens the FULL '
+                    'piece list and the generation shard map partitions it — pass '
+                    'neither cur_shard nor shard_count (docs/parallelism.md)')
+            if resume_state is not None:
+                raise ValueError(
+                    'resume_state is not supported with elastic=: the pod-wide '
+                    'commit scoreboard in the coordination directory IS the read '
+                    'position — restarted hosts rejoin and skip committed groups')
 
         # telemetry: apply the requested level process-wide (None keeps the
         # current configuration) and remember the effective config so worker
@@ -551,12 +588,22 @@ class Reader(object):
             pieces = self._apply_rowgroup_selector(dataset_url, pieces, rowgroup_selector,
                                                    storage_retry_policy)
         pieces, worker_predicate = self._apply_predicate_to_pieces(pieces, predicate)
-        pieces = self._partition_pieces(pieces, cur_shard, shard_count)
+        # the pre-shard enumeration is identical on every host (selector and
+        # predicate run before sharding), which is what makes checkpoints
+        # portable across shard counts: the v2 resume cursor is expressed in
+        # these GLOBAL piece indices (state_dict / merge_resume_states)
+        self._num_global_pieces = len(pieces)
+        self._global_piece_indices = self._shard_piece_indices(
+            len(pieces), cur_shard, shard_count)
+        pieces = [pieces[i] for i in self._global_piece_indices]
         if not pieces:
             raise NoDataAvailableError(
                 'No row groups selected for reading (dataset={}, shard {}/{}). Check predicate/'
                 'selector, or reduce shard_count.'.format(dataset_url, cur_shard, shard_count))
         self._pieces = pieces
+        self._cur_shard = cur_shard
+        self._shard_count = shard_count
+        self._shuffle_row_drop_partitions = shuffle_row_drop_partitions
 
         # (5) ventilator + pool — the item list is the same plan the serve
         # broker builds per stream (serve/plan.py)
@@ -564,14 +611,34 @@ class Reader(object):
         from petastorm_tpu.workers.ventilator import ConcurrentVentilator
         items = build_work_items(len(pieces), shuffle_row_drop_partitions,
                                  worker_predicate)
+        ventilator_resume = None
         if resume_state is not None:
-            self._validate_resume_state(resume_state, dataset_url, len(pieces), len(items))
+            ventilator_resume = self._resolve_resume_state(
+                resume_state, dataset_url, len(pieces), len(items),
+                shuffle_row_drop_partitions)
         self._num_items = len(items)
-        self._ventilator = ConcurrentVentilator(
-            pool.ventilate, items, iterations=num_epochs,
-            max_ventilation_queue_size=pool.workers_count + _VENTILATE_EXTRA_ROWGROUPS,
-            randomize_item_order=shuffle_row_groups, random_seed=seed, tag_items=True,
-            resume_state=resume_state['ventilator'] if resume_state is not None else None)
+        self._elastic_coordinator = None
+        if elastic:
+            # imports stay inside the branch: a plain reader must not even
+            # load the elastic package (tier-1 guards this structurally)
+            from petastorm_tpu.elastic import resolve_elastic
+            from petastorm_tpu.elastic.coordinator import (ElasticCoordinator,
+                                                           ElasticVentilator)
+            elastic_config = resolve_elastic(elastic,
+                                             dataset_path=self._dataset_path)
+            self._elastic_coordinator = ElasticCoordinator(
+                elastic_config, num_items=len(items), seed=seed,
+                shuffle=shuffle_row_groups)
+            self._ventilator = ElasticVentilator(
+                pool.ventilate, items, self._elastic_coordinator,
+                iterations=num_epochs,
+                max_ventilation_queue_size=pool.workers_count + _VENTILATE_EXTRA_ROWGROUPS)
+        else:
+            self._ventilator = ConcurrentVentilator(
+                pool.ventilate, items, iterations=num_epochs,
+                max_ventilation_queue_size=pool.workers_count + _VENTILATE_EXTRA_ROWGROUPS,
+                randomize_item_order=shuffle_row_groups, random_seed=seed, tag_items=True,
+                resume_state=ventilator_resume)
 
         worker_args = {
             'dataset_path': self._dataset_path,
@@ -655,11 +722,18 @@ class Reader(object):
         return [p for i, p in enumerate(pieces) if i in selected]
 
     @staticmethod
+    def _shard_piece_indices(num_pieces, cur_shard, shard_count):
+        """Global indices of the pieces a round-robin shard keeps
+        (reference reader.py:485-502). ``cur_shard=None`` keeps everything."""
+        if cur_shard is None:
+            return list(range(num_pieces))
+        return [i for i in range(num_pieces) if i % shard_count == cur_shard]
+
+    @staticmethod
     def _partition_pieces(pieces, cur_shard, shard_count):
         """Round-robin shard assignment (reference reader.py:485-502)."""
-        if cur_shard is None:
-            return pieces
-        return [p for i, p in enumerate(pieces) if i % shard_count == cur_shard]
+        keep = Reader._shard_piece_indices(len(pieces), cur_shard, shard_count)
+        return [pieces[i] for i in keep]
 
     # -- iteration ----------------------------------------------------------
 
@@ -681,22 +755,51 @@ class Reader(object):
 
     # -- checkpoint / resume ------------------------------------------------
 
-    @staticmethod
-    def _validate_resume_state(state, dataset_url, num_pieces, num_items):
-        if not isinstance(state, dict) or state.get('version') != 1:
+    def _resolve_resume_state(self, state, dataset_url, num_pieces, num_items,
+                              shuffle_row_drop_partitions):
+        """Validate ``resume_state`` and produce the ventilator sub-state.
+
+        Three paths: a state taken over the SAME piece/item selection resumes
+        exactly (v1 semantics — replay order and RNG state preserved); a v2
+        state over the same GLOBAL piece universe but different shard
+        arithmetic resumes portably (the global row-group cursor is remapped
+        onto this shard's local items — the N-hosts-checkpoint,
+        M-hosts-restore path, usually via :func:`merge_resume_states`);
+        anything else is rejected."""
+        if not isinstance(state, dict) or state.get('version') not in (1, 2):
             raise ValueError('Unrecognized resume_state (expected a dict produced by '
                              'Reader.state_dict())')
-        if state.get('num_pieces') != num_pieces or state.get('num_items') != num_items:
-            raise ValueError(
-                'resume_state does not match this reader: it was taken over {} pieces / {} work '
-                'items, but this reader selected {} / {}. Construct the resumed reader with the '
-                'same arguments (dataset, predicate, selector, sharding, '
-                'shuffle_row_drop_partitions) as the checkpointed one.'.format(
-                    state.get('num_pieces'), state.get('num_items'), num_pieces, num_items))
-        if state.get('dataset_url') != dataset_url:
+        if state.get('dataset_url') not in (None, dataset_url):
             warnings.warn('resume_state was taken from {} but this reader opens {}; resuming '
                           'anyway since piece counts match (dataset may have moved)'.format(
                               state.get('dataset_url'), dataset_url))
+        if state.get('num_pieces') == num_pieces and state.get('num_items') == num_items:
+            return state['ventilator']
+        sdp = shuffle_row_drop_partitions
+        if (state.get('version') == 2
+                and state.get('num_global_pieces') == self._num_global_pieces
+                and state.get('shuffle_row_drop_partitions') == sdp):
+            # portable path: same dataset-wide selection, different shard
+            # count. Keep the global (piece, drop-part) cells that land on
+            # this shard; row-group granularity is preserved, the per-host
+            # shuffle RNG is not (it described a different item list), so
+            # remaining epochs reshuffle from the constructor seed.
+            local_of = {g: lp for lp, g in enumerate(self._global_piece_indices)}
+            replay = sorted(local_of[g] * sdp + part
+                            for g, part in state.get('remaining_global_parts', ())
+                            if g in local_of)
+            return {'replay_indices': replay,
+                    'iterations_remaining': state.get('iterations_remaining'),
+                    'rng_state': None}
+        raise ValueError(
+            'resume_state does not match this reader: it was taken over {} pieces / {} work '
+            'items ({} dataset-wide), but this reader selected {} / {} ({} dataset-wide). '
+            'Construct the resumed reader with the same arguments (dataset, predicate, '
+            'selector, shuffle_row_drop_partitions) as the checkpointed one; only the '
+            'cur_shard/shard_count split may differ for v2 states.'.format(
+                state.get('num_pieces'), state.get('num_items'),
+                state.get('num_global_pieces'), num_pieces, num_items,
+                self._num_global_pieces))
 
     def state_dict(self):
         """Snapshot the read position (picklable dict). Pass it as
@@ -710,13 +813,28 @@ class Reader(object):
         re-read in full on resume. At an epoch boundary the resume is exact.
         Remaining epochs re-shuffle from the checkpointed RNG state, so seeded
         runs produce the same row-group order they would have without the
-        interruption."""
+        interruption.
+
+        Version-2 states additionally carry the cursor in GLOBAL piece
+        indices (``remaining_global_parts``), making them portable across
+        shard counts: checkpoint on N hosts, :func:`merge_resume_states` the
+        N dicts, restore on M hosts — every unfinished row group lands on
+        exactly one new shard."""
+        vent = self._ventilator.state_dict()
+        sdp = self._shuffle_row_drop_partitions
+        remaining = sorted({(int(self._global_piece_indices[i // sdp]), int(i % sdp))
+                            for i in vent['replay_indices']})
         return {
-            'version': 1,
+            'version': 2,
             'dataset_url': self._dataset_url,
             'num_pieces': len(self._pieces),
             'num_items': self._num_items,
-            'ventilator': self._ventilator.state_dict(),
+            'ventilator': vent,
+            'num_global_pieces': self._num_global_pieces,
+            'shard': [self._cur_shard, self._shard_count],
+            'shuffle_row_drop_partitions': sdp,
+            'remaining_global_parts': [list(cell) for cell in remaining],
+            'iterations_remaining': vent['iterations_remaining'],
         }
 
     def reset(self):
@@ -741,6 +859,14 @@ class Reader(object):
         if self._chunk_prefetcher is not None:
             self._chunk_prefetcher.join()
         self._pool.join()
+
+    @property
+    def elastic_coordinator(self):
+        """The :class:`~petastorm_tpu.elastic.coordinator.ElasticCoordinator`
+        when this reader runs elastically, else None. Its ``status()`` dict
+        (host, generation, members, alive) backs ``petastorm-tpu-diagnose
+        --pod`` membership rows."""
+        return self._elastic_coordinator
 
     @property
     def quarantined_items(self):
@@ -789,3 +915,66 @@ class Reader(object):
         if not self._stopped:
             self.stop()
             self.join()
+
+
+def merge_resume_states(states):
+    """Union per-host checkpoint dicts into ONE portable ``resume_state``.
+
+    Checkpoint a pod by calling :meth:`Reader.state_dict` on every host,
+    merge the dicts here, and pass the result as ``resume_state=`` to
+    readers constructed with ANY shard count (including 1): the merged
+    state carries the pod-wide set of unfinished row groups in global piece
+    indices, and each restoring shard replays exactly the cells that land
+    on it — no row group is dropped or read twice across the new pod.
+
+    All states must come from readers over the same dataset-wide selection
+    (same dataset, predicate, selector, ``shuffle_row_drop_partitions``) —
+    pass EVERY host's state, or the missing host's unfinished groups are
+    silently treated as delivered. Per-host shuffle RNG state is not
+    portable across item lists, so remaining epochs reshuffle from the
+    restoring readers' ``seed``.
+    """
+    states = list(states)
+    if not states:
+        raise ValueError('merge_resume_states needs at least one state')
+    base = None
+    cells = set()
+    iterations = ()
+    for state in states:
+        if not isinstance(state, dict) or state.get('version') != 2:
+            raise ValueError('merge_resume_states needs version-2 dicts from '
+                             'Reader.state_dict(); got {!r}'.format(
+                                 state.get('version') if isinstance(state, dict)
+                                 else type(state).__name__))
+        if base is None:
+            base = state
+        if (state.get('num_global_pieces') != base.get('num_global_pieces')
+                or state.get('shuffle_row_drop_partitions')
+                != base.get('shuffle_row_drop_partitions')):
+            raise ValueError(
+                'resume states disagree on the dataset-wide selection '
+                '({} pieces x {} drop parts vs {} x {}): they were not taken '
+                'over the same dataset/predicate/selector'.format(
+                    base.get('num_global_pieces'),
+                    base.get('shuffle_row_drop_partitions'),
+                    state.get('num_global_pieces'),
+                    state.get('shuffle_row_drop_partitions')))
+        cells.update((int(g), int(part))
+                     for g, part in state.get('remaining_global_parts', ()))
+        iterations += (state.get('iterations_remaining'),)
+    finite = [it for it in iterations if it is not None]
+    return {
+        'version': 2,
+        'dataset_url': base.get('dataset_url'),
+        # None sentinels: a merged state can never take the exact-resume
+        # path — it always remaps through the portable global cursor
+        'num_pieces': None,
+        'num_items': None,
+        'ventilator': None,
+        'num_global_pieces': base.get('num_global_pieces'),
+        'shard': None,
+        'shuffle_row_drop_partitions': base.get('shuffle_row_drop_partitions'),
+        'remaining_global_parts': [list(cell) for cell in sorted(cells)],
+        'iterations_remaining': (None if len(finite) < len(iterations)
+                                 else min(finite)),
+    }

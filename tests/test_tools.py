@@ -349,3 +349,59 @@ def test_bench_serve_smoke(tmp_path, capsys):
     assert h['pool_zero_copy_rate'] > 0
     assert h['zero_copy_ratio'] is not None
     assert isinstance(h['meets_bar'], bool)
+
+
+# ---------------------------------------------------------------------------
+# elastic: modelcheck --elastic exit-code contract + bench_pod --chaos smoke
+# ---------------------------------------------------------------------------
+
+def test_modelcheck_elastic_cli_exit_code_contract():
+    """The --elastic lane honors the same exit-code contract as the worker
+    and serve lanes: 0 exhausted-clean, 1 counterexample, 2 usage error,
+    3 below the declared canonical-state floor."""
+    import subprocess
+    base = [sys.executable, '-m', 'petastorm_tpu.analysis.protocol.modelcheck']
+    clean = subprocess.run(base + ['--elastic', '--budget-s', '300'],
+                           capture_output=True, text=True, timeout=420)
+    assert clean.returncode == 0, clean.stdout + clean.stderr
+    assert 'exhausted: all invariants hold' in clean.stdout
+
+    bad = subprocess.run(base + ['--elastic', '--mutate', 'skip_done_check',
+                                 '--budget-s', '300'],
+                         capture_output=True, text=True, timeout=420)
+    assert bad.returncode == 1, bad.stdout + bad.stderr
+    assert 'counterexample' in bad.stdout
+
+    floor = subprocess.run(base + ['--elastic', '--min-states', '99999999',
+                                   '--budget-s', '300'],
+                           capture_output=True, text=True, timeout=420)
+    assert floor.returncode == 3
+    assert 'below the declared floor' in floor.stderr
+
+    usage = subprocess.run(base + ['--serve', '--elastic'],
+                           capture_output=True, text=True, timeout=120)
+    assert usage.returncode == 2
+    assert 'mutually exclusive' in usage.stderr
+
+
+def test_bench_pod_chaos_smoke():
+    """bench_pod --chaos end to end: a small pod of real host subprocesses
+    with a SIGKILL + join must finish with full exactly-once coverage and
+    exit 0 — the pod_chaos metric line is the machine-readable verdict."""
+    import subprocess
+    out = subprocess.run(
+        [sys.executable, os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), 'bench_pod.py'),
+         '--chaos', '--rows', '512', '--chaos-kill-after', '2'],
+        capture_output=True, text=True, timeout=420,
+        env=dict(os.environ, JAX_PLATFORMS='cpu'))
+    assert out.returncode == 0, out.stdout + out.stderr
+    recs = [json.loads(line) for line in out.stdout.splitlines()
+            if line.startswith('{')]
+    chaos = [r for r in recs if r.get('metric') == 'pod_chaos']
+    assert len(chaos) == 1
+    rec = chaos[0]
+    assert rec['double_committed'] == 0
+    assert rec['committed'] == 512 // 64
+    assert rec['killed'] and rec['joined']
+    assert rec['survivor_exit_codes_ok'] is True
