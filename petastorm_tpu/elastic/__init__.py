@@ -2,8 +2,11 @@
 
 ``make_reader(elastic=True)`` (or an explicit :class:`ElasticConfig`)
 replaces static ``cur_shard``/``shard_count`` arithmetic with a lease-based
-membership registry, a generation-numbered shard map, and an exactly-once
-resharding protocol, all coordinated through a shared filesystem directory
+membership registry, a generation-numbered shard map, and a resharding
+protocol with exactly-once commits (sample delivery is at-least-once only
+in the false-expiry window bounded by ``lease_s`` —
+``docs/parallelism.md``), all coordinated through a shared filesystem
+directory
 — no coordinator process, no network channel (``docs/parallelism.md``,
 "Elastic pod sharding").
 
@@ -51,7 +54,10 @@ class ElasticConfig(object):
     :param host_id: this host's stable identity; ``None`` derives it from
         ``jax.process_index()`` (falling back to machine+pid)
     :param lease_s: membership lease duration — the worst-case time a dead
-        host pins its in-flight row groups
+        host pins its in-flight row groups, AND the bound on duplicate
+        sample delivery after a false expiry (a host stalled longer than
+        ``lease_s`` but still running may have its in-flight row groups
+        adopted while it is still delivering them; commits stay exclusive)
     :param poll_s: membership/scoreboard scan period (default ``lease_s/4``)
     :param monitor: an :class:`~petastorm_tpu.analysis.protocol.monitor.
         ElasticMonitor` (or ``None`` to resolve from ``PSTPU_ELASTIC_MONITOR``)

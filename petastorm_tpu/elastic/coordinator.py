@@ -10,7 +10,11 @@ The coordinator owns one host's view of the shared coordination directory:
 * ``epochs/NNNNNN/done/NNNNNNNN`` — the per-epoch scoreboard. A row group
   is **committed** when its marker file exists; markers are created with
   ``O_EXCL``, so exactly one host wins each commit no matter how racy the
-  handoff was — this is what makes delivery exactly-once by construction.
+  handoff was — the COMMIT is exactly-once by construction. Sample
+  delivery is at-least-once in one narrow window: a host stalled past
+  ``lease_s`` (GC pause, fs hiccup) but still running may have its
+  in-flight groups adopted and both hosts then yield those rows; only one
+  wins the marker, and ``lease_s`` bounds the duplicate exposure.
 * ``epochs/NNNNNN/inflight/<host>.json`` — each host's claimed-but-not-yet
   -committed row groups. A *live* host's in-flight items are never claimed
   by anyone else; a dead host's (lease expired or lease file gone) become
@@ -35,6 +39,7 @@ from __future__ import annotations
 import errno
 import itertools
 import json
+import logging
 import os
 import threading
 import time
@@ -44,6 +49,8 @@ from petastorm_tpu import observability as obs
 from petastorm_tpu.elastic.membership import MembershipRegistry
 from petastorm_tpu.elastic.shardmap import ShardMap
 from petastorm_tpu.workers.ventilator import VentilatorBase
+
+logger = logging.getLogger(__name__)
 
 
 def _atomic_write(path, payload, retry):
@@ -130,27 +137,54 @@ class ElasticCoordinator(object):
             raise
         numbers = sorted(int(n.split('.')[0]) for n in names
                          if n.endswith('.json') and n.split('.')[0].isdigit())
-        if not numbers:
-            return 0, ()
-        generation = numbers[-1]
-        data = self._retry.call(self._read_json, self._gen_path(generation))
-        return generation, tuple(data.get('members') or ())
+        for generation in reversed(numbers):
+            try:
+                data = self._retry.call(self._read_json,
+                                        self._gen_path(generation))
+            except (OSError, ValueError):
+                # a peer's publish not yet fully visible (eventual-consistency
+                # shared fs) or an I/O hiccup past the retry budget: skip it
+                # this poll — a later scan will see the complete file
+                continue
+            return generation, tuple(data.get('members') or ())
+        return self._generation, self._members
 
     def _read_json(self, path):
         with open(path, 'r') as f:
             return json.loads(f.read())
 
     def _propose_generation(self, generation, members):
-        """O_EXCL proposal: exactly one host defines each generation number;
-        losers just re-read the winner's file."""
+        """Atomic exclusive proposal: the payload is staged in a private tmp
+        file and published with ``os.link`` — link is atomic AND exclusive
+        (EEXIST when a peer won the number), so a concurrent reader sees
+        either no file or a complete one, never a partial write."""
         payload = json.dumps({'generation': generation,
                               'members': list(members),
                               'proposed_by': self.host_id})
         path = self._gen_path(generation)
+        tmp = '{}.tmp.{}'.format(path, os.getpid())
+        try:
+            with open(tmp, 'w') as f:
+                f.write(payload)
+            try:
+                os.link(tmp, path)
+                return True
+            except OSError as e:
+                if getattr(e, 'errno', None) not in (errno.EPERM, errno.ENOSYS,
+                                                     errno.EOPNOTSUPP):
+                    return False
+        except OSError:
+            return False
+        finally:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+        # hard links unsupported (some FUSE object-store mounts): fall back to
+        # O_EXCL + write — not atomic, but readers skip a torn file and pick
+        # it up complete on a later poll
         try:
             fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
-        except FileExistsError:
-            return False
         except OSError:
             return False
         try:
@@ -247,6 +281,12 @@ class ElasticCoordinator(object):
             state['done'] |= done
             state['deferred'] = deferred - state['done']
             state['dead_inflight'] = dead_inflight - state['done']
+            pending_commits = sorted(state['commit_retry'] - state['done'])
+        for item in pending_commits:
+            # markers that could not be created when the item was delivered
+            # (persistent fs error): the item is still ours, keep trying —
+            # commit() re-resolves won/exists/error each attempt
+            self.commit(epoch, item)
 
     # -- per-epoch scoreboard ----------------------------------------------
 
@@ -269,7 +309,8 @@ class ElasticCoordinator(object):
         with self._lock:
             self._epoch_state.setdefault(epoch, {
                 'done': set(), 'deferred': set(), 'dead_inflight': set(),
-                'ventilated': set(), 'inflight': set(), 'handed_off': set()})
+                'ventilated': set(), 'inflight': set(), 'handed_off': set(),
+                'commit_retry': set()})
         # bounded memory: forget scoreboards of long-finished epochs
         with self._lock:
             stale = sorted(self._epoch_state)[:-4]
@@ -331,31 +372,45 @@ class ElasticCoordinator(object):
         with self._lock:
             return item in self._epoch_state[epoch]['done']
 
-    def commit(self, epoch, item):
-        """Try to win ``item``'s commit marker. True when this host's
-        delivery is THE delivery; False when a peer already committed it."""
+    def _create_marker(self, epoch, item):
+        """Try to create ``item``'s O_EXCL marker: ``'won'`` (this host's
+        marker), ``'exists'`` (a peer's), or ``'error'`` (the marker is
+        verifiably NOT on disk — the item must stay uncommitted)."""
         path = os.path.join(self._done_dir(epoch), '{:08d}'.format(item))
 
         def create_marker():
             try:
                 fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
             except FileExistsError:
-                return False
+                return 'exists'
             os.close(fd)
-            return True
+            return 'won'
 
         try:
-            won = self._retry.call(create_marker)
+            return self._retry.call(create_marker)
         except OSError:
-            won = False
+            return 'error'
+
+    def commit(self, epoch, item):
+        """Try to win ``item``'s commit marker. True when this host's
+        delivery is THE delivery; False when a peer already committed it —
+        or when the marker could not be created at all (then the item stays
+        uncommitted locally and the marker is retried on later polls:
+        counting it done with no marker on disk would let this host finish
+        an epoch its peers can never see complete)."""
+        outcome = self._create_marker(epoch, item)
         with self._lock:
             state = self._epoch_state.get(epoch)
+            inflight = None
             if state is not None:
-                state['done'].add(item)
-                state['inflight'].discard(item)
-                inflight = sorted(state['inflight'])
-            else:
-                inflight = None
+                if outcome == 'error':
+                    state['commit_retry'].add(item)
+                else:
+                    state['done'].add(item)
+                    state['inflight'].discard(item)
+                    state['commit_retry'].discard(item)
+                    inflight = sorted(state['inflight'])
+        won = outcome == 'won'
         if won:
             obs.count('elastic_commits')
             if self.monitor is not None:
@@ -415,7 +470,10 @@ class ElasticVentilator(VentilatorBase):
     trace, ``processed_item`` releases the in-flight budget exactly once
     per item, ``mark_delivered`` fires on final delivery — here it also
     tries to win the item's global commit marker, which is what feeds the
-    exactly-once scoreboard. ``upcoming_items`` peeks the claimable head
+    exactly-once commit scoreboard (the commit happens AFTER the rows were
+    yielded, so a lost race after a false lease expiry means the rows went
+    out twice pod-wide — see the module docstring; ``lease_s`` bounds
+    that window). ``upcoming_items`` peeks the claimable head
     for the chunk prefetcher; ``set_max_queue_size`` retargets the budget
     for the autotuner.
     """
@@ -533,20 +591,29 @@ class ElasticVentilator(VentilatorBase):
     # -- the feeding loop --------------------------------------------------
 
     def _ventilate_loop(self):
-        epochs = (itertools.count() if self._iterations is None
-                  else range(self._iterations))
-        for epoch_in_run in epochs:
-            if self._stop_requested:
-                break
-            epoch = self._epoch_base + epoch_in_run
-            with self._cv:
-                self._current_epoch = epoch
-                self._next_epoch = epoch + 1
-                self._epochs_remaining = (
-                    None if self._iterations is None
-                    else self._iterations - epoch_in_run - 1)
-            self._run_epoch(epoch)
-        self._completed = True
+        try:
+            epochs = (itertools.count() if self._iterations is None
+                      else range(self._iterations))
+            for epoch_in_run in epochs:
+                if self._stop_requested:
+                    break
+                epoch = self._epoch_base + epoch_in_run
+                with self._cv:
+                    self._current_epoch = epoch
+                    self._next_epoch = epoch + 1
+                    self._epochs_remaining = (
+                        None if self._iterations is None
+                        else self._iterations - epoch_in_run - 1)
+                self._run_epoch(epoch)
+        except Exception:   # noqa: BLE001 — a dead feed thread must not
+            # leave consumers blocked forever on a queue that will never
+            # fill: mark the ventilation complete so the reader drains and
+            # stops, and leave the root cause in the log
+            logger.exception('elastic ventilator feed thread died; '
+                             'marking ventilation complete')
+            obs.count('elastic_ventilator_errors')
+        finally:
+            self._completed = True
 
     def _run_epoch(self, epoch):
         coord = self._coord

@@ -5,11 +5,15 @@ host id, pid, lease duration, renewal timestamp) that a background
 heartbeat thread renews atomically (tmp + ``os.rename``).  Liveness is
 judged the same way the serve daemon's handshake does:
 
-* a **fresh lease** (renewed within ``lease_s``) is alive;
-* an **expired lease** is dead — unless the holder's pid is provably
-  alive on this machine, which only matters for same-host testing; a
-  provably *dead* pid (``os.kill(pid, 0)`` raising, or a zombie in
-  ``/proc``) shortcuts the wait and marks the host dead immediately;
+* a **fresh lease** (renewed within ``lease_s``) is alive — unless its
+  holder's pid is provably *dead* on this machine (``os.kill(pid, 0)``
+  raising, or a zombie in ``/proc``), which shortcuts the wait and marks
+  the host dead immediately;
+* an **expired lease** is dead. Pid liveness only ever SHORTENS a lease,
+  never extends it: a stale lease is dead even when its same-machine pid
+  is still running — a host stalled past ``lease_s`` is treated as
+  departed, per the documented false-expiry window
+  (``docs/parallelism.md``);
 * a **missing lease** means the host left gracefully (``leave()``
   unlinks it) or never joined.
 
@@ -189,8 +193,9 @@ class MembershipRegistry(object):
     def scan(self, now=None):
         """Decode every lease file into a list of :class:`MemberInfo`.
 
-        Liveness per lease: fresh => alive; stale + pid provably dead on
-        this machine => dead now; stale otherwise => dead (expired). A
+        Liveness per lease: fresh + same-machine pid provably dead =>
+        dead now (the crash shortcut); fresh otherwise => alive; stale =>
+        dead (expired) regardless of pid liveness. A
         lease that cannot be read past the retry budget is reported alive
         and unexpired — I/O trouble must never masquerade as a departure.
         """

@@ -259,7 +259,10 @@ def make_reader(dataset_url,
         through a shared directory (default ``<dataset>/_elastic``). Hosts
         may join or leave MID-EPOCH: survivors adopt a departed host's
         unfinished row groups after its lease expires, filesystem
-        ``O_EXCL`` commit markers make delivery exactly-once pod-wide, and
+        ``O_EXCL`` commit markers make the COMMIT exactly-once pod-wide
+        (sample delivery is at-least-once only in the false-expiry window:
+        a host stalled past ``lease_s`` but still running may deliver rows
+        its adopter also delivers — ``lease_s`` bounds that exposure), and
         the seeded global shuffle order depends only on ``(seed, epoch)``
         — bit-identical with or without churn. Not supported with
         ``elastic``: ``cur_shard``/``shard_count``, ``resume_state``
@@ -462,8 +465,9 @@ def make_batch_reader(dataset_url,
     pool's shm ring (docs/native.md) — identical semantics to
     :func:`make_reader`.
 
-    ``elastic``: lease-based elastic pod sharding with exactly-once handoff
-    (docs/parallelism.md) — identical semantics to :func:`make_reader`.
+    ``elastic``: lease-based elastic pod sharding with exactly-once commit
+    handoff (docs/parallelism.md) — identical semantics to
+    :func:`make_reader`.
     """
     if serve and elastic:
         raise ValueError('elastic is not supported with serve=: the shared '
@@ -759,8 +763,10 @@ class Reader(object):
                               shuffle_row_drop_partitions):
         """Validate ``resume_state`` and produce the ventilator sub-state.
 
-        Three paths: a state taken over the SAME piece/item selection resumes
-        exactly (v1 semantics — replay order and RNG state preserved); a v2
+        Three paths: a state taken over the SAME piece/item selection AND the
+        same ``cur_shard``/``shard_count`` (v2 states record the taking
+        shard; v1 states predate the field and are trusted) resumes exactly
+        (v1 semantics — replay order and RNG state preserved); a v2
         state over the same GLOBAL piece universe but different shard
         arithmetic resumes portably (the global row-group cursor is remapped
         onto this shard's local items — the N-hosts-checkpoint,
@@ -773,7 +779,11 @@ class Reader(object):
             warnings.warn('resume_state was taken from {} but this reader opens {}; resuming '
                           'anyway since piece counts match (dataset may have moved)'.format(
                               state.get('dataset_url'), dataset_url))
-        if state.get('num_pieces') == num_pieces and state.get('num_items') == num_items:
+        ckpt_shard = state.get('shard')
+        shard_matches = (ckpt_shard is None
+                         or list(ckpt_shard) == [self._cur_shard, self._shard_count])
+        if state.get('num_pieces') == num_pieces and state.get('num_items') == num_items \
+                and shard_matches:
             return state['ventilator']
         sdp = shuffle_row_drop_partitions
         if (state.get('version') == 2
@@ -791,6 +801,13 @@ class Reader(object):
             return {'replay_indices': replay,
                     'iterations_remaining': state.get('iterations_remaining'),
                     'rng_state': None}
+        if not shard_matches:
+            raise ValueError(
+                'resume_state was taken on shard {}/{} but this reader is shard {}/{}, and '
+                'the state carries no matching portable cursor to remap — an exact resume '
+                'would replay the other shard\'s positions. Restore each state onto its own '
+                'shard, or merge all hosts\' states with merge_resume_states.'.format(
+                    ckpt_shard[0], ckpt_shard[1], self._cur_shard, self._shard_count))
         raise ValueError(
             'resume_state does not match this reader: it was taken over {} pieces / {} work '
             'items ({} dataset-wide), but this reader selected {} / {} ({} dataset-wide). '

@@ -540,6 +540,34 @@ def test_pod_wide_shards_are_disjoint_after_resume(synthetic_dataset):
                 'hosts {} and {} delivered overlapping rows'.format(a, b)
 
 
+def test_resume_state_on_wrong_shard_remaps_instead_of_exact_replay(synthetic_dataset):
+    """A v2 checkpoint records the shard that took it. Restoring it onto a
+    DIFFERENT shard of the same layout must not silently take the exact
+    path — that would replay the checkpointing shard's local positions as
+    this shard's (row groups double-read on one shard and dropped on
+    another). It falls through to the portable global-cursor remap, which
+    replays only the cells that actually belong to the restoring shard."""
+    url = synthetic_dataset.url
+    reader = make_reader(url, schema_fields=['id'], reader_pool_type='dummy',
+                         seed=9, cur_shard=0, shard_count=2)
+    _read_ids(reader, limit=18)
+    state = pickle.loads(pickle.dumps(reader.state_dict()))
+    reader.stop(); reader.join()
+    assert state['shard'] == [0, 2]
+    assert state['remaining_global_parts'], 'checkpoint must be mid-epoch'
+
+    resumed = make_reader(url, schema_fields=['id'], reader_pool_type='dummy',
+                          seed=9, cur_shard=1, shard_count=2,
+                          resume_state=state)
+    rest = _read_ids(resumed)
+    resumed.stop(); resumed.join()
+    # every group shard 0 had left is a shard-0 group; none land on shard 1,
+    # so the remap yields nothing to replay — the exact path would instead
+    # have replayed shard-0 POSITIONS as shard-1 groups
+    assert rest == [], ('restoring a shard-0 checkpoint onto shard 1 replayed '
+                        'the wrong shard\'s positions: {!r}'.format(rest[:10]))
+
+
 def test_portable_resume_across_shard_counts(synthetic_dataset):
     """Satellite contract for elastic pods: checkpoint a 2-shard pod
     mid-epoch, merge the per-host states with merge_resume_states, and

@@ -229,6 +229,87 @@ def test_commit_markers_are_exactly_once(tmp_path):
         a.close(); b.close()
 
 
+def test_torn_generation_file_is_skipped_not_fatal(tmp_path):
+    """A half-visible peer publish (torn write on an eventually-consistent
+    shared fs) must not kill the poll: the torn file is skipped this scan
+    and picked up once complete — json garbage used to escape poll() and
+    take down the whole feed thread."""
+    coord = _make_coordinator(tmp_path)
+    coord.start()
+    try:
+        assert coord.generation == 1
+        torn = os.path.join(str(tmp_path), 'generations', '00000005.json')
+        with open(torn, 'w') as f:
+            f.write('{"generation":')     # truncated mid-write
+        coord.poll(force=True)            # must not raise
+        assert coord.generation == 1
+        with open(torn, 'w') as f:        # the write completes
+            json.dump({'generation': 5, 'members': ['h0'],
+                       'proposed_by': 'peer'}, f)
+        coord.poll(force=True)
+        assert coord.generation == 5
+        # own proposals are published atomically: every file parses, no
+        # staging files linger
+        gen_dir = os.path.join(str(tmp_path), 'generations')
+        assert all(n.endswith('.json') for n in os.listdir(gen_dir))
+        for name in os.listdir(gen_dir):
+            with open(os.path.join(gen_dir, name)) as f:
+                json.load(f)
+    finally:
+        coord.close()
+
+
+def test_feed_thread_crash_marks_ventilation_complete(tmp_path):
+    """An unexpected exception on the feed thread must mark the ventilator
+    completed (consumers drain and stop) instead of hanging every consumer
+    on a queue that will never fill."""
+    from petastorm_tpu.elastic.coordinator import ElasticVentilator
+    coord = _make_coordinator(tmp_path, num_items=2)
+
+    def boom(epoch):
+        raise RuntimeError('injected feed-thread crash')
+
+    coord.begin_epoch = boom
+    vent = ElasticVentilator(lambda **kw: None,
+                             [{'piece_index': i} for i in range(2)], coord)
+    vent.start()
+    deadline = time.time() + 30
+    while not vent.completed() and time.time() < deadline:
+        time.sleep(0.01)
+    assert vent.completed(), 'feed-thread death left the ventilator hanging'
+    vent.stop()
+
+
+def test_persistent_marker_failure_keeps_item_uncommitted(tmp_path):
+    """A commit whose O_EXCL marker could not be created (fs error past the
+    retry budget) must NOT count the item done locally: no marker on disk
+    means peers could never see the epoch complete. The item stays
+    uncommitted and the marker is retried from the poll loop."""
+    from petastorm_tpu import faults
+    coord = _make_coordinator(tmp_path, num_items=2)
+    coord.start()
+    try:
+        coord.begin_epoch(0)
+        coord.note_ventilated(0, 1)
+        faults.install(faults.FaultPlan(storage_fail_first=10))
+        try:
+            assert coord.commit(0, 1) is False
+        finally:
+            faults.uninstall()
+        done_dir = os.path.join(str(tmp_path), 'epochs', '000000', 'done')
+        assert os.listdir(done_dir) == []
+        assert not coord.is_done(0, 1)
+        assert 1 in coord.undone_items(0)       # still checkpoint-visible
+        assert not coord.epoch_complete(0)
+        # the next poll retries the marker and wins it durably
+        coord.poll(epoch=0, force=True)
+        assert coord.is_done(0, 1)
+        assert os.listdir(done_dir) == ['00000001']
+        assert 1 not in coord.undone_items(0)
+    finally:
+        coord.close()
+
+
 def test_generation_advances_monotonically_on_churn(tmp_path):
     coord = _make_coordinator(tmp_path)
     coord.start()
