@@ -54,12 +54,22 @@ ONCHIP_PATH = os.path.join(REPO_ROOT, 'BENCH_ONCHIP.json')
 # store would otherwise benchmark an older format forever)
 DATASET_FORMAT_STAMP = 'v2-percolumn-compression'
 
+#: ``--compression-sweep`` codecs: every codec the fused kernel decompresses
+#: first-party must ride the SAME hello-world-shaped capture, so the per-codec
+#: numbers are comparable and a codec that silently fell back to Arrow shows
+#: up as a nonzero ``fallback_compression`` counter, not a plausible-looking
+#: slow rate
+SWEEP_CODECS = ('snappy', 'zstd', 'lz4', 'none')
+SWEEP_ROWS = 256
+SWEEP_ROWS_PER_GROUP = 64
+
 #: wall-clock budget for the duty sweep subprocess; points stream as they
 #: complete, so a deadline hit still records every finished point
 DUTY_SWEEP_TIMEOUT_S = int(os.environ.get('PSTPU_BENCH_DUTY_TIMEOUT', '2400'))
 
 
-def _build_dataset(url):
+def _build_dataset(url, compression='snappy', num_rows=NUM_ROWS,
+                   rows_per_row_group=100):
     import numpy as np
 
     from petastorm_tpu.codecs import CompressedImageCodec, NdarrayCodec, ScalarCodec
@@ -76,22 +86,32 @@ def _build_dataset(url):
         'id': i,
         'image1': rng.integers(0, 255, (128, 256, 3), dtype=np.uint8),
         'array_4d': rng.integers(0, 255, (4, 128, 30, 3), dtype=np.uint8),
-    } for i in range(NUM_ROWS)), rows_per_row_group=100)
+    } for i in range(num_rows)), rows_per_row_group=rows_per_row_group,
+        compression=compression)
 
 
-def _ensure_dataset(url):
+def _ensure_dataset(url, cache_dir=None, compression='snappy',
+                    num_rows=NUM_ROWS, rows_per_row_group=100):
     import shutil
-    stamp_path = os.path.join(CACHE_DIR, '.format_stamp')
-    fresh = (os.path.exists(os.path.join(CACHE_DIR, '_common_metadata')) and
+    cache_dir = cache_dir or CACHE_DIR
+    # the default (snappy, full-size) store keeps the historical stamp string
+    # so a warm cache from earlier rounds survives this parameterization
+    stamp = DATASET_FORMAT_STAMP
+    if compression != 'snappy' or num_rows != NUM_ROWS:
+        stamp = '{}-{}-{}r{}'.format(DATASET_FORMAT_STAMP, compression,
+                                     num_rows, rows_per_row_group)
+    stamp_path = os.path.join(cache_dir, '.format_stamp')
+    fresh = (os.path.exists(os.path.join(cache_dir, '_common_metadata')) and
              os.path.exists(stamp_path) and
-             open(stamp_path).read().strip() == DATASET_FORMAT_STAMP)
+             open(stamp_path).read().strip() == stamp)
     if fresh:
         return
-    shutil.rmtree(CACHE_DIR, ignore_errors=True)
-    os.makedirs(CACHE_DIR, exist_ok=True)
-    _build_dataset(url)
+    shutil.rmtree(cache_dir, ignore_errors=True)
+    os.makedirs(cache_dir, exist_ok=True)
+    _build_dataset(url, compression=compression, num_rows=num_rows,
+                   rows_per_row_group=rows_per_row_group)
     with open(stamp_path, 'w') as f:
-        f.write(DATASET_FORMAT_STAMP)
+        f.write(stamp)
 
 
 def _prebuild_native():
@@ -324,6 +344,117 @@ def _duty_section(tpu_seen_early=False):
     return result
 
 
+def _counters():
+    from petastorm_tpu import observability as obs
+    try:
+        return {k: int(v) for k, v in obs.snapshot().get('counters', {}).items()}
+    except Exception:  # noqa: BLE001 - telemetry off: sweep still reports rates
+        return {}
+
+
+def _fused_predicate_share(counters):
+    """Share of fused batches that ran the in-kernel predicate stage — the
+    machine-checkable signal that filtered reads rode the native pushdown
+    (row selection + page-stat skipping inside the GIL-released call) rather
+    than the decode-everything-then-mask Python path."""
+    total = counters.get('fused_batches_total', 0)
+    if not total:
+        return None
+    return round(counters.get('fused_pred_batches_total', 0) / total, 4)
+
+
+def _compression_sweep_section():
+    """Per-codec fused-read capture on a hello-world-shaped store, plus a
+    predicate-filtered phase per codec. Two acceptance numbers live here:
+    ``fallback_compression`` must stay 0 for every codec (zstd/lz4 chunks fuse
+    through the first-party decompressors, no Arrow fallback), and the zstd
+    fused rate must sit within ~10% of snappy's (decompression is not the
+    bottleneck the codec choice moves). The predicate phase reads with a
+    native-pushdown range on ``id`` that matches only the first row group —
+    every other page is skippable from its min/max stats, so
+    ``pred_pages_skipped`` > 0 proves filtered reads do strictly less decode
+    work, not just less collation."""
+    import functools
+
+    from petastorm_tpu import make_reader
+    from petastorm_tpu.predicates import in_range
+    from petastorm_tpu.tools.throughput import reader_throughput
+
+    phases = {}
+    for codec in SWEEP_CODECS:
+        cache = os.path.join(REPO_ROOT, '.bench_cache', 'sweep_' + codec)
+        url = 'file://' + cache
+        _ensure_dataset(url, cache_dir=cache, compression=codec,
+                        num_rows=SWEEP_ROWS,
+                        rows_per_row_group=SWEEP_ROWS_PER_GROUP)
+        _warm(url)
+        before = _counters()
+        rates = []
+        for _ in range(3):
+            rates.append(reader_throughput(
+                url, warmup_cycles=64, measure_cycles=1024, pool_type='thread',
+                workers_count=3, shuffle_row_groups=True, read_method='python',
+                make_reader_fn=functools.partial(make_reader, seed=0),
+            ).samples_per_second)
+        after = _counters()
+
+        # filtered phase: only ids 0..SWEEP_ROWS_PER_GROUP-1 survive, i.e.
+        # exactly the first row group of the sequential-id store
+        predicate = in_range('id', lo=0, hi=SWEEP_ROWS_PER_GROUP - 1)
+        pred_before, t0, matched = _counters(), time.perf_counter(), 0
+        epochs = 8
+        with make_reader(url, shuffle_row_groups=False, workers_count=3,
+                         predicate=predicate, num_epochs=epochs) as reader:
+            for _ in reader:
+                matched += 1
+        wall = time.perf_counter() - t0
+        pred_after = _counters()
+
+        def delta(key, a=pred_before, b=pred_after):
+            return b.get(key, 0) - a.get(key, 0)
+
+        phase = {
+            'metric': 'compression_sweep',
+            'codec': codec,
+            'fused_samples_per_sec': round(statistics.median(rates), 2),
+            'rounds': [round(r, 2) for r in rates],
+            # any chunk the kernel refused on codec grounds during the
+            # unfiltered rounds — the tentpole's headline acceptance is 0
+            'fallback_compression': (after.get('fused_fallback_reason:compression', 0) -
+                                     before.get('fused_fallback_reason:compression', 0)),
+            'fused_batches': (after.get('fused_batches_total', 0) -
+                              before.get('fused_batches_total', 0)),
+            'predicate': {
+                'selected_rows_per_sec': round(matched / wall, 2) if wall else None,
+                'rows_matched': matched,
+                'rows_expected': SWEEP_ROWS_PER_GROUP * epochs,
+                'pred_batches': delta('fused_pred_batches_total'),
+                'pred_pages_skipped': delta('fused_pred_pages_skipped_total'),
+                'pred_rows_selected': delta('fused_pred_rows_selected'),
+                'fallback_predicate': sum(
+                    v - pred_before.get(k, 0) for k, v in pred_after.items()
+                    if k.startswith('fused_fallback_column:') and k.endswith(':predicate')),
+            },
+        }
+        print(json.dumps(phase), flush=True)
+        phases[codec] = {k: v for k, v in phase.items() if k != 'metric'}
+
+    snappy_rate = phases['snappy']['fused_samples_per_sec']
+    zstd_rate = phases['zstd']['fused_samples_per_sec']
+    summary = {
+        'metric': 'compression_sweep_summary',
+        'zstd_vs_snappy': round(zstd_rate / snappy_rate, 3) if snappy_rate else None,
+        'zstd_within_10pct': bool(snappy_rate and
+                                  abs(zstd_rate - snappy_rate) / snappy_rate <= 0.10),
+        'fallback_compression_total': sum(p['fallback_compression'] for p in phases.values()),
+        'pred_pages_skipped_total': sum(p['predicate']['pred_pages_skipped']
+                                        for p in phases.values()),
+        'codecs': phases,
+    }
+    print(json.dumps(summary), flush=True)
+    return {k: v for k, v in summary.items() if k != 'metric'}
+
+
 def _spin_ms(n=6_000_000):
     """Wall time of a fixed CPU-bound loop — a direct probe of the host's
     EFFECTIVE cpu speed at this instant. On this container it measures
@@ -409,6 +540,16 @@ def main(argv=None):
                              'reader (1 worker) once as-is and once under '
                              'autotune=True; the output records both rates and '
                              'the decision trajectory')
+    parser.add_argument('--compression', choices=SWEEP_CODECS, default='snappy',
+                        help='parquet codec for the headline hello-world store '
+                             '(docs/native.md: every listed codec decodes through '
+                             'the same fused kernel via the first-party '
+                             'decompressors; the store caches per codec)')
+    parser.add_argument('--compression-sweep', action='store_true',
+                        help='additionally capture the per-codec fused-read sweep '
+                             '+ predicate-filtered phase on hello-world-shaped '
+                             'stores: one line per codec, then a summary with the '
+                             'zstd-vs-snappy ratio and total page-stat skips')
     parser.add_argument('--protocol-monitor', action='store_true',
                         help='attach the worker-pool protocol conformance monitor '
                              '(docs/protocol.md) to every measured reader: a chaos '
@@ -425,13 +566,15 @@ def main(argv=None):
         from petastorm_tpu import observability as obs
         obs.configure(telemetry)
 
-    url = 'file://' + CACHE_DIR
+    cache_dir = (CACHE_DIR if args.compression == 'snappy'
+                 else CACHE_DIR + '_' + args.compression)
+    url = 'file://' + cache_dir
     # opportunistic probe AT CAPTURE START: a TPU reachable now but gone by
     # the end of the ~10-minute CPU capture still gets its duty sweep
     early_platform, early_count = _probe_tpu()
     tpu_seen_early = early_platform == 'tpu' and early_count >= 1
     _prebuild_native()
-    _ensure_dataset(url)
+    _ensure_dataset(url, cache_dir=cache_dir, compression=args.compression)
     _warm(url)
 
     from petastorm_tpu.tools.throughput import reader_throughput
@@ -490,6 +633,8 @@ def main(argv=None):
 
     decode_shares = _decode_collate_section()
 
+    compression_sweep = _compression_sweep_section() if args.compression_sweep else None
+
     autotune = _autotune_section(url, headline_rate=value) if args.autotune else None
 
     duty = _duty_section(tpu_seen_early=tpu_seen_early)
@@ -521,6 +666,12 @@ def main(argv=None):
         # where the decode went, not a Python tail)
         'decode_collate_share': (decode_shares or {}).get('decode_collate_share'),
         'fused_decode_share': (decode_shares or {}).get('fused_decode_share'),
+        # share of fused batches that ran the in-kernel predicate stage over
+        # the whole capture (the sweep's filtered phases are the contributor;
+        # an unfiltered-only capture honestly reports 0.0)
+        'fused_predicate_share': _fused_predicate_share(_counters()),
+        'compression': args.compression,
+        'compression_sweep': compression_sweep,
         'duty': duty,
         'autotune': autotune,
         'chaos': _chaos_section() if args.chaos else None,

@@ -92,16 +92,24 @@ class ArrowBatchWorker(WorkerBase):
             key = _cache_key(args['dataset_path'], piece, needed)
             batch = args['cache'].get(key, lambda: self._load_batch(piece, needed, None))
         else:
-            # predicate columns are read even when excluded from the output
-            # selection (reference arrow_reader_worker.py:181-240)
-            load_cols = needed
-            if worker_predicate is not None:
-                load_cols = sorted(set(needed) | set(worker_predicate.get_fields()))
-            batch = self._load_batch(piece, load_cols, shuffle_row_drop_partition)
-            if worker_predicate is not None:
-                batch = self._apply_predicate(batch, worker_predicate)
-                if batch is not None:
-                    batch = {k: v for k, v in batch.items() if k in needed}
+            batch = None
+            fused_served = False
+            if worker_predicate is not None and shuffle_row_drop_partition is None:
+                fast = self._load_batch_with_predicate(piece, needed, worker_predicate)
+                if fast is not None:
+                    batch = fast or None  # {} -> no surviving rows
+                    fused_served = True
+            if not fused_served:
+                # predicate columns are read even when excluded from the output
+                # selection (reference arrow_reader_worker.py:181-240)
+                load_cols = needed
+                if worker_predicate is not None:
+                    load_cols = sorted(set(needed) | set(worker_predicate.get_fields()))
+                batch = self._load_batch(piece, load_cols, shuffle_row_drop_partition)
+                if worker_predicate is not None:
+                    batch = self._apply_predicate(batch, worker_predicate)
+                    if batch is not None:
+                        batch = {k: v for k, v in batch.items() if k in needed}
 
         if batch is None or not batch:
             return
@@ -157,6 +165,55 @@ class ArrowBatchWorker(WorkerBase):
         for key, value in piece.partition_keys.items():
             if key in column_names:
                 batch[key] = np.full(num_rows, value)
+        return batch
+
+    def _load_batch_with_predicate(self, piece, needed, predicate):
+        """Native predicate pushdown for the batch reader: clauses, page-stat
+        skipping and selected-row collation run in one GIL-released call
+        (docs/native.md); Arrow serves only the non-fused columns, taken at
+        the surviving row indices. Returns the filtered batch ({} when no row
+        survives), or None when the predicate shape / columns are not natively
+        evaluable — the caller then runs the Python pushdown path."""
+        pf = self._parquet_file(piece.path)
+        if not hasattr(pf, 'read_fused_predicate'):
+            return None
+        clauses = getattr(predicate, 'native_clauses', lambda: None)()
+        if clauses is None:
+            return None
+        schema = self.args['schema']
+        pred_fields = sorted(predicate.get_fields())
+        if any(f in piece.partition_keys or f not in schema.fields
+               for f in pred_fields):
+            return None  # partition-key predicates: piece-level path decides
+        physical = [c for c in needed
+                    if c not in piece.partition_keys and c in schema.fields]
+        if not physical:
+            return None
+        try:
+            # schema_fields=None: the batch reader's raw-column contract, same
+            # as the unfiltered fused pass above
+            res = pf.read_fused_predicate(piece.row_group, physical,
+                                          pred_fields, clauses, None)
+        except Exception:  # noqa: BLE001 - any surprise: Python pushdown serves it
+            return None
+        if res is None:
+            return None
+        block, rest, sel_mask, _n_selected, _pages_skipped = res
+        kept = np.flatnonzero(sel_mask)
+        if not len(kept):
+            return {}
+        batch = dict(block)
+        if rest:
+            with obs.stage('read', cat='worker', piece=piece.path,
+                           row_group=piece.row_group):
+                table = pf.read_row_group(piece.row_group, columns=rest)
+                table = table.take(kept)
+            with obs.stage('decode', cat='worker', rows=len(kept)):
+                for name in rest:
+                    batch[name] = _column_to_numpy(table.column(name), name)
+        for key, value in piece.partition_keys.items():
+            if key in needed:
+                batch[key] = np.full(len(kept), value)
         return batch
 
     def _apply_predicate(self, batch, predicate):

@@ -255,11 +255,11 @@ class ChunkCachedParquetFile(object):
             self._fused_plans[key] = plan
         return self._fused_plans[key]
 
-    def _fused_chunks(self, plan):
+    def _fused_chunks(self, cols):
         """Per-column chunk views served from the content-addressed local
         mirror (fetched once per chunk; warm reads are pure mmap)."""
         chunks = []
-        for p in plan.columns:
+        for p in cols:
             key = self._chunk_key(p.chunk_off, p.chunk_len)
             try:
                 mm = self._store.mmap_chunk(
@@ -282,17 +282,54 @@ class ChunkCachedParquetFile(object):
         if not plan.columns:
             fused.count_fallbacks(plan.reasons)
             return {}, list(columns)
-        block, _reasons = fused.read_block(self._lib, self._fused_chunks(plan),
+        block, _reasons = fused.read_block(self._lib,
+                                           self._fused_chunks(plan.columns),
                                            plan, stage_args={'row_group': i})
         rest = [c for c in columns if c not in block]
         return block, rest
+
+    def read_fused_predicate(self, i, columns, pred_fields, clauses,
+                             schema_fields=None, decode_hints=None,
+                             resize_hints=None):
+        """Same contract as ``NativeParquetFile.read_fused_predicate``, with
+        every chunk (output AND predicate columns) served from the local
+        mirror — a warm filtered read touches no remote bytes at all."""
+        from petastorm_tpu.native import fused
+        plan = self.fused_plan(i, columns, schema_fields, decode_hints,
+                               resize_hints, include_pagescan=True)
+        if plan is None or not plan.columns:
+            return None
+        got = fused.plan_predicate_columns(self._meta, self._flat_index, i,
+                                           pred_fields, schema_fields)
+        if got is None:
+            fused.count_fallbacks({f: 'predicate' for f in pred_fields})
+            return None
+        pred_plans, pred_index = got
+        for p in pred_plans:
+            if p.chunk_off + p.chunk_len > self._file_size:
+                fused.count_fallbacks({p.name: 'bounds'})
+                return None
+        compiled = fused.compile_predicate(clauses, pred_index)
+        if isinstance(compiled, str):
+            fused.count_fallbacks({f: compiled for f in pred_fields})
+            return None
+        preds, keepalive = compiled
+        res = fused.read_block_pred(
+            self._lib, self._fused_chunks(plan.columns), plan,
+            self._fused_chunks(pred_plans), pred_plans, preds, keepalive,
+            stage_args={'row_group': i})
+        if res is None:
+            return None
+        block, _reasons, sel_mask, n_selected, pages_skipped = res
+        rest = [c for c in columns if c not in block]
+        return block, rest, sel_mask, n_selected, pages_skipped
 
     def fused_read_into(self, plan, out_buf, offsets):
         """In-place (shm-ring slot) variant, mirroring the local reader."""
         from petastorm_tpu import observability as obs
         from petastorm_tpu.native import fused
         with obs.stage('fused_decode', cat='native', rows=plan.expected_rows):
-            return fused.read_into(self._lib, self._fused_chunks(plan),
+            return fused.read_into(self._lib, self._fused_chunks(plan.columns),
                                    plan.columns, plan.expected_rows, out_buf,
                                    offsets)
 

@@ -88,6 +88,15 @@ class DatasetWriter(object):
 
     Hive-style partitioning: pass ``partition_by=['field', ...]`` and rows are
     routed to ``field=value/`` subdirectories, one open writer per partition.
+
+    ``compression`` selects the parquet codec: a string applies dataset-wide
+    (``'snappy'`` default; ``'zstd'``, ``'lz4'`` and ``'none'`` all decode
+    through the same fused native kernel via its first-party decompressors —
+    docs/native.md qualification matrix), a dict maps column name -> codec for
+    per-column control, and ``None`` means uncompressed. With the string form,
+    columns whose codec already compresses its payloads (png/jpeg/zlib cells)
+    are written uncompressed automatically (``preferred_column_compression``)
+    — re-compressing them costs read-side decompression for zero size win.
     """
 
     def __init__(self, dataset_url, schema, row_group_size_mb=None, rows_per_row_group=None,
@@ -274,7 +283,12 @@ def materialize_dataset(dataset_url, schema, row_group_size_mb=None, rows_per_ro
     """Context manager bracketing a dataset write (reference
     etl/dataset_metadata.py:52-114). Yields a :class:`DatasetWriter`; on exit,
     closes it, writes ``_common_metadata`` with the JSON unischema and per-file
-    row-group counts, and validates the dataset is readable."""
+    row-group counts, and validates the dataset is readable.
+
+    :param compression: parquet codec — dataset-wide string (``'snappy'``
+        default, ``'zstd'``/``'lz4'``/``'none'`` equally fused-readable), a
+        per-column ``{name: codec}`` dict, or ``None`` for uncompressed; see
+        :class:`DatasetWriter` for the already-compressed-payload override."""
     writer = DatasetWriter(dataset_url, schema, row_group_size_mb=row_group_size_mb,
                            rows_per_row_group=rows_per_row_group, rows_per_file=rows_per_file,
                            partition_by=partition_by, compression=compression)

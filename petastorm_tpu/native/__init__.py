@@ -212,13 +212,13 @@ class NativeParquetFile(object):
                 decode_hints, resize_hints, include_pagescan=include_pagescan)
         return self._fused_plans[key]
 
-    def _fused_chunks(self, plan):
+    def _fused_chunks(self, cols):
         """Per-column chunk byte views over the mmapped file (bounds-checked
         against the file size; a stale footer fails the read, not the
         process)."""
         mm = self._mmaps.get(self.path)
         chunks = []
-        for p in plan.columns:
+        for p in cols:
             if p.chunk_off < 0 or p.chunk_off + p.chunk_len > mm.size:
                 chunks.append(None)
             else:
@@ -239,10 +239,48 @@ class NativeParquetFile(object):
         if not plan.columns:
             fused.count_fallbacks(plan.reasons)
             return {}, list(columns)
-        block, _reasons = fused.read_block(self._lib, self._fused_chunks(plan),
+        block, _reasons = fused.read_block(self._lib,
+                                           self._fused_chunks(plan.columns),
                                            plan, stage_args={'row_group': i})
         rest = [c for c in columns if c not in block]
         return block, rest
+
+    def read_fused_predicate(self, i, columns, pred_fields, clauses,
+                             schema_fields=None, decode_hints=None,
+                             resize_hints=None):
+        """Filtered fused read of one row group: predicate evaluation (with
+        min/max page-stat skipping), row selection and the decode of ONLY the
+        surviving rows run in a single GIL-released call. ``clauses`` is the
+        ``PredicateBase.native_clauses()`` protocol list. Returns ``(block,
+        rest, sel_mask, n_selected, pages_skipped)`` — ``rest`` columns must
+        be Arrow-read and filtered with ``sel_mask`` by the caller — or None
+        when the predicate shape / columns are not natively evaluable (reason
+        ``predicate`` accounted per predicate column) or the kernel declined."""
+        from petastorm_tpu.native import fused
+        plan = self.fused_plan(i, columns, schema_fields, decode_hints,
+                               resize_hints, include_pagescan=True)
+        if plan is None or not plan.columns:
+            return None
+        got = fused.plan_predicate_columns(self._pq_meta, self._flat_index, i,
+                                           pred_fields, schema_fields)
+        if got is None:
+            fused.count_fallbacks({f: 'predicate' for f in pred_fields})
+            return None
+        pred_plans, pred_index = got
+        compiled = fused.compile_predicate(clauses, pred_index)
+        if isinstance(compiled, str):
+            fused.count_fallbacks({f: compiled for f in pred_fields})
+            return None
+        preds, keepalive = compiled
+        res = fused.read_block_pred(
+            self._lib, self._fused_chunks(plan.columns), plan,
+            self._fused_chunks(pred_plans), pred_plans, preds, keepalive,
+            stage_args={'row_group': i})
+        if res is None:
+            return None
+        block, _reasons, sel_mask, n_selected, pages_skipped = res
+        rest = [c for c in columns if c not in block]
+        return block, rest, sel_mask, n_selected, pages_skipped
 
     def fused_read_into(self, plan, out_buf, offsets):
         """Run a prepared fused plan writing directly into ``out_buf`` (the
@@ -250,7 +288,7 @@ class NativeParquetFile(object):
         maps). Returns the per-column native results."""
         from petastorm_tpu.native import fused
         with obs.stage('fused_decode', cat='native', rows=plan.expected_rows):
-            return fused.read_into(self._lib, self._fused_chunks(plan),
+            return fused.read_into(self._lib, self._fused_chunks(plan.columns),
                                    plan.columns, plan.expected_rows, out_buf,
                                    offsets)
 

@@ -54,7 +54,7 @@ MAX_PAGES = 4096
 #: equal the ``pstpu_abi_version()`` literal in rowgroup_reader.cpp — the
 #: loader refuses a kernel reporting anything else (stale build cache), and
 #: lint rule PT900 keeps the two literals in sync statically.
-EXPECTED_ABI = 3
+EXPECTED_ABI = 4
 
 # modes / codecs — keep in sync with rowgroup_reader.cpp
 MODE_FIXED = 0
@@ -62,6 +62,27 @@ MODE_BINARY_RAW = 1
 MODE_BINARY_IMG = 2
 CODEC_UNCOMPRESSED = 0
 CODEC_SNAPPY = 1
+CODEC_ZSTD = 2
+CODEC_LZ4_RAW = 3
+CODEC_LZ4 = 4      # parquet legacy LZ4: hadoop-framed / frame / raw auto-detect
+
+#: parquet metadata compression string -> kernel codec id. Every codec here
+#: has a first-party bounds-checked decompressor in rowgroup_reader.cpp;
+#: anything else (GZIP, BROTLI, LZO) stays an Arrow-path ``compression``
+#: fallback.
+CODEC_BY_NAME = {
+    'UNCOMPRESSED': CODEC_UNCOMPRESSED,
+    'SNAPPY': CODEC_SNAPPY,
+    'ZSTD': CODEC_ZSTD,
+    'LZ4_RAW': CODEC_LZ4_RAW,
+    'LZ4': CODEC_LZ4,
+}
+
+# predicate ops / comparison dtypes — keep in sync with rowgroup_reader.cpp
+PRED_IN = 0
+PRED_RANGE = 1
+_PRED_DTYPE_CODES = {('i', 4): 0, ('i', 8): 1, ('u', 4): 2, ('u', 8): 3,
+                     ('f', 4): 4, ('f', 8): 5}
 
 #: native per-column status -> fallback reason label (rowgroup_reader.cpp)
 REASON_BY_STATUS = {
@@ -109,12 +130,40 @@ class FusedColStruct(ctypes.Structure):
     ]
 
 
+class FusedPredStruct(ctypes.Structure):
+    """Field-for-field mirror of ``struct FusedPred`` (the batch-buffer ABI)."""
+
+    _fields_ = [
+        ('values', ctypes.c_void_p),
+        ('values_cap', ctypes.c_uint64),
+        ('count', ctypes.c_int64),
+        ('col', ctypes.c_int32),
+        ('op', ctypes.c_int32),
+        ('dtype', ctypes.c_int32),
+        ('negate', ctypes.c_int32),
+        ('has_lo', ctypes.c_int32),
+        ('has_hi', ctypes.c_int32),
+        ('lo_incl', ctypes.c_int32),
+        ('hi_incl', ctypes.c_int32),
+        ('status', ctypes.c_int32),
+        ('pages_skipped', ctypes.c_int32),
+    ]
+
+
 def register_abi(lib):
-    """ctypes signature of the fused entry point (called from native.__init__)."""
+    """ctypes signature of the fused entry points (called from native.__init__)."""
     lib.pstpu_read_fused.restype = ctypes.c_longlong
     lib.pstpu_read_fused.argtypes = [
         ctypes.POINTER(FusedColStruct), ctypes.c_int, ctypes.c_int,
         ctypes.c_int, ctypes.c_void_p, ctypes.c_void_p]
+    lib.pstpu_read_fused_pred.restype = ctypes.c_longlong
+    lib.pstpu_read_fused_pred.argtypes = [
+        ctypes.POINTER(FusedColStruct), ctypes.c_int,
+        ctypes.POINTER(FusedColStruct), ctypes.c_int,
+        ctypes.POINTER(FusedPredStruct), ctypes.c_int,
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_longlong, ctypes.c_int,
+        ctypes.c_int, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_longlong), ctypes.POINTER(ctypes.c_longlong)]
 
 
 class ColumnPlan(object):
@@ -195,11 +244,8 @@ def _qualify_chunk(meta_col, schema_col):
         stats = meta_col.statistics
         if stats is None or stats.null_count is None or stats.null_count != 0:
             return 'nullable'
-    if meta_col.compression == 'UNCOMPRESSED':
-        codec = CODEC_UNCOMPRESSED
-    elif meta_col.compression == 'SNAPPY':
-        codec = CODEC_SNAPPY
-    else:
+    codec = CODEC_BY_NAME.get(meta_col.compression)
+    if codec is None:
         return 'compression'
     if any(e not in _OK_ENCODINGS for e in meta_col.encodings):
         return 'encoding'
@@ -403,6 +449,138 @@ def count_fallbacks(reasons):
         obs.count('fused_fallback_column:{}:{}'.format(name, reason))
 
 
+def _pred_domain(plan):
+    """``(dtype_code, comparison dtype, logical dtype)`` for one predicate
+    column plan, or None when the column's values cannot be compared natively
+    (binary modes, FLBA tensors, non-numeric logicals). Integer comparisons
+    run at the PHYSICAL width; they go unsigned only when the logical dtype is
+    unsigned at full physical width — narrower unsigned logicals zero-extend
+    into the positive signed range, where the signed compare is already
+    exact."""
+    phys = plan.phys_dtype
+    if plan.mode != MODE_FIXED or phys is None or phys.itemsize != plan.itemsize:
+        return None
+    logical = plan.field_dtype or phys
+    if logical.kind == 'u' and logical.itemsize == phys.itemsize:
+        cmp_dtype = np.dtype('u{}'.format(phys.itemsize))
+    else:
+        cmp_dtype = phys
+    code = _PRED_DTYPE_CODES.get((cmp_dtype.kind, cmp_dtype.itemsize))
+    if code is None:
+        return None
+    return code, cmp_dtype, logical
+
+
+def _pred_operand(value, logical, cmp_dtype):
+    """``value`` encoded as ``cmp_dtype`` bytes, or None when it is not
+    EXACTLY representable in the column's logical domain — the native compare
+    must agree bit-for-bit with the numpy fallback, so a rounding cast is
+    never acceptable."""
+    try:
+        v0 = np.asarray(value)
+        if v0.shape != () or v0.dtype.kind not in 'iufb':
+            return None
+        with np.errstate(all='ignore'):
+            c = v0.astype(logical)
+            if not bool(c == v0):
+                return None
+            return c.astype(cmp_dtype).tobytes()
+    except (TypeError, ValueError, OverflowError):
+        return None
+
+
+def compile_predicate(clauses, pred_index):
+    """Map protocol clause dicts (``PredicateBase.native_clauses``) onto a
+    ctypes ``FusedPred`` array. ``pred_index`` maps predicate column name ->
+    ``(descriptor index, ColumnPlan)``. Returns ``(preds, keepalive)`` — the
+    struct array plus the operand buffers it points into, which MUST stay
+    referenced across the kernel call — or the string ``'predicate'`` when any
+    clause shape is not natively evaluable (the caller counts the fallback and
+    rides the Arrow predicate path)."""
+    entries = []
+    keepalive = []
+    for cl in clauses or ():
+        hit = pred_index.get(cl.get('field'))
+        if hit is None:
+            return 'predicate'
+        idx, plan = hit
+        dom = _pred_domain(plan)
+        if dom is None:
+            return 'predicate'
+        code, cmp_dtype, logical = dom
+        w = cmp_dtype.itemsize
+        e = {'col': idx, 'dtype': code, 'negate': 1 if cl.get('negate') else 0,
+             'has_lo': 0, 'has_hi': 0, 'lo_incl': 0, 'hi_incl': 0}
+        op = cl.get('op')
+        if op == 'in':
+            packed = set()
+            for v in cl.get('values', ()):
+                b = _pred_operand(v, logical, cmp_dtype)
+                # an unrepresentable operand can never equal a column value:
+                # dropping it is exact, matching the numpy fallback
+                if b is not None:
+                    packed.add(b)
+            data = b''.join(sorted(packed))
+            buf = np.frombuffer(bytearray(data or b'\x00'), dtype=np.uint8)
+            e.update(op=PRED_IN, count=len(data) // w, values=buf)
+        elif op == 'range':
+            bounds = []
+            for key, flag, incl in (('lo', 'has_lo', 'lo_incl'),
+                                    ('hi', 'has_hi', 'hi_incl')):
+                v = cl.get(key)
+                if v is None:
+                    bounds.append(b'\x00' * w)
+                    continue
+                b = _pred_operand(v, logical, cmp_dtype)
+                if b is None:
+                    return 'predicate'
+                bounds.append(b)
+                e[flag] = 1
+                e[incl] = 1 if cl.get(key + '_incl', True) else 0
+            buf = np.frombuffer(bytearray(b''.join(bounds)), dtype=np.uint8)
+            e.update(op=PRED_RANGE, count=0, values=buf)
+        else:
+            return 'predicate'
+        keepalive.append(e['values'])
+        entries.append(e)
+    if not entries:
+        return 'predicate'
+    preds = (FusedPredStruct * len(entries))()
+    for p, e in zip(preds, entries):
+        buf = e['values']
+        p.values = buf.ctypes.data
+        p.values_cap = buf.nbytes
+        p.count = e['count']
+        p.col = e['col']
+        p.op = e['op']
+        p.dtype = e['dtype']
+        p.negate = e['negate']
+        p.has_lo = e['has_lo']
+        p.has_hi = e['has_hi']
+        p.lo_incl = e['lo_incl']
+        p.hi_incl = e['hi_incl']
+    return preds, keepalive
+
+
+def plan_predicate_columns(pq_meta, flat_index, row_group, pred_fields,
+                           schema_fields):
+    """ColumnPlans for the predicate columns — always planned with
+    ``include_pagescan`` (the zero-copy view path cannot gate collation) —
+    plus the name -> (descriptor index, plan) map ``compile_predicate``
+    consumes. Returns None when any predicate column does not qualify
+    natively."""
+    plan = plan_row_group(pq_meta, flat_index, row_group, list(pred_fields),
+                          schema_fields, include_pagescan=True)
+    if plan is None or plan.rest:
+        return None
+    index = {}
+    for i, p in enumerate(plan.columns):
+        if _pred_domain(p) is None:
+            return None
+        index[p.name] = (i, p)
+    return plan.columns, index
+
+
 def _invoke_read_fused(lib, descs, n_cols, n_threads, img_probe, img_decode):
     """THE single Python<->C transition of a fused batch (ctypes releases the
     GIL for the call's duration). Isolated so the structural one-GIL-touch
@@ -507,6 +685,168 @@ def read_block(lib, chunks, plan, stage_args=None):
         obs.count('fused_batches_total')
     count_fallbacks({n: r for n, r in reasons.items() if n not in block})
     return block, reasons
+
+
+def _invoke_read_fused_pred(lib, descs, n_cols, pred_descs, n_pred_cols, preds,
+                            n_preds, sel_ptr, sel_cap, total_rows, n_threads,
+                            img_probe, img_decode, out_selected, out_skipped):
+    """THE single Python<->C transition of a fused *filtered* batch: predicate
+    evaluation, page-stat skipping and selected-row collation all run inside
+    this one GIL-released call. Isolated so the structural one-GIL-touch test
+    can count invocations."""
+    return lib.pstpu_read_fused_pred(
+        descs, n_cols, pred_descs, n_pred_cols, preds, n_preds, sel_ptr,
+        sel_cap, total_rows, n_threads, MAX_PAGES, img_probe, img_decode,
+        out_selected, out_skipped)
+
+
+def _fill_desc(d, plan, chunk, out_ptr, out_cap, aux, expected_rows):
+    d.chunk = chunk.ctypes.data
+    d.chunk_len = plan.chunk_len
+    d.out = out_ptr
+    d.out_cap = out_cap
+    if aux is not None:
+        d.aux_buf = aux.ctypes.data
+        d.aux_cap = aux.nbytes
+    d.expected_rows = expected_rows
+    d.mode = plan.mode
+    d.codec = plan.codec
+    d.itemsize = plan.itemsize
+    d.has_def_levels = 1 if plan.has_def else 0
+    d.strip_npy = 1 if plan.strip_npy else 0
+    if plan.img is not None:
+        d.img_h, d.img_w, d.img_c = plan.img
+    d.status = 0
+
+
+def _narrow_plan(plan, full_rows, n_selected):
+    """Shallow copy of ``plan`` with the row-dependent bounds rescaled from
+    the planned full row group to the ``n_selected`` rows the gather kept."""
+    q = ColumnPlan(plan.name)
+    for slot in ColumnPlan.__slots__:
+        setattr(q, slot, getattr(plan, slot))
+    if plan.out_shape is not None:
+        q.out_shape = (n_selected,) + tuple(plan.out_shape[1:])
+    if plan.known_size and full_rows:
+        q.out_bound = plan.out_bound // full_rows * n_selected
+    return q
+
+
+def read_block_pred(lib, chunks, plan, pred_chunks, pred_plans, preds,
+                    keepalive, stage_args=None):
+    """Filtered fused batch: evaluate the compiled predicate clauses against
+    the predicate column chunks (skipping whole pages via min/max page
+    statistics first), then collate ONLY the selected rows of every output
+    column — one GIL-released call end to end, strictly less decode work than
+    an unfiltered read whenever pages can be skipped.
+
+    Returns ``(block, reasons, sel_mask, n_selected, pages_skipped)`` —
+    ``sel_mask`` is the boolean row mask over the full row group, used by the
+    caller to filter the non-fused (Arrow) columns consistently — or None when
+    the kernel declined (any clause or column failed natively); the caller
+    then falls back to the unfused predicate path for the whole block."""
+    rows = plan.expected_rows
+    offsets, total = [], 0
+    for p in plan.columns:
+        offsets.append(total)
+        total += p.out_bound
+    out = np.empty(total, dtype=np.uint8)
+    n = len(plan.columns)
+    npred = len(pred_plans)
+    if n == 0 or npred == 0 or len(preds) == 0:
+        return None
+    descs = (FusedColStruct * n)()
+    pred_descs = (FusedColStruct * npred)()
+    aux_bufs = []
+    has_img = any(p.mode == MODE_BINARY_IMG for p in plan.columns)
+    probe_addr = decode_addr = None
+    if has_img:
+        from petastorm_tpu.native import image_codec
+        addrs = image_codec.batch_fn_addrs()
+        if addrs is None:
+            return None
+        probe_addr, decode_addr = addrs
+    for i, p in enumerate(plan.columns):
+        aux = np.zeros(_AUX_BYTES, dtype=np.uint8)
+        aux_bufs.append(aux)
+        chunk = chunks[i]
+        if chunk is None or chunk.nbytes != p.chunk_len:
+            return None
+        _fill_desc(descs[i], p, chunk, out.ctypes.data + offsets[i],
+                   p.out_bound, aux, rows)
+    for i, p in enumerate(pred_plans):
+        chunk = pred_chunks[i]
+        if chunk is None or chunk.nbytes != p.chunk_len:
+            return None
+        _fill_desc(pred_descs[i], p, chunk, None, 0, None, rows)
+    sel = np.zeros((rows + 7) // 8 or 1, dtype=np.uint8)
+    out_selected = ctypes.c_longlong(0)
+    out_skipped = ctypes.c_longlong(0)
+    with obs.stage('fused_predicate', cat='native', rows=rows,
+                   **(stage_args or {})):
+        if has_img:
+            from petastorm_tpu.native import image_codec
+            with image_codec._thread_grant(None) as grant:
+                for i in range(n):
+                    descs[i].img_threads = grant
+                ret = _invoke_read_fused_pred(
+                    lib, descs, n, pred_descs, npred, preds, len(preds),
+                    sel.ctypes.data, sel.nbytes, rows, _column_threads(n),
+                    probe_addr, decode_addr, ctypes.byref(out_selected),
+                    ctypes.byref(out_skipped))
+        else:
+            ret = _invoke_read_fused_pred(
+                lib, descs, n, pred_descs, npred, preds, len(preds),
+                sel.ctypes.data, sel.nbytes, rows, _column_threads(n),
+                None, None, ctypes.byref(out_selected),
+                ctypes.byref(out_skipped))
+    # chunks / aux_bufs / keepalive operand buffers anchored through the call
+    del keepalive
+    # the kernel's return counts FAILED OUTPUT COLUMNS — those degrade
+    # per-column to the Arrow path below, exactly like the unfiltered pass.
+    # Only a failed predicate stage (any clause or predicate column status
+    # nonzero) invalidates the selection itself and fails the whole block.
+    if ret < 0:
+        return None
+    if any(pred_descs[i].status != 0 for i in range(npred)):
+        return None
+    if any(pr.status != 0 for pr in preds):
+        return None
+    n_selected = int(out_selected.value)
+    pages_skipped = int(out_skipped.value)
+    sel_mask = np.unpackbits(sel, bitorder='little')[:rows].astype(bool)
+    block = {}
+    reasons = dict(plan.reasons)
+    if n_selected == 0:
+        for p in plan.columns:
+            if p.out_shape is None:
+                # npy-stripped cells: the row shape is only discoverable from
+                # a decoded cell, and there are none — Arrow serves the column
+                # (zero rows either way)
+                reasons[p.name] = 'post-validate'
+                continue
+            dtype = p.field_dtype if p.field_dtype is not None else p.out_dtype
+            block[p.name] = np.empty((0,) + tuple(p.out_shape[1:]), dtype=dtype)
+    else:
+        for i, p in enumerate(plan.columns):
+            res = (descs[i].status, descs[i].out_used, descs[i].aux0,
+                   descs[i].aux1,
+                   bytes(aux_bufs[i][:descs[i].aux1]) if descs[i].aux1 else b'')
+            col = build_column(_narrow_plan(p, rows, n_selected), res, out,
+                               offsets[i], n_selected)
+            if col is None:
+                reasons[p.name] = REASON_BY_STATUS.get(res[0], 'post-validate')
+            else:
+                block[p.name] = col
+    count_fallbacks({n: r for n, r in reasons.items() if n not in block})
+    if not block:
+        return None  # nothing fused: the unfiltered Arrow pushdown is simpler
+    obs.count('fused_pred_batches_total')
+    obs.count('fused_pred_pages_skipped_total', pages_skipped)
+    obs.count('fused_pred_rows_selected', n_selected)
+    obs.count('fused_columns_total', len(block))
+    obs.count('fused_batches_total')
+    return block, reasons, sel_mask, n_selected, pages_skipped
 
 
 def _column_threads(n_cols):

@@ -87,6 +87,16 @@ def fused_fallback_table(diagnostics):
     return table
 
 
+#: fallback reason -> one-line remedy appended under the table when present
+_FALLBACK_REMEDIES = {
+    'predicate': 'predicate shape not natively evaluable — see docs/native.md '
+                 'qualification matrix (in_lambda, string sets and partition-key '
+                 'predicates stay on the Python path)',
+    'compression': 'codec off the fused path (GZIP/BROTLI/LZO) — rewrite the '
+                   'store with snappy/zstd/lz4 (materialize_dataset compression=)',
+}
+
+
 def format_fused_fallbacks(diagnostics):
     """Human-readable per-column fallback section (empty string when every
     column rode the fused/zero-copy native path)."""
@@ -95,10 +105,14 @@ def format_fused_fallbacks(diagnostics):
         return ''
     lines = ['fused-decode fallbacks (column -> reason x count; see '
              'docs/native.md for the reason catalog):']
+    seen_reasons = set()
     for column in sorted(table):
         reasons = ', '.join('{} x{}'.format(r, c)
                             for r, c in sorted(table[column].items()))
         lines.append('  {:<24s} {}'.format(column, reasons))
+        seen_reasons.update(table[column])
+    for reason in sorted(seen_reasons & set(_FALLBACK_REMEDIES)):
+        lines.append('  remedy[{}]: {}'.format(reason, _FALLBACK_REMEDIES[reason]))
     return '\n'.join(lines)
 
 

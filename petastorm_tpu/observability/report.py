@@ -32,12 +32,14 @@ from __future__ import annotations
 #: read→decode→collate pass (docs/native.md) — its seconds INCLUDE the page
 #: faults of cold chunks, so on cold storage it partially overlaps what
 #: read_io would have shown.
-_WORKER_STAGES = ('read_io', 'chunk_fetch', 'fused_decode', 'decode', 'transform')
+_WORKER_STAGES = ('read_io', 'chunk_fetch', 'fused_predicate', 'fused_decode',
+                  'decode', 'transform')
 
 #: stage -> one-line remedy, surfaced next to the named bottleneck
 _HINTS = {
     'worker.read_io': 'storage-bound: enable chunk_cache for remote stores, or add IO parallelism (workers_count)',
     'worker.chunk_fetch': 'cold chunk mirror: warm the cache (epoch 2+ reads locally) or raise prefetch_budget',
+    'worker.fused_predicate': 'fused predicate+decode dominates: tighten the predicate (page-stat skipping prunes more when clauses are selective) or add cores/workers (docs/native.md)',
     'worker.fused_decode': 'fused native decode dominates: add cores/workers — the pass is already one GIL-released call per batch (docs/native.md)',
     'worker.decode': 'decode-bound: more workers/cores, batched TransformSpec, image_decode_hints, or a RawTensorCodec store; check fused_fallback_reason:* counters for columns off the fused path',
     'worker.transform': 'transform-bound: vectorize with TransformSpec(batched=True)',
@@ -67,6 +69,7 @@ def stall_report(diagnostics):
     busy = {
         'read_io': max(read - chunk_fetch, 0.0),
         'chunk_fetch': chunk_fetch,
+        'fused_predicate': float(diagnostics.get('stage_fused_predicate_s', 0.0) or 0.0),
         'fused_decode': float(diagnostics.get('stage_fused_decode_s', 0.0) or 0.0),
         'decode': float(diagnostics.get('stage_decode_s', 0.0) or 0.0),
         'transform': float(diagnostics.get('stage_transform_s', 0.0) or 0.0),

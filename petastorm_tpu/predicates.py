@@ -35,6 +35,17 @@ class PredicateBase(object):
         gave the batch worker (arrow_reader_worker.py:181-240)."""
         return None
 
+    def native_clauses(self):
+        """AND-of-clauses description for the fused native predicate stage, or
+        ``None`` when this predicate cannot be pushed below the GIL (the
+        worker then evaluates it in Python as before). Each clause is a dict
+        ``{'field', 'op': 'in'|'range', 'negate'}`` plus ``'values'`` (in) or
+        ``'lo'/'hi'/'lo_incl'/'hi_incl'`` (range); clauses are ANDed row-wise.
+        Semantics MUST match :meth:`do_include` exactly — the worker trusts
+        the native verdict without re-checking (see docs/native.md for the
+        qualification matrix)."""
+        return None
+
 
 def evaluate_predicate_mask(predicate, block, num_rows):
     """THE contract enforcement for :meth:`PredicateBase.do_include_batch`,
@@ -60,6 +71,17 @@ def _batch_mask(predicate, block):
     if batch_fn is None:
         return None
     return batch_fn(block)
+
+
+def _native_semantics_intact(predicate, base):
+    """A subclass that overrides ``do_include``/``do_include_batch`` changed
+    the predicate's semantics: the base class's clause description no longer
+    speaks for it, and the native pushdown — which trusts the clauses without
+    re-checking — must decline rather than silently evaluate the BASE
+    semantics below the GIL."""
+    cls = type(predicate)
+    return (cls.do_include is base.do_include and
+            cls.do_include_batch is base.do_include_batch)
 
 
 class in_set(PredicateBase):
@@ -99,6 +121,75 @@ class in_set(PredicateBase):
         if not ok:
             return None
         return np.isin(col, vals)
+
+    def native_clauses(self):
+        if not _native_semantics_intact(self, in_set):
+            return None
+        vals = list(self._inclusion_values)
+        # numeric/bool membership is the natively-evaluable shape; string and
+        # mixed-type sets keep the Python path (same domain caution as the
+        # vectorized branch above)
+        if not all(isinstance(v, (bool, int, float, np.bool_, np.integer,
+                                  np.floating))
+                   and not isinstance(v, (str, bytes)) for v in vals):
+            return None
+        return [{'field': self._field_name, 'op': 'in', 'values': vals,
+                 'negate': False}]
+
+
+class in_range(PredicateBase):
+    """Keep rows whose scalar field value lies between ``lo`` and ``hi``
+    (either bound optional, inclusivity configurable). This is the canonical
+    natively-pushable range predicate: on qualifying stores the fused kernel
+    evaluates it below the GIL and skips whole pages via min/max page
+    statistics before decoding anything (docs/native.md)."""
+
+    def __init__(self, field_name, lo=None, hi=None, lo_inclusive=True,
+                 hi_inclusive=True):
+        if lo is None and hi is None:
+            raise ValueError('in_range needs at least one bound')
+        self._field_name = field_name
+        self._lo = lo
+        self._hi = hi
+        self._lo_inclusive = bool(lo_inclusive)
+        self._hi_inclusive = bool(hi_inclusive)
+
+    def get_fields(self):
+        return {self._field_name}
+
+    def _in_range(self, v):
+        if self._lo is not None:
+            ok = v >= self._lo if self._lo_inclusive else v > self._lo
+            if not ok:
+                return False
+        if self._hi is not None:
+            ok = v <= self._hi if self._hi_inclusive else v < self._hi
+            if not ok:
+                return False
+        return True
+
+    def do_include(self, values):
+        return bool(self._in_range(values[self._field_name]))
+
+    def do_include_batch(self, block):
+        col = block[self._field_name]
+        if not isinstance(col, np.ndarray) or col.ndim != 1 \
+                or col.dtype.kind not in 'biuf':
+            return None
+        mask = np.ones(len(col), dtype=bool)
+        with np.errstate(invalid='ignore'):
+            if self._lo is not None:
+                mask &= (col >= self._lo) if self._lo_inclusive else (col > self._lo)
+            if self._hi is not None:
+                mask &= (col <= self._hi) if self._hi_inclusive else (col < self._hi)
+        return mask
+
+    def native_clauses(self):
+        if not _native_semantics_intact(self, in_range):
+            return None
+        return [{'field': self._field_name, 'op': 'range', 'lo': self._lo,
+                 'hi': self._hi, 'lo_incl': self._lo_inclusive,
+                 'hi_incl': self._hi_inclusive, 'negate': False}]
 
 
 class in_intersection(PredicateBase):
@@ -174,6 +265,17 @@ class in_negate(PredicateBase):
         inner = _batch_mask(self._predicate, block)
         return None if inner is None else ~np.asarray(inner, dtype=bool)
 
+    def native_clauses(self):
+        if not _native_semantics_intact(self, in_negate):
+            return None
+        inner = getattr(self._predicate, 'native_clauses', lambda: None)()
+        if inner is None or len(inner) != 1:
+            # NOT over an AND of several clauses is not an AND of clauses
+            return None
+        cl = dict(inner[0])
+        cl['negate'] = not cl.get('negate')
+        return [cl]
+
 
 class in_reduce(PredicateBase):
     """Compose predicates with a reduction over their booleans, e.g.
@@ -206,6 +308,19 @@ class in_reduce(PredicateBase):
                 return None
             masks.append(np.asarray(m, dtype=bool))
         return combine(masks)
+
+    def native_clauses(self):
+        if not _native_semantics_intact(self, in_reduce):
+            return None
+        if self._reduce_func is not all:
+            return None  # only conjunctions are an AND of clauses
+        out = []
+        for p in self._predicate_list:
+            cls = getattr(p, 'native_clauses', lambda: None)()
+            if cls is None:
+                return None
+            out.extend(cls)
+        return out or None
 
 
 class in_pseudorandom_split(PredicateBase):

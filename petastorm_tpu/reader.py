@@ -665,6 +665,11 @@ class Reader(object):
         if self._chunk_cache_config is not None:
             from petastorm_tpu.chunkstore.prefetch import ChunkPrefetcher
             prefetch_cols = [n for n in output_schema.fields]
+            if worker_predicate is not None:
+                # predicate columns are read (fused or Arrow) before anything
+                # else in every filtered batch — mirror their chunks too
+                prefetch_cols += [f for f in sorted(worker_predicate.get_fields())
+                                  if f not in prefetch_cols]
             self._chunk_prefetcher = ChunkPrefetcher(
                 self._ventilator, pieces, prefetch_cols,
                 resolver.filesystem_factory(), self._chunk_cache_config)

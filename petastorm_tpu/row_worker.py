@@ -458,6 +458,65 @@ class RowGroupDecoderWorker(WorkerBase):
             table, _ = self._read_table(piece, rest, indices)
         return self._decode_table(table, column_names, piece, pre=pre)
 
+    def _fused_predicate_block(self, pf, piece, column_names, predicate_fields,
+                               predicate, drop_indices):
+        """Native predicate pushdown (docs/native.md): clause evaluation,
+        min/max page-stat skipping, row selection and the decode of ONLY the
+        surviving rows all run inside one GIL-released fused call; Arrow is
+        consulted just for the columns the kernel cannot serve (their rows
+        filtered with the same selection). Returns the decoded block (possibly
+        zero rows), or None when the predicate shape / columns are not
+        natively evaluable — the caller then runs the Python pushdown path."""
+        if not hasattr(pf, 'read_fused_predicate'):
+            return None
+        clauses = getattr(predicate, 'native_clauses', lambda: None)()
+        if clauses is None:
+            return None
+        schema = self.args['schema']
+        if any(f in piece.partition_keys or f not in schema.fields
+               for f in predicate_fields):
+            return None  # partition-key predicates: piece-level path decides
+        transform = self.args.get('transform_spec')
+        physical = [c for c in column_names if c not in piece.partition_keys
+                    and c in schema.fields]
+        if not physical:
+            return None
+        try:
+            res = pf.read_fused_predicate(
+                piece.row_group, physical, predicate_fields, clauses,
+                schema.fields,
+                getattr(transform, 'image_decode_hints', None),
+                getattr(transform, 'image_resize', None))
+        except Exception as e:  # noqa: BLE001 - any surprise: Python pushdown serves it
+            logger.debug('fused predicate read of %s rg=%s failed (%s); Python path',
+                         piece.path, piece.row_group, e)
+            return None
+        if res is None:
+            return None
+        block, _rest, sel_mask, n_selected, _pages_skipped = res
+        kept_global = np.flatnonzero(sel_mask)
+        if drop_indices is not None:
+            # the kernel selected over the FULL row group; narrow both the
+            # fused block and the surviving-row indices to this partition
+            keep = np.isin(kept_global, drop_indices)
+            block = take_block(block, np.flatnonzero(keep))
+            kept_global = kept_global[keep]
+        if not len(kept_global):
+            return {}
+        remaining = [c for c in column_names if c not in block]
+        rem_block = {}
+        if remaining:
+            if any(c not in piece.partition_keys and c in schema.fields
+                   for c in remaining):
+                rem_table, _ = self._read_table(piece, remaining, kept_global)
+                rem_block = self._decode_table(rem_table, remaining, piece)
+            else:
+                rem_block = self._decode_columns(None, remaining, piece, {},
+                                                 schema, {}, {}, transform,
+                                                 len(kept_global))
+        return {name: (block[name] if name in block else rem_block[name])
+                for name in column_names if name in block or name in rem_block}
+
     def _load_block_with_predicate(self, piece, column_names, predicate,
                                    shuffle_row_drop_partition):
         """Predicate pushdown: decode predicate columns first, mask, early-exit,
@@ -473,6 +532,11 @@ class RowGroupDecoderWorker(WorkerBase):
         num_rows = pf.metadata.row_group(piece.row_group).num_rows
         drop_indices = select_row_drop_indices(num_rows, shuffle_row_drop_partition,
                                                self.args['ngram'])
+        fast = self._fused_predicate_block(
+            pf, piece, column_names, predicate_fields, predicate,
+            drop_indices if shuffle_row_drop_partition else None)
+        if fast is not None:
+            return fast or None
         pred_table, _ = self._read_table(piece, predicate_fields, drop_indices
                                          if shuffle_row_drop_partition else None)
         pred_block = self._decode_table(pred_table, predicate_fields, piece)

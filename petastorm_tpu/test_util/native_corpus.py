@@ -41,17 +41,46 @@ def tzigzag(v):
     return tvarint((v << 1) ^ (v >> 63))
 
 
-def plain_page(num_values, itemsize=8, value=0, values=None, encoding=0):
-    """One handwritten v1 data page (thrift compact header + values)."""
+def stats_struct(min_value=None, max_value=None, null_count=None,
+                 max_len=None, min_len=None):
+    """Thrift compact ``Statistics`` struct (fields 3/5/6 — null_count,
+    max_value, min_value). ``max_len``/``min_len`` override the declared
+    binary lengths to build over-declared (corrupt) stats."""
+    out = b''
+    last = 0
+    if null_count is not None:
+        out += bytes([((3 - last) << 4) | 6]) + tzigzag(null_count)
+        last = 3
+    if max_value is not None:
+        out += (bytes([((5 - last) << 4) | 8])
+                + tvarint(len(max_value) if max_len is None else max_len)
+                + max_value)
+        last = 5
+    if min_value is not None:
+        out += (bytes([((6 - last) << 4) | 8])
+                + tvarint(len(min_value) if min_len is None else min_len)
+                + min_value)
+        last = 6
+    return out + b'\x00'
+
+
+def plain_page(num_values, itemsize=8, value=0, values=None, encoding=0,
+               declared_raw=None, stats=None):
+    """One handwritten v1 data page (thrift compact header + values).
+    ``declared_raw`` overrides the declared UNCOMPRESSED size (for pages whose
+    ``values`` bytes are a handwritten compressed frame); ``stats`` embeds a
+    :func:`stats_struct` as DataPageHeader field 5."""
     if values is None:
         values = struct.pack('<q', value)[:itemsize] * num_values
     dph = (bytes([0x15]) + tzigzag(num_values)   # 1: num_values
            + bytes([0x15]) + tzigzag(encoding)   # 2: encoding
            + bytes([0x15]) + tzigzag(3)          # 3: def-levels RLE
            + bytes([0x15]) + tzigzag(3)          # 4: rep-levels RLE
+           + (bytes([0x1C]) + stats if stats is not None else b'')  # 5: stats
            + b'\x00')
+    raw_len = len(values) if declared_raw is None else declared_raw
     header = (bytes([0x15]) + tzigzag(0)                  # 1: type DATA_PAGE
-              + bytes([0x15]) + tzigzag(len(values))      # 2: uncompressed
+              + bytes([0x15]) + tzigzag(raw_len)          # 2: uncompressed
               + bytes([0x15]) + tzigzag(len(values))      # 3: compressed
               + bytes([0x2C]) + dph                       # 5: DataPageHeader
               + b'\x00')
@@ -112,6 +141,151 @@ def overflow_dict_chunk():
     return dict_page(1 << 61, dict_vals) + plain_page(4, values=idx, encoding=2)
 
 
+# ---------------------------------------------------------------------------
+# handwritten zstd / lz4 frames (PR 15): the first-party decompressors are
+# driven with frames no real encoder emits — truncations, over-declared
+# content sizes, corrupt compressed blocks — plus byte-exact positive
+# controls built from raw/RLE blocks only (no entropy coding needed).
+# ---------------------------------------------------------------------------
+
+ZSTD_MAGIC = 0xFD2FB528
+LZ4_FRAME_MAGIC = 0x184D2204
+
+
+def zstd_frame_bytes(payload, content_size=None, block_kind='raw'):
+    """One handwritten RFC 8878 frame: single-segment header with a 4-byte
+    frame-content-size, then ONE block. ``block_kind``:
+
+    * ``'raw'`` — a stored block carrying ``payload`` verbatim;
+    * ``'rle'`` — an RLE block regenerating ``len(payload)`` copies of
+      ``payload[0]``;
+    * ``'corrupt'`` — a block flagged COMPRESSED whose body is ``payload``
+      (garbage to the FSE/huffman parsers: must be rejected, never decoded).
+
+    ``content_size`` overrides the declared frame content size (over- or
+    under-declaring what the block regenerates)."""
+    if content_size is None:
+        content_size = len(payload)
+    fhd = (2 << 6) | 0x20  # fcs_code 2 (4-byte FCS) + single-segment
+    out = struct.pack('<I', ZSTD_MAGIC) + bytes([fhd]) + struct.pack(
+        '<I', content_size)
+    if block_kind == 'raw':
+        bh = (len(payload) << 3) | 1                  # type 0 (raw), last
+        out += struct.pack('<I', bh)[:3] + payload
+    elif block_kind == 'rle':
+        bh = (len(payload) << 3) | (1 << 1) | 1       # type 1 (RLE), last
+        out += struct.pack('<I', bh)[:3] + payload[:1]
+    else:
+        bh = (len(payload) << 3) | (2 << 1) | 1       # type 2 (compressed)
+        out += struct.pack('<I', bh)[:3] + payload
+    return out
+
+
+def lz4_raw_block_bytes(payload):
+    """One raw LZ4 block holding ``payload`` as a single literals-only final
+    sequence (valid per spec: the last sequence carries no match)."""
+    lit = len(payload)
+    out = bytearray([min(lit, 15) << 4])
+    if lit >= 15:
+        rem = lit - 15
+        while rem >= 255:
+            out.append(255)
+            rem -= 255
+        out.append(rem)
+    return bytes(out) + payload
+
+
+def lz4_raw_match_block():
+    """A raw LZ4 block exercising the overlapping match-copy path: 4
+    literals, a match of 8 at offset 4 (self-overlapping), then a
+    literals-only tail. Returns ``(block_bytes, decoded_bytes)``."""
+    block = (bytes([(4 << 4) | (8 - 4)]) + b'abcd' + struct.pack('<H', 4)
+             + bytes([2 << 4]) + b'zz')
+    return block, b'abcd' * 3 + b'zz'
+
+
+def lz4_frame_bytes(payload, stored=False):
+    """One handwritten LZ4 frame (magic, FLG/BD/HC, one block, EndMark).
+    ``stored=True`` writes the block uncompressed (high bit of the size)."""
+    flg = (1 << 6) | 0x20  # version 01, block-independent
+    out = struct.pack('<I', LZ4_FRAME_MAGIC) + bytes([flg, 0x40, 0])
+    block = payload if stored else lz4_raw_block_bytes(payload)
+    bsz = len(block) | (0x80000000 if stored else 0)
+    return out + struct.pack('<I', bsz) + block + struct.pack('<I', 0)
+
+
+def lz4_hadoop_bytes(payload, declared_raw=None):
+    """One hadoop-framed LZ4 chunk (what parquet's legacy LZ4 codec writes):
+    big-endian [decompressed size][compressed size] then a raw block.
+    ``declared_raw`` over/under-declares the decompressed size."""
+    block = lz4_raw_block_bytes(payload)
+    want = len(payload) if declared_raw is None else declared_raw
+    return struct.pack('>II', want, len(block)) + block
+
+
+def compressed_frame_corpus():
+    """(chunk_bytes, codec, expect_ok) triples: handwritten compressed pages
+    driven through the fused kernel — positive controls that MUST decode
+    byte-exactly, and malformed frames that MUST be rejected with a status.
+    Replayed by the release fuzz lane and the ASan/UBSan lane alike."""
+    vals = struct.pack('<qqqq', 7, 7, 7, 7)
+    # an RLE block regenerates payload[0] x declared-size, so its positive
+    # control uses a byte-uniform payload that IS its own regeneration
+    rle_vals = b'\x07' * 32
+    return [
+        # -- positive controls (expect_ok=True, output must equal expect bytes)
+        (plain_page(4, values=zstd_frame_bytes(vals), declared_raw=32), 2, True, vals),
+        (plain_page(4, values=zstd_frame_bytes(rle_vals, block_kind='rle'),
+                    declared_raw=32), 2, True, rle_vals),
+        (plain_page(4, values=lz4_raw_block_bytes(vals), declared_raw=32), 3, True, vals),
+        (plain_page(4, values=lz4_frame_bytes(vals), declared_raw=32), 4, True, vals),
+        (plain_page(4, values=lz4_frame_bytes(vals, stored=True),
+                    declared_raw=32), 4, True, vals),
+        (plain_page(4, values=lz4_hadoop_bytes(vals), declared_raw=32), 4, True, vals),
+        # -- truncated frames: every prefix check must hold
+        (plain_page(4, values=zstd_frame_bytes(vals)[:11], declared_raw=32), 2, False, vals),
+        (plain_page(4, values=lz4_raw_block_bytes(vals)[:3], declared_raw=32), 3, False, vals),
+        (plain_page(4, values=lz4_hadoop_bytes(vals)[:7], declared_raw=32), 4, False, vals),
+        # -- over-declared sizes: the declared regeneration exceeds reality
+        (plain_page(4, values=zstd_frame_bytes(vals, content_size=1 << 20),
+                    declared_raw=32), 2, False, vals),
+        (plain_page(4, values=lz4_hadoop_bytes(vals, declared_raw=1 << 20),
+                    declared_raw=32), 4, False, vals),
+        # -- under-declared: frame regenerates more than the page claims
+        (plain_page(4, values=zstd_frame_bytes(vals * 2, content_size=16),
+                    declared_raw=32), 2, False, vals),
+        # -- corrupt compressed block: garbage to the FSE/huffman parsers
+        (plain_page(4, values=zstd_frame_bytes(b'\x9e\x42' * 8,
+                                               block_kind='corrupt'),
+                    declared_raw=32), 2, False, vals),
+        # -- codec mismatch: a valid zstd frame fed to the lz4 decoder
+        (plain_page(4, values=zstd_frame_bytes(vals), declared_raw=32), 3, False, vals),
+    ]
+
+
+def page_stats_corpus():
+    """Pages with handwritten min/max Statistics, valid and corrupt — the
+    page-stat parser must bounds-check declared binary lengths."""
+    vals = struct.pack('<qqqq', 1, 2, 3, 4)
+    lo, hi = struct.pack('<q', 1), struct.pack('<q', 4)
+    return [
+        # valid stats: page decodes, stats parse
+        (plain_page(4, values=vals,
+                    stats=stats_struct(min_value=lo, max_value=hi,
+                                       null_count=0)), True),
+        # over-declared binary length: must be rejected at header-parse time,
+        # never read past the chunk
+        (plain_page(4, values=vals,
+                    stats=stats_struct(min_value=lo, max_value=hi,
+                                       max_len=1 << 20)), False),
+        (plain_page(4, values=vals,
+                    stats=stats_struct(min_value=lo, min_len=1 << 20)), False),
+        # stats struct with only a null count (min/max absent): decodes fine,
+        # the skip logic must simply distrust the page
+        (plain_page(4, values=vals, stats=stats_struct(null_count=2)), True),
+    ]
+
+
 def fuzz_corpus(seed=0xF05ED, mutated=150, garbage=60, max_garbage=96):
     """The seeded corpus the release fuzz test replays: byte mutations /
     truncations / splices of a valid two-page chunk, then pure garbage.
@@ -157,19 +331,77 @@ def replay_chunk_through_kernels(lib, data, reason_by_status):
         assert -1 <= n <= 16, n
     if chunk.size == 0:
         return
-    for mode, codec in ((0, 0), (0, 1), (1, 0), (1, 1)):
-        plan = fused.ColumnPlan('f')
-        plan.mode = mode
-        plan.codec = codec
-        plan.itemsize = 8
-        plan.strip_npy = mode == 1
-        plan.out_dtype = np.dtype(np.int64)
-        plan.out_shape = (4,)
-        plan.chunk_len = chunk.size
-        plan.out_bound = 64
-        out = np.zeros(64, np.uint8)
-        (res,) = fused.read_into(lib, [chunk], [plan], 4, out, [0])
-        assert res[0] in reason_by_status or res[0] == 0, res
+    # every mode x codec the dispatch accepts: UNCOMPRESSED, SNAPPY, ZSTD,
+    # LZ4_RAW and auto-detected LZ4 all walk the same page/decompress path
+    for mode in (0, 1):
+        for codec in (0, 1, 2, 3, 4):
+            plan = fused.ColumnPlan('f')
+            plan.mode = mode
+            plan.codec = codec
+            plan.itemsize = 8
+            plan.strip_npy = mode == 1
+            plan.out_dtype = np.dtype(np.int64)
+            plan.out_shape = (4,)
+            plan.chunk_len = chunk.size
+            plan.out_bound = 64
+            out = np.zeros(64, np.uint8)
+            (res,) = fused.read_into(lib, [chunk], [plan], 4, out, [0])
+            assert res[0] in reason_by_status or res[0] == 0, res
+    replay_chunk_through_pred_kernel(lib, chunk)
+
+
+def _pred_plan_for_chunk(fused, chunk, codec):
+    plan = fused.ColumnPlan('f')
+    plan.mode = 0
+    plan.codec = codec
+    plan.itemsize = 8
+    plan.phys_dtype = np.dtype(np.int64)
+    plan.out_dtype = np.dtype(np.int64)
+    plan.out_shape = (4,)
+    plan.chunk_len = chunk.size
+    plan.out_bound = 64
+    plan.known_size = True
+    return plan
+
+
+def replay_chunk_through_pred_kernel(lib, chunk):
+    """Drive one chunk through the fused *predicate* entry point: the chunk
+    serves as both the output column and the predicate column, under an IN
+    clause and a negated RANGE clause. The kernel must honour the same
+    sentinel contract as the unfiltered pass — a selection bitmap and status,
+    never a crash or over-read (the ASan lane replays this identically)."""
+    from petastorm_tpu.native import fused
+
+    operand = np.arange(2, dtype=np.int64).view(np.uint8)
+    bound = np.zeros(16, dtype=np.uint8)
+    for codec in (0, 1, 2, 3, 4):
+        plan = _pred_plan_for_chunk(fused, chunk, codec)
+        pred_plan = _pred_plan_for_chunk(fused, chunk, codec)
+        for op, negate in ((fused.PRED_IN, 0), (fused.PRED_RANGE, 1)):
+            preds = (fused.FusedPredStruct * 1)()
+            pr = preds[0]
+            if op == fused.PRED_IN:
+                pr.values = operand.ctypes.data
+                pr.values_cap = operand.nbytes
+                pr.count = 2
+            else:
+                pr.values = bound.ctypes.data
+                pr.values_cap = bound.nbytes
+                pr.count = 0
+                pr.has_lo = 1
+                pr.lo_incl = 1
+            pr.col = 0
+            pr.op = op
+            pr.dtype = 1  # i64
+            pr.negate = negate
+            plan_obj = fused.FusedPlan([plan], [], {}, 4)
+            res = fused.read_block_pred(lib, [chunk], plan_obj, [chunk],
+                                        [pred_plan], preds,
+                                        [operand, bound])
+            if res is not None:
+                _block, _reasons, sel_mask, n_selected, _skipped = res
+                assert 0 <= n_selected <= 4
+                assert int(sel_mask.sum()) == n_selected, (n_selected, sel_mask)
 
 
 def replay_corrupt_chunk_regressions(lib):
@@ -226,6 +458,57 @@ def replay_corrupt_chunk_regressions(lib):
     res2 = fused.read_into(lib, [chunk2, chunk2], [bad, good], 2, out2, [0, 16])
     assert res2[0][0] != 0 and res2[1][0] == 0, res2
     assert res2[1][3] > 0 and res2[1][4] == cells[0][:res2[1][3]], res2
+
+    replay_compressed_frames(lib)
+    replay_page_stats(lib)
+
+
+def replay_compressed_frames(lib):
+    """Handwritten zstd/lz4 frames through the fused kernel: positive
+    controls must decode byte-exactly, malformed frames must be rejected
+    with a status — never a crash or over-read."""
+    from petastorm_tpu.native import fused
+
+    for data, codec, expect_ok, vals in compressed_frame_corpus():
+        chunk = np.frombuffer(data, dtype=np.uint8)
+        plan = fused.ColumnPlan('c')
+        plan.codec = codec
+        plan.itemsize = 8
+        plan.phys_dtype = np.dtype(np.int64)
+        plan.out_dtype = np.dtype(np.int64)
+        plan.out_shape = (4,)
+        plan.chunk_len = chunk.size
+        plan.out_bound = len(vals)
+        out = np.zeros(len(vals), np.uint8)
+        (res,) = fused.read_into(lib, [chunk], [plan], 4, out, [0])
+        if expect_ok:
+            assert res[0] == 0, (res, codec)
+            assert bytes(out) == vals, (codec, bytes(out))
+        else:
+            assert res[0] != 0, (res, codec)
+
+
+def replay_page_stats(lib):
+    """Pages carrying handwritten Statistics structs: valid stats must not
+    disturb the decode, over-declared binary lengths must be rejected at
+    header-parse time."""
+    from petastorm_tpu.native import fused
+
+    for data, expect_ok in page_stats_corpus():
+        chunk = np.frombuffer(data, dtype=np.uint8)
+        plan = fused.ColumnPlan('s')
+        plan.itemsize = 8
+        plan.phys_dtype = np.dtype(np.int64)
+        plan.out_dtype = np.dtype(np.int64)
+        plan.out_shape = (4,)
+        plan.chunk_len = chunk.size
+        plan.out_bound = 32
+        out = np.zeros(32, np.uint8)
+        (res,) = fused.read_into(lib, [chunk], [plan], 4, out, [0])
+        if expect_ok:
+            assert res[0] == 0, res
+        else:
+            assert res[0] != 0, res
 
 
 def replay_ring_cycles(ring_mod, name_suffix):

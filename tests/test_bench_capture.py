@@ -90,7 +90,7 @@ def test_main_emits_headline_line(monkeypatch, capsys):
 
     monkeypatch.setattr(bench, '_probe_tpu', lambda *a, **k: ('none', 0))
     monkeypatch.setattr(bench, '_prebuild_native', lambda: None)
-    monkeypatch.setattr(bench, '_ensure_dataset', lambda url: None)
+    monkeypatch.setattr(bench, '_ensure_dataset', lambda url, **kw: None)
     monkeypatch.setattr(bench, '_warm', lambda url: None)
     monkeypatch.setattr(bench, '_duty_section',
                         lambda **kw: {'skipped': True, 'reason': 'stubbed'})
@@ -110,6 +110,11 @@ def test_main_emits_headline_line(monkeypatch, capsys):
     assert rec['duty'] == {'skipped': True, 'reason': 'stubbed'}
     # default capture runs at counters level: no critical-path block
     assert rec['critical_path'] is None
+    # compression knob defaults: snappy store, sweep only on request, and the
+    # predicate-share key is always present so round-over-round diffs line up
+    assert rec['compression'] == 'snappy'
+    assert rec['compression_sweep'] is None
+    assert 'fused_predicate_share' in rec
 
 
 def test_critical_path_section_spans_level():
@@ -178,6 +183,15 @@ def test_select_runs_contended_capture_reports_all():
     assert excluded == [] and mad_excluded == []
     assert value == pytest.approx(5000.0)
     assert spread == spread_all
+
+
+def test_fused_predicate_share():
+    """The headline's predicate-share metric: pred batches over all fused
+    batches; None when nothing fused (no fabricated 0.0 from a dead capture)."""
+    assert bench._fused_predicate_share({}) is None
+    assert bench._fused_predicate_share({'fused_batches_total': 8}) == 0.0
+    assert bench._fused_predicate_share(
+        {'fused_batches_total': 8, 'fused_pred_batches_total': 2}) == 0.25
 
 
 # ---------------------------------------------------------------------------

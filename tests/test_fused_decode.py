@@ -33,6 +33,7 @@ from petastorm_tpu import observability as obs
 from petastorm_tpu.codecs import (CompressedImageCodec, NdarrayCodec, RawTensorCodec,
                                   ScalarCodec)
 from petastorm_tpu.etl.dataset_metadata import write_petastorm_dataset
+from petastorm_tpu.predicates import in_negate, in_range, in_reduce, in_set
 from petastorm_tpu.unischema import Unischema, UnischemaField
 
 native = pytest.importorskip('petastorm_tpu.native')
@@ -80,7 +81,7 @@ def _write_scalar_store(tmp_path, compression, repeated):
     return url, schema, rows
 
 
-@pytest.mark.parametrize('compression', ['snappy', 'none'])
+@pytest.mark.parametrize('compression', ['snappy', 'zstd', 'lz4', 'none'])
 @pytest.mark.parametrize('repeated', [True, False], ids=['rle-runs', 'bit-packed'])
 def test_scalar_parity_all_types(tmp_path, compression, repeated):
     url, schema, rows = _write_scalar_store(tmp_path, compression, repeated)
@@ -102,7 +103,7 @@ def test_scalar_parity_all_types(tmp_path, compression, repeated):
             np.testing.assert_array_equal(block[name], ref)
 
 
-@pytest.mark.parametrize('compression', ['snappy', 'none'])
+@pytest.mark.parametrize('compression', ['snappy', 'zstd', 'lz4', 'none'])
 @pytest.mark.parametrize('dictionary', [True, False], ids=['dict', 'plain'])
 def test_data_page_v2_parity(tmp_path, compression, dictionary):
     """DATA_PAGE_V2 chunks (previously a blanket ``fused_fallback_reason:
@@ -491,6 +492,152 @@ def test_precheck_failed_column_keeps_aux_alignment():
 
 
 # ---------------------------------------------------------------------------
+# native predicate pushdown: parity, page-stat skipping, single GIL call
+# ---------------------------------------------------------------------------
+
+def _pred_cases():
+    """Every natively-pushable clause shape, each with a Python ``do_include``
+    oracle the fused verdicts must match row-for-row. Store values are
+    ``i * 7 + 1`` for i in [0, 64) = 1..442 in row groups of 16."""
+    return [
+        ('range', in_range('c_int64', lo=100, hi=300)),
+        ('range-exclusive', in_range('c_int64', lo=106, hi=302,
+                                     lo_inclusive=False, hi_inclusive=False)),
+        ('in', in_set([1, 106, 441, 9999], 'c_int64')),
+        ('not-in', in_negate(in_set([1, 106, 442], 'c_int64'))),
+        ('and', in_reduce([in_range('c_int64', lo=50),
+                           in_range('c_float64', hi=200.0)], all)),
+        ('float-range', in_range('c_float64', lo=33.5)),
+    ]
+
+
+@pytest.mark.parametrize('compression', ['snappy', 'zstd', 'lz4', 'none'])
+def test_fused_predicate_parity(tmp_path, compression):
+    """The filtered fused read returns exactly the rows the predicate's own
+    ``do_include`` keeps — every clause shape, every codec — with zero
+    ``predicate`` fallbacks."""
+    url, schema, rows = _write_scalar_store(tmp_path, compression,
+                                            repeated=False)
+    path = _parquet_path(tmp_path / 'store')
+    pf = native.NativeParquetFile(path)
+    md = pq.read_metadata(path)
+    cols = list(schema.fields)
+    obs.get_registry().reset()
+    obs.configure('counters')
+    for label, pred in _pred_cases():
+        clauses = pred.native_clauses()
+        assert clauses is not None, label
+        fields = sorted(pred.get_fields())
+        expect = [r for r in rows
+                  if pred.do_include({f: r[f] for f in pred.get_fields()})]
+        got = []
+        for rg in range(md.num_row_groups):
+            res = pf.read_fused_predicate(rg, cols, fields, clauses,
+                                          schema.fields)
+            assert res is not None, (label, compression, rg)
+            block, rest, sel_mask, n_selected, _skipped = res
+            assert rest == [], (label, rest)
+            assert int(sel_mask.sum()) == n_selected
+            for k in range(n_selected):
+                got.append({name: block[name][k] for name in cols})
+        assert len(got) == len(expect), label
+        for g, e in zip(got, expect):
+            for name in cols:
+                assert g[name] == e[name], (label, name)
+    counters = _counters()
+    assert not any(':predicate' in k for k in counters), counters
+    assert counters.get('fused_pred_batches_total', 0) > 0
+
+
+@pytest.mark.parametrize('compression', ['snappy', 'zstd'])
+def test_fused_predicate_page_stat_skip(tmp_path, compression):
+    """A row group whose single data page is excluded wholesale by its
+    min/max page statistics decodes NOTHING: zero selected rows and a
+    nonzero page-skip count (strictly less decode work than an unfiltered
+    read — the acceptance contract)."""
+    url, schema, rows = _write_scalar_store(tmp_path, compression,
+                                            repeated=False)
+    pf = native.NativeParquetFile(_parquet_path(tmp_path / 'store'))
+    cols = list(schema.fields)
+    # row group 3 holds values 337..442; hi=100 excludes every page by stats
+    pred = in_range('c_int64', hi=100)
+    res = pf.read_fused_predicate(3, cols, ['c_int64'],
+                                  pred.native_clauses(), schema.fields)
+    assert res is not None
+    block, rest, sel_mask, n_selected, skipped = res
+    assert n_selected == 0 and not sel_mask.any()
+    assert skipped > 0
+    for name in block:
+        assert len(block[name]) == 0
+
+
+def test_reader_native_predicate_end_to_end(tmp_path):
+    """make_reader with a composed pushable predicate on a zstd store: the
+    row set matches the Python oracle, batches ride the fused predicate
+    stage, pages get stat-skipped, and no predicate column falls back."""
+    url, schema, rows = _write_scalar_store(tmp_path, 'zstd', repeated=False)
+    obs.get_registry().reset()
+    obs.configure('counters')
+    pred = in_reduce([in_range('c_int64', lo=100, hi=300),
+                      in_negate(in_set([106], 'c_int64'))], all)
+    with make_reader(url, predicate=pred, reader_pool_type='dummy',
+                     shuffle_row_groups=False, num_epochs=1) as reader:
+        got = sorted(int(r.c_int64) for r in reader)
+    expect = sorted(int(r['c_int64']) for r in rows
+                    if 100 <= r['c_int64'] <= 300 and r['c_int64'] != 106)
+    assert got == expect
+    counters = _counters()
+    assert counters.get('fused_pred_batches_total', 0) > 0
+    assert counters.get('fused_pred_pages_skipped_total', 0) > 0
+    assert not any(':predicate' in k for k in counters), counters
+
+
+def test_one_gil_transition_per_filtered_batch(tmp_path, monkeypatch):
+    """Structural twin of the unfiltered one-GIL test: predicate evaluation,
+    page skipping and selected-row collation are ONE native call — and the
+    unfiltered entry point is never touched on the side."""
+    url, schema, rows = _write_scalar_store(tmp_path, 'snappy', repeated=False)
+    pf = native.NativeParquetFile(_parquet_path(tmp_path / 'store'))
+    cols = list(schema.fields)
+    pred_calls, unfiltered_calls = [], []
+    real = fused._invoke_read_fused_pred
+    monkeypatch.setattr(fused, '_invoke_read_fused_pred',
+                        lambda *a: (pred_calls.append(a), real(*a))[1])
+    monkeypatch.setattr(fused, '_invoke_read_fused',
+                        lambda *a: unfiltered_calls.append(a))
+    pred = in_range('c_int64', lo=100, hi=300)
+    res = pf.read_fused_predicate(0, cols, ['c_int64'],
+                                  pred.native_clauses(), schema.fields)
+    assert res is not None
+    block, rest, _sel_mask, n_selected, _skipped = res
+    assert rest == [] and n_selected > 0
+    assert len(pred_calls) == 1   # ONE native transition, filter included
+    assert not unfiltered_calls
+
+
+@pytest.mark.parametrize('compression', ['snappy', 'zstd', 'lz4', 'none'])
+def test_write_compression_knob_roundtrip(tmp_path, compression):
+    """The materialize-side ``compression=`` knob round-trips through every
+    supported codec: the written chunks carry the requested codec and the
+    reader serves bit-exact rows with zero compression fallbacks."""
+    url, schema, rows = _write_scalar_store(tmp_path, compression,
+                                            repeated=True)
+    md = pq.read_metadata(_parquet_path(tmp_path / 'store'))
+    written = md.row_group(0).column(0).compression
+    if compression == 'none':
+        assert written == 'UNCOMPRESSED'
+    else:
+        assert written.lower().startswith(compression[:3])
+    obs.get_registry().reset()
+    obs.configure('counters')
+    with make_reader(url, reader_pool_type='dummy', shuffle_row_groups=False,
+                     num_epochs=1) as reader:
+        got = sorted(int(r.c_int64) for r in reader)
+    assert got == sorted(int(r['c_int64']) for r in rows)
+    assert _counters().get('fused_fallback_reason:compression', 0) == 0
+
+
+# ---------------------------------------------------------------------------
 # robustness / fuzz: malformed bytes must return the sentinel, never crash
 # ---------------------------------------------------------------------------
 
@@ -513,6 +660,52 @@ def test_fuzz_snappy_and_hybrid_hypothesis():
     @hypothesis.given(st.binary(max_size=160))
     def run(data):
         _fuzz_one(lib, data)
+
+    run()
+
+
+def test_fuzz_compressed_frames_corpus():
+    """The handwritten zstd/lz4 frame corpus against the release kernel:
+    positive controls decode byte-exactly, malformed frames are rejected
+    (the same corpus replays under ASan/UBSan in test_sanitized_native)."""
+    native_corpus.replay_compressed_frames(native._load_library())
+    native_corpus.replay_page_stats(native._load_library())
+
+
+def test_fuzz_compressed_frames_hypothesis():
+    """Single-byte flips over every handwritten zstd/lz4 frame, replayed
+    through every decompressor dispatch AND the predicate kernel: the
+    sentinel contract must hold at any mutation site."""
+    hypothesis = pytest.importorskip('hypothesis')
+    from hypothesis import strategies as st
+    lib = native._load_library()
+    frames = [bytes(case) for case, _codec, _ok, _vals
+              in native_corpus.compressed_frame_corpus()]
+
+    @hypothesis.settings(max_examples=150, deadline=None)
+    @hypothesis.given(st.data())
+    def run(data):
+        raw = data.draw(st.sampled_from(frames))
+        pos = data.draw(st.integers(0, len(raw) - 1))
+        val = data.draw(st.integers(0, 255))
+        mutated = bytearray(raw)
+        mutated[pos] = val
+        _fuzz_one(lib, bytes(mutated))
+
+    run()
+
+
+def test_fuzz_page_stats_hypothesis():
+    """Random bytes spliced in as the v1 Statistics struct: the page-header
+    stats parser must parse or reject without ever reading past the chunk."""
+    hypothesis = pytest.importorskip('hypothesis')
+    from hypothesis import strategies as st
+    lib = native._load_library()
+
+    @hypothesis.settings(max_examples=120, deadline=None)
+    @hypothesis.given(st.binary(max_size=48))
+    def run(stats):
+        _fuzz_one(lib, _plain_page(4, stats=stats + b'\x00'))
 
     run()
 
@@ -651,7 +844,8 @@ def test_process_pool_inplace_fused_publish(tmp_path):
 # end-to-end: the bench-shaped store rides fully fused with zero fallbacks
 # ---------------------------------------------------------------------------
 
-def test_hello_world_shaped_store_fully_fused(tmp_path):
+@pytest.mark.parametrize('compression', ['snappy', 'zstd'])
+def test_hello_world_shaped_store_fully_fused(tmp_path, compression):
     pytest.importorskip('cv2')
     from petastorm_tpu.native import image_codec
     if not image_codec.is_available():
@@ -667,7 +861,8 @@ def test_hello_world_shaped_store_fully_fused(tmp_path):
              'image1': rng.integers(0, 255, (16, 24, 3), np.uint8),
              'array_4d': rng.integers(0, 255, (2, 4, 5, 3), np.uint8)}
             for i in range(30)]
-    write_petastorm_dataset(url, schema, iter(rows), rows_per_row_group=10)
+    write_petastorm_dataset(url, schema, iter(rows), rows_per_row_group=10,
+                            compression=compression)
     obs.get_registry().reset()
     obs.configure('counters')
     with make_reader(url, reader_pool_type='thread', workers_count=2,

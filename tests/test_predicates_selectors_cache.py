@@ -255,6 +255,37 @@ def test_weighted_sampling_end_to_end(synthetic_dataset):
     mixed.stop(); mixed.join()
 
 
+def test_native_clauses_decline_on_overridden_semantics():
+    """A subclass that overrides do_include/do_include_batch changed the
+    predicate's meaning — the inherited native_clauses must decline so the
+    fused pushdown never evaluates the BASE semantics below the GIL."""
+    from petastorm_tpu.predicates import in_range
+
+    class RowOverride(in_set):
+        def do_include(self, values):
+            return True
+
+    class BatchOverride(in_range):
+        def do_include_batch(self, block):
+            return None
+
+    class PlainSub(in_set):
+        pass
+
+    assert RowOverride([1], 'x').native_clauses() is None
+    assert BatchOverride('x', lo=0).native_clauses() is None
+    # wrappers around an overridden inner predicate decline transitively
+    assert in_negate(RowOverride([1], 'x')).native_clauses() is None
+    assert in_reduce([RowOverride([1], 'x')], all).native_clauses() is None
+    # an overridden WRAPPER declines even over a clean inner predicate
+    class NegOverride(in_negate):
+        def do_include(self, values):
+            return True
+    assert NegOverride(in_set([1], 'x')).native_clauses() is None
+    # a subclass that overrides neither keeps the native path
+    assert PlainSub([1], 'x').native_clauses() is not None
+
+
 def test_in_set_mixed_type_values_keep_row_semantics():
     # np.isin silently coerces ['a', 1] to unicode and stops matching ints;
     # the batched path must decline so per-row semantics win

@@ -1229,6 +1229,25 @@ def test_pt903_mult_form_bound_flagged(tmp_path):
     assert codes == ['PT903']
 
 
+def test_pt903_gather_dict_bound_flagged(tmp_path):
+    """The decompressor-fed twin in the filtered gather: the DECOMPRESSED
+    dictionary region's bound must stay division-form — a corrupt zstd/lz4
+    page declaring a huge count would wrap the product past the check."""
+    codes = _mutant_codes(tmp_path, {'rowgroup_reader.cpp': [(
+        'if (dict_n > vlen / w) return kColDict;',
+        'if (dict_n * w > vlen) return kColDict;')]}, ['PT903'])
+    assert codes == ['PT903']
+
+
+def test_pt903_gather_plain_bound_flagged(tmp_path):
+    """The PLAIN gather's decompressed values-region bound: num_values * w
+    wraps for a corrupt page of a compressed chunk."""
+    codes = _mutant_codes(tmp_path, {'rowgroup_reader.cpp': [(
+        'if (nv > vlen / w) return kColBounds;',
+        'if (nv * w > vlen) return kColBounds;')]}, ['PT903'])
+    assert codes == ['PT903']
+
+
 def test_pt904_dropped_capacity_check_flagged(tmp_path):
     """Dropping the aux_cap check before the aux_buf memcpy fires PT904."""
     codes = _mutant_codes(tmp_path, {'rowgroup_reader.cpp': [(
