@@ -70,6 +70,17 @@ Rule families (see ``docs/analysis.md`` for bad/good examples):
   crossing a pickle/queue/zmq/ring boundary uncopied; PT1103: a borrow's
   manual release reachable only on some paths (``analysis/lifetime.py``,
   the static half of ``native/lifetime.py``).
+* **PT1300–PT1303** whole-program thread races — ONE model over all the
+  concurrency domains (``analysis/races.py``): cross-module lock-order
+  cycles with call-graph edge propagation (PT1300 — PT101 keeps class-local
+  cycles, PT1300 owns everything deeper or wider); reads of lock-guarded
+  mutable containers with no lock held, with guarded-by inference that
+  follows ``self`` helper calls (PT1301); lock-guarded containers escaping
+  via return/yield/store so callers mutate them un-guarded (PT1302);
+  blocking calls — unbounded ``Condition.wait``/``Event.wait``, blocking
+  ``queue.get/put``, ``join``, ``time.sleep``, elastic lease I/O — made
+  while holding a lock (PT1303). The static half of the deterministic
+  schedule explorer (``analysis/schedule/``, ``petastorm-tpu-race``).
 * **PT1200** elastic shard-map determinism — shard maps must be pure
   functions of ``(seed, epoch, members)``: wall-clock reads, module-global
   RNG draws, RNG constructors without an explicit seed, and iteration over
@@ -100,6 +111,7 @@ from petastorm_tpu.analysis.lifecycle import ResourceLifecycleChecker
 from petastorm_tpu.analysis.lifetime import LifetimeChecker
 from petastorm_tpu.analysis.locks import LockDisciplineChecker
 from petastorm_tpu.analysis.protocol_lints import ProtocolLintChecker
+from petastorm_tpu.analysis.races import RaceChecker
 from petastorm_tpu.analysis.serve_lints import ServeActuatorChecker
 from petastorm_tpu.analysis.telemetry import TelemetrySpanChecker
 from petastorm_tpu.analysis.trace_lints import TraceContextChecker
@@ -122,6 +134,7 @@ ALL_CHECKERS = (
     CppSafetyChecker,
     LifetimeChecker,
     ElasticDeterminismChecker,
+    RaceChecker,
 )
 
 #: every individual rule id the registered checkers can emit — the linter
@@ -165,7 +178,8 @@ __all__ = [
     'ElasticDeterminismChecker', 'ExceptionHygieneChecker', 'Finding',
     'HashabilityChecker', 'JaxPurityChecker', 'LifetimeChecker',
     'LockDisciplineChecker',
-    'NativeBufferChecker', 'ProtocolLintChecker', 'ResourceLifecycleChecker', 'ServeActuatorChecker',
+    'NativeBufferChecker', 'ProtocolLintChecker', 'RaceChecker',
+    'ResourceLifecycleChecker', 'ServeActuatorChecker',
     'SourceFile', 'TelemetrySpanChecker', 'TraceContextChecker',
     'collect_sources', 'load_baseline', 'run_analysis', 'run_checkers',
 ]

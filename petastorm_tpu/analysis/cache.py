@@ -30,6 +30,15 @@ key.
 baseline applied. Baseline absorption and ``--select``/``--ignore`` are view
 filters over the analysis, not part of it — they are re-applied on every
 run, so switching flags never needs a re-scan and never poisons the cache.
+
+**The whole-program pass** (the PT13xx race lints) does not fit per-file
+caching — its findings depend on every in-scope file at once. It gets one
+content-addressed entry instead, keyed by :func:`program_pass_key` (the
+analysis fingerprint plus relpath+bytes of every file matching the program
+checkers' scope). A warm run costs one hash sweep over the scoped files and
+one JSON read; a ``--changed`` run passes the full listing via
+``program_entries`` so cross-module properties are never derived from a
+subset.
 """
 
 from __future__ import annotations
@@ -253,27 +262,90 @@ class ResultCache(object):
         os.replace(tmp, os.path.join(self.dir, _INDEX_NAME))
         self._index_dirty = False
 
+    # direct keyed entries — the whole-program pass addresses its result by
+    # an aggregate digest rather than a single file's stamp
+
+    def lookup_key(self, key):
+        try:
+            with open(os.path.join(self.dir, key + '.json')) as f:
+                payload = json.load(f)
+        except (OSError, ValueError):
+            return None
+        self.hits += 1
+        return [_finding_from_dict(d) for d in payload]
+
+    def store_key(self, key, findings):
+        self.misses += 1
+        tmp = os.path.join(self.dir, key + '.json.tmp')
+        with open(tmp, 'w') as f:
+            json.dump([fi.to_dict() for fi in findings], f)
+        os.replace(tmp, os.path.join(self.dir, key + '.json'))
+
 
 # -- the incremental run ----------------------------------------------------
 
+def program_pass_key(scoped_entries):
+    """Aggregate content key of the whole-program pass: the analysis package
+    fingerprint plus every in-scope file's relpath and bytes, in path order.
+    Editing any scoped file — or any checker — is a new key; editing a file
+    OUTSIDE the program scope leaves the entry warm."""
+    h = hashlib.sha256()
+    h.update(b'program-pass:')
+    h.update(analysis_fingerprint().encode())
+    for abspath, relpath in sorted(scoped_entries, key=lambda e: e[1]):
+        h.update(relpath.replace(os.sep, '/').encode())
+        try:
+            with open(abspath, 'rb') as f:
+                h.update(f.read())
+        except OSError:
+            h.update(b'<unreadable>')
+    return h.hexdigest()
+
+
 def run_analysis_incremental(file_entries, cache=None, baseline=None,
-                             select=None, ignore=None, keep_suppressed=False):
+                             select=None, ignore=None, keep_suppressed=False,
+                             program_entries=None):
     """:func:`analysis.run_analysis` semantics over an explicit
     ``[(abspath, relpath)]`` listing, optionally through a
-    :class:`ResultCache`. Checkers are strictly per-file (cross-file inputs
-    — the sibling native sources — are part of the cache key), so per-file
-    caching is exact, not approximate."""
+    :class:`ResultCache`.
+
+    Per-file checkers are strictly per-file (cross-file inputs — the sibling
+    native sources — are part of the cache key), so per-file caching is
+    exact, not approximate. Whole-program checkers (the PT13xx race lints)
+    run once over ``program_entries`` (default: ``file_entries``) and cache
+    their result under :func:`program_pass_key` — a ``--changed`` run must
+    pass the FULL listing here, because cross-module properties cannot be
+    derived from the changed subset alone."""
     from petastorm_tpu.analysis import ALL_CHECKERS
     checkers = [cls() for cls in ALL_CHECKERS]
+    per_file = [c for c in checkers if not c.program_level]
+    program = [c for c in checkers if c.program_level]
     findings = []
     for abspath, relpath in file_entries:
         cached = cache.lookup(abspath, relpath) if cache is not None else None
         if cached is None:
             src = SourceFile.load(abspath, relpath)
-            cached = run_checkers(checkers, [src], keep_suppressed=True)
+            cached = run_checkers(per_file, [src], keep_suppressed=True)
             if cache is not None:
                 cache.store(abspath, relpath, cached)
         findings.extend(cached)
+    if program:
+        scoped = [(a, r) for a, r in (program_entries if program_entries
+                                      is not None else file_entries)
+                  if any(c.matches_path(r.replace(os.sep, '/'))
+                         for c in program)]
+        prog_findings = None
+        key = program_pass_key(scoped) if cache is not None else None
+        if cache is not None:
+            prog_findings = cache.lookup_key(key)
+        if prog_findings is None:
+            sources = [SourceFile.load(a, r) for a, r in scoped]
+            prog_findings = [f for f in run_checkers(program, sources,
+                                                     keep_suppressed=True)
+                             if f.code != 'PT000']   # PT000 is the per-file pass's
+            if cache is not None:
+                cache.store_key(key, prog_findings)
+        findings.extend(prog_findings)
     if cache is not None:
         cache.flush_index()
     # the stored results are unfiltered; re-apply the view filters the same
@@ -295,4 +367,5 @@ def run_analysis_incremental(file_entries, cache=None, baseline=None,
 
 
 __all__ = ['ResultCache', 'analysis_fingerprint', 'changed_file_entries',
-           'file_key', 'iter_file_entries', 'run_analysis_incremental']
+           'file_key', 'iter_file_entries', 'program_pass_key',
+           'run_analysis_incremental']

@@ -72,10 +72,14 @@ def build_parser():
     parser.add_argument('paths', nargs='*',
                         help='files/directories to scan (default: the installed '
                              'petastorm_tpu package)')
-    parser.add_argument('--format', choices=('text', 'json'), default='text',
+    parser.add_argument('--format', choices=('text', 'json', 'sarif'),
+                        default='text',
                         help='json = one finding object per line (JSONL; '
                              'includes noqa/baselined findings with their '
-                             'status — only "open" ones affect the exit code)')
+                             'status — only "open" ones affect the exit code); '
+                             'sarif = one SARIF 2.1.0 document (suppressed '
+                             'findings carry a "suppressions" entry) for CI '
+                             'PR annotation')
     parser.add_argument('--baseline', metavar='FILE',
                         help='analysis_baseline.json absorbing known findings '
                              '(missing file = empty baseline)')
@@ -141,7 +145,7 @@ def main(argv=None):
     if ignore == EXIT_USAGE:
         return EXIT_USAGE
     baseline = load_baseline(args.baseline) if args.baseline else None
-    keep_suppressed = args.format == 'json' and not args.write_baseline
+    keep_suppressed = args.format in ('json', 'sarif') and not args.write_baseline
     if args.changed or args.cache:
         from petastorm_tpu.analysis.cache import (ResultCache,
                                                   changed_file_entries,
@@ -154,9 +158,13 @@ def main(argv=None):
             print('error: {}'.format(e), file=sys.stderr)
             return EXIT_USAGE
         cache = ResultCache(args.cache) if args.cache else None
+        # the whole-program pass (PT13xx) always sees the FULL listing — a
+        # changed-files subset cannot support cross-module analysis
+        program_entries = iter_file_entries(paths) if args.changed else None
         findings = run_analysis_incremental(
             entries, cache=cache, baseline=baseline, select=select,
-            ignore=ignore, keep_suppressed=keep_suppressed)
+            ignore=ignore, keep_suppressed=keep_suppressed,
+            program_entries=program_entries)
         if args.changed:
             print('{} changed file{} scanned'.format(
                 len(entries), '' if len(entries) == 1 else 's'),
@@ -184,6 +192,10 @@ def main(argv=None):
         # status so machine consumers can annotate suppressions too
         for f in findings:
             print(json.dumps(f.to_dict(), sort_keys=True))
+    elif args.format == 'sarif':
+        from petastorm_tpu.analysis.sarif import to_sarif
+        print(json.dumps(to_sarif(findings, ALL_CHECKERS), indent=2,
+                         sort_keys=True))
     else:
         for f in open_findings:
             print(f.format())

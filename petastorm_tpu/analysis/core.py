@@ -161,6 +161,9 @@ class Checker(object):
     #: meta-test requires a committed bad/clean fixture pair per listed id,
     #: so a new id registered here without teeth fails tier-1
     codes = None
+    #: True on :class:`ProgramChecker` subclasses — run once over all
+    #: matching sources, not once per file
+    program_level = False
     name = 'base'
     description = ''
     scope = ('*.py',)
@@ -169,10 +172,13 @@ class Checker(object):
     def rule_codes(cls):
         return cls.codes or (cls.code,)
 
-    def matches(self, src):
+    def matches_path(self, relpath):
         import fnmatch
-        return any(fnmatch.fnmatch(src.relpath, pat)
-                   or fnmatch.fnmatch('/' + src.relpath, pat) for pat in self.scope)
+        return any(fnmatch.fnmatch(relpath, pat)
+                   or fnmatch.fnmatch('/' + relpath, pat) for pat in self.scope)
+
+    def matches(self, src):
+        return self.matches_path(src.relpath)
 
     def check(self, src):
         raise NotImplementedError
@@ -180,6 +186,28 @@ class Checker(object):
     def finding(self, src, line, message, code=None):
         return Finding(path=src.relpath, line=line, code=code or self.code,
                        message=message, snippet=src.line_text(line))
+
+
+class ProgramChecker(Checker):
+    """Base of whole-program rule families (the PT13xx race lints).
+
+    A program checker sees every in-scope source at once via
+    :meth:`check_program` — cross-module lock-order graphs and guarded-by
+    inference cannot be computed one file at a time. ``check(src)`` delegates
+    to a single-file program run so fixture unit tests keep working, and the
+    runner (:func:`run_checkers`) takes care to invoke the checker exactly
+    once per pass, never per file. Incremental runs cache the program pass
+    under a digest of ALL in-scope file bytes (see
+    :func:`petastorm_tpu.analysis.cache.run_analysis_incremental`)."""
+
+    #: dispatch marker honored by run_checkers and the incremental runner
+    program_level = True
+
+    def check_program(self, sources):
+        raise NotImplementedError
+
+    def check(self, src):
+        yield from self.check_program([src])
 
 
 class Baseline(object):
@@ -282,13 +310,17 @@ def run_checkers(checkers, sources, baseline=None, keep_suppressed=False):
     from dataclasses import replace
     findings = []
     suppressed = []
+    per_file = [c for c in checkers if not c.program_level]
+    program = [c for c in checkers if c.program_level]
+    by_relpath = {}
     for src in sources:
         if src.parse_error is not None:
             findings.append(Finding(path=src.relpath, line=src.parse_error.lineno or 1,
                                     code='PT000',
                                     message='syntax error: {}'.format(src.parse_error.msg)))
             continue
-        for checker in checkers:
+        by_relpath[src.relpath] = src
+        for checker in per_file:
             if not checker.matches(src):
                 continue
             for f in checker.check(src):
@@ -296,6 +328,19 @@ def run_checkers(checkers, sources, baseline=None, keep_suppressed=False):
                     findings.append(f)
                 elif keep_suppressed:
                     suppressed.append(replace(f, status='noqa'))
+    # whole-program passes: one invocation over every matching (parseable)
+    # source; noqa still applies at the reported line of the reported file
+    for checker in program:
+        in_scope = [s for s in sources if s.parse_error is None
+                    and checker.matches(s)]
+        if not in_scope:
+            continue
+        for f in checker.check_program(in_scope):
+            src = by_relpath.get(f.path)
+            if src is None or not src.is_suppressed(f.line, f.code):
+                findings.append(f)
+            elif keep_suppressed:
+                suppressed.append(replace(f, status='noqa'))
     findings.sort()
     if baseline is not None:
         open_findings, absorbed = baseline.split(findings)

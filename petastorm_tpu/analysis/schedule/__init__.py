@@ -1,0 +1,42 @@
+"""Deterministic interleaving exploration for the thread plane.
+
+The dynamic half of PR 16's race story (static half:
+:mod:`petastorm_tpu.analysis.races`, rules PT1300-PT1303):
+
+* :mod:`~petastorm_tpu.analysis.schedule.scheduler` — the loom-style
+  cooperative scheduler (patched ``threading`` primitives, seeded +
+  replayable schedules, vector-clock race detection, deadlock detection);
+* :mod:`~petastorm_tpu.analysis.schedule.explorer` — N random schedules +
+  bounded-preemption DFS, with ``PSTPU_SCHEDULE=`` byte-for-byte replay;
+* :mod:`~petastorm_tpu.analysis.schedule.scenarios` — the real-component
+  scenarios tier-1 explores, plus seeded-defect fixtures proving the
+  explorer has teeth;
+* :mod:`~petastorm_tpu.analysis.schedule.cli` — ``petastorm-tpu-race``.
+
+See docs/analysis.md ("reading a schedule trace") for how to act on a
+failure report.
+"""
+
+from petastorm_tpu.analysis.schedule.explorer import (ExploreReport, explore,
+                                                      replay, run_one)
+from petastorm_tpu.analysis.schedule.scenarios import (DEFECT_SCENARIOS,
+                                                       SCENARIOS, lookup)
+from petastorm_tpu.analysis.schedule.scheduler import (SCHEDULE_ENV,
+                                                       PrefixStrategy, Race,
+                                                       RandomStrategy,
+                                                       ReplayStrategy,
+                                                       RunResult,
+                                                       ScheduleDivergence,
+                                                       Scheduler,
+                                                       SchedulerError,
+                                                       current_scheduler,
+                                                       parse_schedule,
+                                                       schedule_from_env)
+
+__all__ = [
+    'DEFECT_SCENARIOS', 'ExploreReport', 'PrefixStrategy', 'Race',
+    'RandomStrategy', 'ReplayStrategy', 'RunResult', 'SCENARIOS',
+    'SCHEDULE_ENV', 'ScheduleDivergence', 'Scheduler', 'SchedulerError',
+    'current_scheduler', 'explore', 'lookup', 'parse_schedule', 'replay',
+    'run_one', 'schedule_from_env',
+]

@@ -244,7 +244,12 @@ class ElasticCoordinator(object):
             self._refresh_epoch(epoch, alive)
 
     def _refresh_epoch(self, epoch, alive):
-        state = self._epoch_state.get(epoch)
+        with self._lock:
+            # consumer threads retire stale epochs (del) under the lock; an
+            # unlocked get here races the dict resize. The state dict itself
+            # stays valid once fetched — per-epoch state is only ever dropped,
+            # never rebound.
+            state = self._epoch_state.get(epoch)
         if state is None:
             return
         done = set()

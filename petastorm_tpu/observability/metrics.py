@@ -172,7 +172,7 @@ class MetricsRegistry(object):
         self._stage_timers = {}
 
     def _get_or_create(self, name, factory, kind):
-        metric = self._metrics.get(name)
+        metric = self._metrics.get(name)  # noqa: PT1301 - intentional double-checked locking; dict.get is GIL-atomic and a miss re-checks under _lock
         if metric is None:
             with self._lock:
                 metric = self._metrics.get(name)

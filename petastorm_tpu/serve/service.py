@@ -382,7 +382,7 @@ class ReaderService(object):
             with obs.span('serve.admit', cat='serve', tenant=tenant_id,
                           stream=stream_id):
                 with stream.write_lock:
-                    token = stream.ring.join()
+                    token = stream.ring.join()  # noqa: PT1303 - bcast-ring consumer-slot grant: a nonblocking C call, not a thread join
                 tenant = _Tenant(tenant_id, stream_id, token, weight, conn,
                                  joined_shared=not fresh)
                 stream.tenants[tenant_id] = tenant

@@ -883,9 +883,11 @@ class ProcessPool(object):
             logger.warning('Worker %d (pid %s) died with exitcode %s; draining its results',
                            worker_id, p.pid, p.exitcode)
         self._deaths_seen = True
-        old_ring = self._rings[worker_id] if worker_id < len(self._rings) else None
-        if old_ring is not None:
-            with self._ring_lock:
+        with self._ring_lock:
+            # autotune's grow path appends to _rings concurrently; the index
+            # read must sit under the same lock as the retire mutation
+            old_ring = self._rings[worker_id] if worker_id < len(self._rings) else None
+            if old_ring is not None:
                 self._retired_rings.append(old_ring)
                 self._rings[worker_id] = None
         self._dying[worker_id] = {'proc': p, 'ring': old_ring, 'at': now}
@@ -1112,9 +1114,12 @@ class ProcessPool(object):
         return list(self._telemetry_by_pid.values())
 
     def _all_done(self):
-        if self._ventilated_items > self._completed_items:
-            return False
+        # completed() first: once true, the ventilated count is final and the
+        # counter comparison below cannot be stale (the reverse order races
+        # an epoch ventilating between the two reads; see thread_pool)
         if self._ventilator is not None and not self._ventilator.completed():
+            return False
+        if self._ventilated_items > self._completed_items:
             return False
         return True
 

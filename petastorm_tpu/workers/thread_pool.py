@@ -214,11 +214,17 @@ class ThreadPool(object):
             self.done_callback(seq)
 
     def _all_done(self):
+        # completed() MUST be read before the counters: once it is true the
+        # ventilated count is final, so a subsequent counter read cannot be
+        # stale. The reverse order is a termination race — a whole epoch can
+        # ventilate between a counters read of (0, 0) and completed()
+        # flipping true, and the reader gives up with every item in flight
+        # (found by the schedule explorer, docs/analysis.md).
+        if self._ventilator is not None and not self._ventilator.completed():
+            return False
         with self._counter_lock:
             outstanding = self._ventilated_items > self._completed_items
         if outstanding or not self._results_queue.empty():
-            return False
-        if self._ventilator is not None and not self._ventilator.completed():
             return False
         return True
 

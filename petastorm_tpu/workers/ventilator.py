@@ -123,7 +123,7 @@ class ConcurrentVentilator(VentilatorBase):
     def start(self):
         if self._thread is not None:
             raise RuntimeError('Ventilator already started')
-        if self._completed:
+        if self.completed():
             return
         self._thread = threading.Thread(target=self._ventilate_loop, daemon=True)
         self._thread.start()
@@ -194,22 +194,28 @@ class ConcurrentVentilator(VentilatorBase):
             return [self._items_to_ventilate[i] for i in indices]
 
     def completed(self):
-        """True when no more items will ever be ventilated."""
-        return self._completed
+        """True when no more items will ever be ventilated. The flag is
+        read/written under ``_in_flight_cv`` like every other piece of
+        ventilation state: the feeding thread sets it on exhaustion while
+        consumer threads poll it, and the deterministic-schedule explorer
+        (``analysis/schedule``) flags the bare-flag protocol this replaced
+        as a write/read race."""
+        with self._in_flight_cv:
+            return self._completed
 
     def reset(self):
         """Restart ventilation for the originally requested number of iterations.
         Only valid after the previous run completed (the reference refuses
         mid-epoch reset citing races, reader.py:431-438 — we do too)."""
-        if not self._completed:
+        if not self.completed():
             raise RuntimeError('Cannot reset ventilator while ventilation is still in progress')
         if self._thread is not None:
             self._thread.join()
-        self._replay_indices = None
-        self._completed = len(self._items_to_ventilate) == 0
-        self._stop_requested = False
         self._thread = None
         with self._in_flight_cv:
+            self._replay_indices = None
+            self._completed = len(self._items_to_ventilate) == 0
+            self._stop_requested = False
             self._iterations_remaining = self._requested_iterations
             self._in_flight = 0
             self._undelivered.clear()
@@ -219,17 +225,23 @@ class ConcurrentVentilator(VentilatorBase):
         self.start()
 
     def stop(self):
-        self._stop_requested = True
+        # the stop flag joins the rest of the state under _in_flight_cv: the
+        # feeding thread re-checks it under the same lock, so the request
+        # can never be torn against an in-progress epoch layout
         with self._in_flight_cv:
+            self._stop_requested = True
             self._in_flight_cv.notify_all()
         if self._thread is not None and self._thread is not threading.current_thread():
             self._thread.join()
-        self._completed = True
+        with self._in_flight_cv:
+            self._completed = True
 
     def _ventilate_loop(self):
         first_pass = True
-        while not self._stop_requested:
+        while True:
             with self._in_flight_cv:
+                if self._stop_requested:
+                    break
                 if first_pass and self._replay_indices is not None:
                     # resumed run: replay saved items verbatim; does not consume
                     # an iteration (it is the remainder of an interrupted epoch)
@@ -281,7 +293,8 @@ class ConcurrentVentilator(VentilatorBase):
             with self._in_flight_cv:
                 if counted and self._iterations_remaining is not None:
                     self._iterations_remaining -= 1
-        self._completed = True
+        with self._in_flight_cv:
+            self._completed = True
 
 
 class _TenantQueue(object):
@@ -503,8 +516,10 @@ class FairShareVentilator(VentilatorBase):
             self._on_tenant_done(tenant_id)
 
     def completed(self):
-        """Long-lived: only a stop completes this ventilator."""
-        return self._completed
+        """Long-lived: only a stop completes this ventilator. Read under
+        ``_cv`` — the flag protocol matches :class:`ConcurrentVentilator`."""
+        with self._cv:
+            return self._completed
 
     def stop(self):
         with self._cv:
@@ -512,7 +527,8 @@ class FairShareVentilator(VentilatorBase):
             self._cv.notify_all()
         if self._thread is not None and self._thread is not threading.current_thread():
             self._thread.join()
-        self._completed = True
+        with self._cv:
+            self._completed = True
 
     def upcoming_items(self, max_items):
         """Merged read-only peek at the next items across tenants (for the

@@ -28,7 +28,8 @@ def test_console_scripts_declared_and_resolvable():
     scripts = proj['scripts']
     assert set(scripts) == {'pstpu-throughput', 'pstpu-copy-dataset',
                             'pstpu-generate-metadata', 'pstpu-metadata-util',
-                            'petastorm-tpu-lint', 'petastorm-tpu-diagnose',
+                            'petastorm-tpu-lint', 'petastorm-tpu-race',
+                            'petastorm-tpu-diagnose',
                             'petastorm-tpu-modelcheck', 'petastorm-tpu-autotune',
                             'petastorm-tpu-serve'}
     import importlib

@@ -1,0 +1,237 @@
+"""The deterministic interleaving explorer (petastorm_tpu.analysis.schedule).
+
+Four layers, mirroring the acceptance contract of the dynamic race pass:
+
+* **teeth** — the seeded-defect fixtures MUST fail (a torn read-modify-write,
+  the pre-fix ventilator flag protocol, an ABBA deadlock): an explorer that
+  cannot catch a planted defect proves nothing when it passes.
+* **soundness** — the race-free twin survives 500+ schedules with zero
+  reports: no false positives from the vector-clock tracker.
+* **replayability** — same seed => byte-for-byte identical schedules;
+  ``PSTPU_SCHEDULE=<schedule>`` reproduces a recorded failure exactly.
+* **the tier-1 floor** — every real-component scenario (ventilators,
+  shuffling buffer, slot registry, autotune actuator) passes >= 300
+  schedules per run of this file.
+"""
+
+import threading
+
+import pytest
+
+from petastorm_tpu.analysis.schedule import scenarios
+from petastorm_tpu.analysis.schedule.cli import (EXIT_CLEAN, EXIT_FINDINGS,
+                                                 EXIT_INCONCLUSIVE,
+                                                 EXIT_USAGE, main)
+from petastorm_tpu.analysis.schedule.explorer import explore, replay, run_one
+from petastorm_tpu.analysis.schedule.scenarios import (DEFECT_SCENARIOS,
+                                                       SCENARIOS, lookup)
+from petastorm_tpu.analysis.schedule.scheduler import (SCHEDULE_ENV,
+                                                       RandomStrategy,
+                                                       SchedulerError,
+                                                       parse_schedule)
+
+#: the tier-1 floor from the issue contract: every real-component scenario
+#: must survive at least this many explored schedules
+SCHEDULE_FLOOR = 300
+
+
+# ---------------------------------------------------------------------------
+# teeth: seeded defects must be caught within the default budget
+# ---------------------------------------------------------------------------
+
+def test_torn_counter_caught():
+    report = explore(scenarios.torn_counter, name='torn_counter',
+                     schedules=SCHEDULE_FLOOR)
+    assert not report.ok
+    failure = report.failure
+    assert failure.races, failure.describe()
+    assert any(r.attr == 'value' for r in failure.races)
+    assert failure.schedule  # every failure is replayable
+    assert 'PSTPU_SCHEDULE' in report.describe()
+
+
+def test_prefix_ventilator_flag_protocol_caught():
+    """Regression teeth: the explorer catches the EXACT defect class the
+    static+dynamic pass removed from ConcurrentVentilator/FairShareVentilator
+    (bare ``_stop_requested``/``_completed`` flag reads/writes beside a
+    Condition-guarded protocol)."""
+    report = explore(scenarios.prefix_ventilator_flags,
+                     name='prefix_ventilator_flags',
+                     schedules=SCHEDULE_FLOOR)
+    assert not report.ok
+    raced = {r.attr for r in report.failure.races}
+    assert raced & {'_stop_requested', '_completed'}, \
+        report.failure.describe()
+
+
+def test_abba_deadlock_detected():
+    report = explore(scenarios.abba_deadlock, name='abba_deadlock',
+                     schedules=SCHEDULE_FLOOR)
+    assert not report.ok
+    failure = report.failure
+    assert failure.deadlock is not None, failure.describe()
+    assert 'deadlock' in failure.describe()
+    assert failure.schedule
+
+
+# ---------------------------------------------------------------------------
+# soundness: the race-free twin survives 500+ schedules
+# ---------------------------------------------------------------------------
+
+def test_safe_counter_soundness_500_schedules():
+    report = explore(scenarios.safe_counter, name='safe_counter',
+                     schedules=500, dfs_budget=100)
+    assert report.ok, report.failure.describe()
+    assert report.schedules_run >= 500
+    assert report.dfs_runs > 0  # the DFS phase actually ran
+
+
+# ---------------------------------------------------------------------------
+# determinism + replay
+# ---------------------------------------------------------------------------
+
+def test_same_seed_same_schedules():
+    first = explore(scenarios.torn_counter, name='torn_counter',
+                    schedules=50, seed=7)
+    second = explore(scenarios.torn_counter, name='torn_counter',
+                     schedules=50, seed=7)
+    assert first.failure.schedule == second.failure.schedule
+    assert [r.describe() for r in first.failure.races] \
+        == [r.describe() for r in second.failure.races]
+
+
+def test_env_replay_is_byte_for_byte():
+    report = explore(scenarios.torn_counter, name='torn_counter',
+                     schedules=50)
+    recorded = report.failure.schedule
+    replayed = explore(scenarios.torn_counter, name='torn_counter',
+                       schedules=50, environ={SCHEDULE_ENV: recorded})
+    assert replayed.replayed
+    assert replayed.schedules_run == 1  # one exact replay, no exploration
+    assert replayed.failure.schedule == recorded
+    assert [r.key() for r in replayed.failure.races] \
+        == [r.key() for r in report.failure.races]
+
+
+def test_replay_helper_reproduces_failure():
+    report = explore(scenarios.torn_counter, name='torn_counter',
+                     schedules=50)
+    result = replay(scenarios.torn_counter, report.failure.schedule)
+    assert result.schedule == report.failure.schedule
+    assert [r.key() for r in result.races] \
+        == [r.key() for r in report.failure.races]
+
+
+def test_replay_divergence_is_inconclusive_not_a_pass():
+    # thread 9 never exists: the recorded choice is not runnable at step 0
+    result = replay(scenarios.safe_counter, '9')
+    assert result.divergence
+    assert result.inconclusive
+    assert not result.ok
+
+
+def test_step_budget_exhaustion_is_inconclusive():
+    sched, result = run_one(scenarios.concurrent_ventilator,
+                            RandomStrategy(0), max_steps=3)
+    assert result.steps_exhausted
+    assert result.inconclusive and not result.ok
+
+
+def test_parse_schedule():
+    assert parse_schedule('0,1,2,0') == [0, 1, 2, 0]
+    assert parse_schedule(' 3 , 4 ') == [3, 4]
+    with pytest.raises(SchedulerError):
+        parse_schedule('0,x,1')
+
+
+def test_threading_restored_after_runs():
+    """The monkeypatches must be scoped to the run — including failing and
+    aborted runs — or everything after the first explore() breaks."""
+    explore(scenarios.torn_counter, name='torn_counter', schedules=10)
+    explore(scenarios.abba_deadlock, name='abba_deadlock', schedules=10)
+    lock_cls = type(threading.Lock())
+    assert lock_cls.__module__ in ('_thread', 'threading')
+    ev = threading.Event()
+    ev.set()
+    assert ev.wait(timeout=1)
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 floor: real components, >= 300 schedules each
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize('name', sorted(SCENARIOS))
+def test_real_component_survives_schedule_floor(name):
+    report = explore(SCENARIOS[name], name=name, schedules=SCHEDULE_FLOOR,
+                     dfs_budget=100)
+    assert report.ok, report.failure.describe()
+    assert report.schedules_run >= SCHEDULE_FLOOR
+
+
+def test_scenario_registry_lookup():
+    assert set(SCENARIOS) & set(DEFECT_SCENARIOS) == set()
+    for name in list(SCENARIOS) + list(DEFECT_SCENARIOS):
+        assert callable(lookup(name))
+    with pytest.raises(KeyError):
+        lookup('no_such_scenario')
+
+
+# ---------------------------------------------------------------------------
+# petastorm-tpu-race: the documented exit-code contract
+# ---------------------------------------------------------------------------
+
+def test_cli_clean_scenario_exits_0(capsys):
+    assert main(['explore', 'safe_counter', '--schedules', '20']) \
+        == EXIT_CLEAN
+    assert 'safe_counter' in capsys.readouterr().out
+
+
+def test_cli_finding_exits_1(capsys):
+    assert main(['explore', 'torn_counter', '--schedules', '50']) \
+        == EXIT_FINDINGS
+    out = capsys.readouterr().out
+    assert 'race' in out
+    assert 'PSTPU_SCHEDULE' in out  # the replay handle is printed
+
+
+def test_cli_unknown_scenario_exits_2(capsys):
+    assert main(['explore', 'no_such_scenario']) == EXIT_USAGE
+
+
+def test_cli_no_subcommand_exits_2(capsys):
+    assert main([]) == EXIT_USAGE
+
+
+def test_cli_env_replay_needs_exactly_one_scenario(monkeypatch, capsys):
+    monkeypatch.setenv(SCHEDULE_ENV, '0,1,0')
+    assert main(['explore', 'torn_counter', 'safe_counter']) == EXIT_USAGE
+
+
+def test_cli_env_replay_reproduces_failure(monkeypatch, capsys):
+    report = explore(scenarios.torn_counter, name='torn_counter',
+                     schedules=50)
+    monkeypatch.setenv(SCHEDULE_ENV, report.failure.schedule)
+    assert main(['explore', 'torn_counter']) == EXIT_FINDINGS
+    assert report.failure.schedule in capsys.readouterr().out
+
+
+def test_cli_inconclusive_exits_3(capsys):
+    assert main(['explore', 'concurrent_ventilator', '--max-steps', '3',
+                 '--schedules', '5', '--dfs-budget', '0']) \
+        == EXIT_INCONCLUSIVE
+
+
+def test_cli_list_catalogs_everything(capsys):
+    assert main(['list']) == EXIT_CLEAN
+    out = capsys.readouterr().out
+    for name in list(SCENARIOS) + list(DEFECT_SCENARIOS):
+        assert name in out
+
+
+def test_cli_lint_mode_selects_pt13_family(capsys, tmp_path):
+    clean = tmp_path / 'clean.py'
+    # a PT600 violation: out of the PT13 family, so `lint` must NOT report it
+    clean.write_text('class C(object):\n'
+                     '    def __eq__(self, other):\n'
+                     '        return True\n')
+    assert main(['lint', str(clean)]) == EXIT_CLEAN

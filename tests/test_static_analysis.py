@@ -31,6 +31,7 @@ from petastorm_tpu.analysis.jax_purity import JaxPurityChecker
 from petastorm_tpu.analysis.lifecycle import ResourceLifecycleChecker
 from petastorm_tpu.analysis.locks import LockDisciplineChecker
 from petastorm_tpu.analysis.protocol_lints import ProtocolLintChecker
+from petastorm_tpu.analysis.races import RaceChecker
 from petastorm_tpu.analysis.telemetry import TelemetrySpanChecker
 
 import petastorm_tpu
@@ -1272,6 +1273,556 @@ def test_abi_checker_ignores_fixture_without_cpp():
     src = SourceFile('<fixture>', 'native/fused.py',
                      'import ctypes\nlib = None\n')
     assert list(AbiConformanceChecker().check(src)) == []
+
+
+# ---------------------------------------------------------------------------
+# PT1300-PT1303 whole-program race lints
+# ---------------------------------------------------------------------------
+
+def _program_findings(files):
+    """Run the whole-program RaceChecker over a dict of relpath -> source."""
+    sources = [SourceFile('<fixture:{}>'.format(rp), rp, textwrap.dedent(txt))
+               for rp, txt in sorted(files.items())]
+    for src in sources:
+        assert src.parse_error is None, (src.relpath, src.parse_error)
+    return run_checkers([RaceChecker()], sources)
+
+
+_ABBA_POOL = '''
+    import threading
+
+    class GrowablePool(object):
+        def __init__(self):
+            self._pool_lock = threading.Lock()
+
+        def grow(self, vent):
+            with self._pool_lock:
+                vent.pause_inner()
+
+        def grow_inner(self):
+            with self._pool_lock:
+                pass
+'''
+
+_ABBA_VENT = '''
+    import threading
+
+    class PausableVentilator(object):
+        def __init__(self):
+            self._vent_lock = threading.Lock()
+
+        def pause(self, pool):
+            with self._vent_lock:
+                pool.grow_inner()
+
+        def pause_inner(self):
+            with self._vent_lock:
+                pass
+'''
+
+
+def test_pt1300_cross_module_abba_cycle_flagged():
+    findings = _program_findings({'workers/pool.py': _ABBA_POOL,
+                                  'workers/vent.py': _ABBA_VENT})
+    assert [f.code for f in findings] == ['PT1300']
+    assert '_pool_lock' in findings[0].message
+    assert '_vent_lock' in findings[0].message
+
+
+def test_pt1300_consistent_cross_module_order_passes():
+    # both entry paths take pool-lock before vent-lock: an order, not a cycle
+    vent = _ABBA_VENT.replace('pool.grow_inner()', 'pass')
+    assert _program_findings({'workers/pool.py': _ABBA_POOL,
+                              'workers/vent.py': vent}) == []
+
+
+def test_pt1300_deep_call_chain_cycle_flagged():
+    """Two levels of self-call indirection: beyond PT101's one-level limit,
+    so PT1300 owns it even within a single class."""
+    code = '''
+        import threading
+
+        class C(object):
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def one(self):
+                with self._a:
+                    self._mid_b()
+
+            def two(self):
+                with self._b:
+                    self._mid_a()
+
+            def _mid_a(self):
+                self._take_a()
+
+            def _mid_b(self):
+                self._take_b()
+
+            def _take_a(self):
+                with self._a:
+                    pass
+
+            def _take_b(self):
+                with self._b:
+                    pass
+    '''
+    findings = _program_findings({'workers/c.py': code})
+    assert [f.code for f in findings] == ['PT1300']
+
+
+def test_pt1300_pt101_dedup_class_local_cycle():
+    """A single-class, one-level-indirection ABBA is PT101's territory:
+    PT101 reports it, PT1300 must NOT double-report."""
+    code = '''
+        import threading
+
+        class C(object):
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+                self._na = 0
+                self._nb = 0
+
+            def one(self):
+                with self._a:
+                    self._take_b()
+
+            def two(self):
+                with self._b:
+                    self._take_a()
+
+            def _take_a(self):
+                with self._a:
+                    self._na += 1
+
+            def _take_b(self):
+                with self._b:
+                    self._nb += 1
+    '''
+    src = SourceFile('<fixture>', 'workers/c.py', textwrap.dedent(code))
+    codes = [f.code for f in
+             run_checkers([LockDisciplineChecker(), RaceChecker()], [src])]
+    assert codes == ['PT101']
+
+
+def test_pt1300_uncorrelated_ambiguous_receiver_resolves_to_nothing():
+    """Two classes define ``drain``; the receiver name shares no token with
+    either class, so no call edge is invented and no cycle is reported."""
+    a = _ABBA_POOL.replace('vent.pause_inner()', 'zz.drain()') \
+                  .replace('def grow_inner', 'def drain_a')
+    b = '''
+        import threading
+
+        class First(object):
+            def __init__(self):
+                self._f = threading.Lock()
+
+            def drain(self):
+                with self._f:
+                    pass
+
+        class Second(object):
+            def __init__(self):
+                self._s = threading.Lock()
+
+            def drain(self):
+                with self._s:
+                    pass
+    '''
+    assert _program_findings({'workers/a.py': a, 'workers/b.py': b}) == []
+
+
+def test_pt1301_unguarded_read_of_guarded_container():
+    code = '''
+        import threading
+
+        class Q(object):
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []
+
+            def put(self, x):
+                with self._lock:
+                    self._items.append(x)
+
+            def snapshot(self):
+                return list(self._items)
+    '''
+    findings = _program_findings({'workers/q.py': code})
+    assert [f.code for f in findings] == ['PT1301']
+    assert '_items' in findings[0].message
+
+
+def test_pt1301_guarded_by_inference_through_helper():
+    """A private helper invoked only under the lock inherits the guard — the
+    '# noqa: caller holds the lock' convention, computed."""
+    code = '''
+        import threading
+
+        class Q(object):
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []
+
+            def put(self, x):
+                with self._lock:
+                    self._items.append(x)
+                    self._drain()
+
+            def _drain(self):
+                for item in self._items:
+                    pass
+    '''
+    assert _program_findings({'workers/q.py': code}) == []
+
+
+def test_pt1301_scalar_flags_not_flagged():
+    # GIL-atomic scalar flags are PT100's domain, not a torn-view hazard
+    code = '''
+        import threading
+
+        class Q(object):
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._stop = False
+
+            def stop(self):
+                with self._lock:
+                    self._stop = True
+
+            def running(self):
+                return not self._stop
+    '''
+    assert _program_findings({'workers/q.py': code}) == []
+
+
+def test_pt1302_live_reference_escape_flagged():
+    code = '''
+        import threading
+
+        class Q(object):
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []
+
+            def put(self, x):
+                with self._lock:
+                    self._items.append(x)
+
+            def items(self):
+                with self._lock:
+                    return self._items
+    '''
+    findings = _program_findings({'workers/q.py': code})
+    assert [f.code for f in findings] == ['PT1302']
+    assert 'copy out' in findings[0].message
+
+
+def test_pt1302_copy_out_passes():
+    code = '''
+        import threading
+
+        class Q(object):
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []
+
+            def put(self, x):
+                with self._lock:
+                    self._items.append(x)
+
+            def items(self):
+                with self._lock:
+                    return list(self._items)
+    '''
+    assert _program_findings({'workers/q.py': code}) == []
+
+
+def test_pt1303_unbounded_wait_under_lock_flagged():
+    code = '''
+        import threading
+
+        class Q(object):
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cv = threading.Condition(self._lock)
+                self._ready = False
+
+            def set_ready(self):
+                with self._cv:
+                    self._ready = True
+                    self._cv.notify_all()
+
+            def wait_ready(self):
+                with self._cv:
+                    while not self._ready:
+                        self._cv.wait()
+    '''
+    findings = _program_findings({'workers/q.py': code})
+    assert [f.code for f in findings] == ['PT1303']
+    assert 'wait(timeout=...)' in findings[0].message
+
+
+def test_pt1303_timed_wait_passes():
+    code = '''
+        import threading
+
+        class Q(object):
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cv = threading.Condition(self._lock)
+                self._ready = False
+
+            def set_ready(self):
+                with self._cv:
+                    self._ready = True
+                    self._cv.notify_all()
+
+            def wait_ready(self):
+                with self._cv:
+                    while not self._ready:
+                        self._cv.wait(timeout=0.1)
+    '''
+    assert _program_findings({'workers/q.py': code}) == []
+
+
+def test_pt1303_out_of_scope_modules_ignored():
+    code = '''
+        import threading
+
+        class Q(object):
+            def __init__(self):
+                self._cv = threading.Condition()
+
+            def wait_forever(self):
+                with self._cv:
+                    self._cv.wait()
+    '''
+    # the identical shape fires inside the concurrency domains...
+    assert [f.code for f in _program_findings({'workers/q.py': code})] \
+        == ['PT1303']
+    # ...and is ignored outside them
+    sources = [SourceFile('<f>', 'codecs/q.py', textwrap.dedent(code))]
+    assert run_checkers([RaceChecker()], sources) == []
+
+
+# ---------------------------------------------------------------------------
+# PT13xx seeded mutations of REAL sources: re-introducing the defect class
+# into the live tree must make the checker fire (and the live tree is clean)
+# ---------------------------------------------------------------------------
+
+_SEEDED_ABBA = '''
+
+class _SeededA(object):
+    def __init__(self):
+        self._lock_a = threading.Lock()
+
+    def forward(self, other):
+        with self._lock_a:
+            other.backward_inner()
+
+    def forward_inner(self):
+        with self._lock_a:
+            pass
+
+
+class _SeededB(object):
+    def __init__(self):
+        self._lock_b = threading.Lock()
+
+    def backward(self, other):
+        with self._lock_b:
+            other.forward_inner()
+
+    def backward_inner(self):
+        with self._lock_b:
+            pass
+'''
+
+# (rule, real file, fixed fragment, broken fragment); appending instead of
+# replacing when the fixed fragment is the empty suffix
+_SEEDED_MUTATIONS = [
+    ('PT1300', 'workers/ventilator.py', None, _SEEDED_ABBA),
+    ('PT1301', 'elastic/coordinator.py',
+     'with self._lock:\n'
+     '            # consumer threads retire stale epochs (del) under the lock; an\n'
+     '            # unlocked get here races the dict resize. The state dict itself\n'
+     '            # stays valid once fetched — per-epoch state is only ever dropped,\n'
+     '            # never rebound.\n'
+     '            state = self._epoch_state.get(epoch)',
+     'state = self._epoch_state.get(epoch)'),
+    ('PT1302', 'workers/thread_pool.py',
+     'return list(self._quarantined)', 'return self._quarantined'),
+    ('PT1303', 'workers/ventilator.py',
+     'self._in_flight_cv.wait(timeout=0.1)', 'self._in_flight_cv.wait()'),
+]
+
+
+@pytest.mark.parametrize('rule,relpath,fixed,broken',
+                         _SEEDED_MUTATIONS,
+                         ids=[m[0] for m in _SEEDED_MUTATIONS])
+def test_pt13xx_seeded_mutation_of_real_source(rule, relpath, fixed, broken):
+    path = os.path.join(PKG_DIR, relpath)
+    with open(path) as f:
+        original = f.read()
+    checker = RaceChecker()
+    clean = run_checkers([checker],
+                         [SourceFile(path, relpath, original)])
+    assert rule not in {f.code for f in clean}, (
+        'real source {} already carries an open {}'.format(relpath, rule))
+    if fixed is None:
+        mutated = original + broken
+    else:
+        assert fixed in original, (
+            'expected fixed fragment vanished from {} — update the seeded '
+            'mutation to track the source'.format(relpath))
+        mutated = original.replace(fixed, broken)
+    findings = run_checkers([RaceChecker()],
+                            [SourceFile(path, relpath, mutated)])
+    assert rule in {f.code for f in findings}, (
+        'seeded {} defect in {} not caught'.format(rule, relpath))
+
+
+# ---------------------------------------------------------------------------
+# SARIF output (--format sarif)
+# ---------------------------------------------------------------------------
+
+def _sarif_run(path, extra=()):
+    proc = subprocess.run(
+        [sys.executable, '-m', 'petastorm_tpu.analysis', str(path),
+         '--format', 'sarif'] + list(extra),
+        capture_output=True, text=True, timeout=120)
+    return proc, json.loads(proc.stdout)
+
+
+def test_sarif_document_structure(tmp_path):
+    """Structural validation against the subset of the SARIF 2.1.0 schema
+    the linter emits (jsonschema is not an install dependency)."""
+    bad = tmp_path / 'bad.py'
+    bad.write_text('class C(object):\n'
+                   '    def __eq__(self, other):\n'
+                   '        return True\n'
+                   'class D(object):\n'
+                   '    def __eq__(self, other):  # noqa: PT600 - identity only\n'
+                   '        return True\n')
+    proc, doc = _sarif_run(bad)
+    assert proc.returncode == 1  # exit-code contract is format-independent
+    from petastorm_tpu.analysis.sarif import SARIF_SCHEMA, SARIF_VERSION
+    assert doc['$schema'] == SARIF_SCHEMA
+    assert doc['version'] == SARIF_VERSION
+    assert isinstance(doc['runs'], list) and len(doc['runs']) == 1
+    run = doc['runs'][0]
+    driver = run['tool']['driver']
+    assert driver['name'] == 'petastorm-tpu-lint'
+    rule_ids = [r['id'] for r in driver['rules']]
+    assert rule_ids == sorted(set(rule_ids), key=rule_ids.index)  # unique
+    for r in driver['rules']:
+        assert set(r) >= {'id', 'name', 'shortDescription'}
+        assert r['shortDescription']['text']
+    # the full registered catalog is advertised, plus the parse-error rule
+    assert set(rule_ids) == set(ALL_RULE_CODES) | {'PT000'}
+    assert len(run['results']) == 2
+    for result in run['results']:
+        assert result['ruleId'] == 'PT600'
+        assert result['level'] == 'error'
+        assert result['message']['text']
+        assert driver['rules'][result['ruleIndex']]['id'] == result['ruleId']
+        loc = result['locations'][0]['physicalLocation']
+        assert loc['artifactLocation']['uri'] == 'bad.py'
+        assert isinstance(loc['region']['startLine'], int)
+        assert loc['region']['startLine'] >= 1
+
+
+def test_sarif_suppression_kinds(tmp_path):
+    """noqa -> inSource, baseline -> external; open results carry none."""
+    bad = tmp_path / 'bad.py'
+    bad.write_text('class C(object):\n'
+                   '    def __eq__(self, other):\n'
+                   '        return True\n'
+                   'class D(object):\n'
+                   '    def __eq__(self, other):  # noqa: PT600 - identity only\n'
+                   '        return True\n')
+    proc, doc = _sarif_run(bad)
+    results = doc['runs'][0]['results']
+    kinds = sorted(r['suppressions'][0]['kind'] if 'suppressions' in r
+                   else 'open' for r in results)
+    assert kinds == ['inSource', 'open']
+    baseline = tmp_path / 'baseline.json'
+    subprocess.run(
+        [sys.executable, '-m', 'petastorm_tpu.analysis', str(bad),
+         '--write-baseline', str(baseline)],
+        capture_output=True, text=True, timeout=120)
+    proc, doc = _sarif_run(bad, ['--baseline', str(baseline)])
+    assert proc.returncode == 0  # everything suppressed
+    kinds = sorted(r['suppressions'][0]['kind'] if 'suppressions' in r
+                   else 'open' for r in doc['runs'][0]['results'])
+    assert kinds == ['external', 'inSource']
+
+
+def test_sarif_package_tree_has_no_open_results():
+    proc = subprocess.run(
+        [sys.executable, '-m', 'petastorm_tpu.analysis', PKG_DIR,
+         '--format', 'sarif', '--baseline', BASELINE_PATH],
+        capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    open_results = [r for r in doc['runs'][0]['results']
+                    if 'suppressions' not in r]
+    assert open_results == []
+
+
+# ---------------------------------------------------------------------------
+# the whole-program pass through --cache / --changed
+# ---------------------------------------------------------------------------
+
+def _write_abba_tree(root):
+    pkg = root / 'workers'
+    pkg.mkdir(parents=True, exist_ok=True)
+    (pkg / 'pool.py').write_text(textwrap.dedent(_ABBA_POOL))
+    (pkg / 'vent.py').write_text(textwrap.dedent(_ABBA_VENT))
+    return [(str(pkg / n), 'workers/' + n) for n in ('pool.py', 'vent.py')]
+
+
+def test_program_pass_is_cached_and_invalidated(tmp_path):
+    from petastorm_tpu.analysis.cache import (ResultCache,
+                                              run_analysis_incremental)
+    entries = _write_abba_tree(tmp_path)
+    cache_dir = str(tmp_path / 'cache')
+
+    cache = ResultCache(cache_dir)
+    first = run_analysis_incremental(entries, cache=cache)
+    assert 'PT1300' in {f.code for f in first}
+
+    cache = ResultCache(cache_dir)
+    second = run_analysis_incremental(entries, cache=cache)
+    assert [f.to_dict() for f in second] == [f.to_dict() for f in first]
+    assert cache.misses == 0  # per-file AND program entries all warm
+
+    # editing a scoped file invalidates the aggregate program key
+    fixed = textwrap.dedent(_ABBA_VENT).replace('pool.grow_inner()', 'pass')
+    (tmp_path / 'workers' / 'vent.py').write_text(fixed)
+    cache = ResultCache(cache_dir)
+    third = run_analysis_incremental(entries, cache=cache)
+    assert 'PT1300' not in {f.code for f in third}
+
+
+def test_changed_subset_still_runs_whole_program_pass(tmp_path):
+    """--changed semantics: per-file checkers see only the changed subset,
+    but the PT13xx pass runs over the FULL listing — a cross-module cycle
+    must not vanish just because only one of its files changed."""
+    from petastorm_tpu.analysis.cache import run_analysis_incremental
+    entries = _write_abba_tree(tmp_path)
+    changed_only = entries[:1]
+    findings = run_analysis_incremental(changed_only,
+                                        program_entries=entries)
+    assert 'PT1300' in {f.code for f in findings}
+    # the subset alone cannot prove the cycle
+    subset_only = run_analysis_incremental(changed_only,
+                                           program_entries=changed_only)
+    assert 'PT1300' not in {f.code for f in subset_only}
 
 
 # ---------------------------------------------------------------------------
