@@ -87,6 +87,12 @@ Rule families (see ``docs/analysis.md`` for bad/good examples):
   raw sets are all rejected inside ``elastic/shardmap.py``. Two hosts that
   derive different maps for the same generation double-read or drop row
   groups with no error anywhere (``analysis/elastic_lints.py``).
+* **PT1400** sequence sampling determinism — mixture sampling, bucket
+  release and packing decisions (``sequence/``,
+  ``weighted_sampling_reader.py``) must be reproducible under a fixed
+  seed: wall-clock reads, module-global RNG draws and lexically-unseeded
+  RNG constructors are rejected, so a training run's data order stays a
+  checkpointable fact (``analysis/sequence_lints.py``).
 
 Suppress a single finding with ``# noqa: PT###`` (reason encouraged) on its
 line; absorb pre-existing findings with an ``analysis_baseline.json`` (see
@@ -112,6 +118,7 @@ from petastorm_tpu.analysis.lifetime import LifetimeChecker
 from petastorm_tpu.analysis.locks import LockDisciplineChecker
 from petastorm_tpu.analysis.protocol_lints import ProtocolLintChecker
 from petastorm_tpu.analysis.races import RaceChecker
+from petastorm_tpu.analysis.sequence_lints import SequenceDeterminismChecker
 from petastorm_tpu.analysis.serve_lints import ServeActuatorChecker
 from petastorm_tpu.analysis.telemetry import TelemetrySpanChecker
 from petastorm_tpu.analysis.trace_lints import TraceContextChecker
@@ -135,6 +142,7 @@ ALL_CHECKERS = (
     LifetimeChecker,
     ElasticDeterminismChecker,
     RaceChecker,
+    SequenceDeterminismChecker,
 )
 
 #: every individual rule id the registered checkers can emit — the linter
@@ -179,7 +187,8 @@ __all__ = [
     'HashabilityChecker', 'JaxPurityChecker', 'LifetimeChecker',
     'LockDisciplineChecker',
     'NativeBufferChecker', 'ProtocolLintChecker', 'RaceChecker',
-    'ResourceLifecycleChecker', 'ServeActuatorChecker',
+    'ResourceLifecycleChecker', 'SequenceDeterminismChecker',
+    'ServeActuatorChecker',
     'SourceFile', 'TelemetrySpanChecker', 'TraceContextChecker',
     'collect_sources', 'load_baseline', 'run_analysis', 'run_checkers',
 ]

@@ -97,10 +97,18 @@ class DatasetWriter(object):
     columns whose codec already compresses its payloads (png/jpeg/zlib cells)
     are written uncompressed automatically (``preferred_column_compression``)
     — re-compressing them costs read-side decompression for zero size win.
+
+    ``append=True`` opens an EXISTING dataset for growth (the tail-following
+    ingest contract, docs/sequence.md): part-file names continue past the
+    files already recorded in ``_common_metadata``, and the row-group
+    inventory written on close MERGES with the existing one instead of
+    replacing it. Single-writer only — two concurrent appenders would race
+    the metadata rewrite.
     """
 
     def __init__(self, dataset_url, schema, row_group_size_mb=None, rows_per_row_group=None,
-                 rows_per_file=None, partition_by=None, compression='snappy'):
+                 rows_per_file=None, partition_by=None, compression='snappy', append=False):
+        self._dataset_url = dataset_url
         self._resolver = FilesystemResolver(dataset_url)
         self._fs = self._resolver.filesystem()
         self._root = self._resolver.get_dataset_path()
@@ -160,10 +168,21 @@ class DatasetWriter(object):
         self._row_groups_per_file = {}  # relpath -> count
         self._closed = False
         self._fs.create_dir(self._root, recursive=True)
+        # append mode: the existing inventory both seeds the merged metadata
+        # written on close and tells _open_file which part names are taken
+        self._existing_counts = {}
+        if append:
+            arrow_meta = _read_common_metadata(self._fs, self._root)
+            meta = (arrow_meta.metadata or {}) if arrow_meta is not None else {}
+            if ROW_GROUPS_PER_FILE_KEY in meta:
+                self._existing_counts = json.loads(
+                    meta[ROW_GROUPS_PER_FILE_KEY].decode('utf-8'))
 
     @property
     def row_groups_per_file(self):
-        return dict(self._row_groups_per_file)
+        """Full inventory this writer's metadata describes: the pre-existing
+        files (append mode) merged with everything written here."""
+        return {**self._existing_counts, **self._row_groups_per_file}
 
     def write(self, row_dict):
         """Encode and buffer one row (a dict of in-memory field values)."""
@@ -189,6 +208,29 @@ class DatasetWriter(object):
             # percent-escape like hive so '/' etc. cannot corrupt the path
             parts.append('{}={}'.format(key, quote(str(value), safe='')))
         return '/'.join(parts)
+
+    def publish(self, final=False):
+        """Make everything written SO FAR visible to readers and stamp an
+        atomic snapshot marker (the tail-following contract, docs/sequence.md).
+
+        Flushes and closes every open part file (Parquet footers only exist on
+        closed files), rewrites ``_common_metadata`` with the merged row-group
+        inventory, then publishes a ``_snapshots/snap-NNNNNN.json`` marker via
+        :func:`petastorm_tpu.sequence.tail.publish_snapshot`. The writer stays
+        usable — the next :meth:`write` opens a fresh part file, so published
+        files are immutable from the moment a snapshot names them.
+
+        :param final: marks the snapshot terminal so tail followers stop
+            polling instead of waiting for more data
+        :returns: the published snapshot id (int)
+        """
+        if self._closed:
+            raise PetastormTpuError('Writer is closed')
+        for writer in self._writers.values():
+            writer.close()
+        _write_dataset_metadata(self._dataset_url, self._schema, self.row_groups_per_file)
+        from petastorm_tpu.sequence.tail import publish_snapshot
+        return publish_snapshot(self._dataset_url, final=final)
 
     def close(self):
         if self._closed:
@@ -235,9 +277,15 @@ class _PartitionWriter(object):
 
     def _open_file(self):
         p = self._parent
-        basename = 'part-{:05d}.parquet'.format(self._file_seq)
-        self._file_seq += 1
-        relpath = posixpath.join(self._rel_dir, basename) if self._rel_dir else basename
+        while True:
+            basename = 'part-{:05d}.parquet'.format(self._file_seq)
+            self._file_seq += 1
+            relpath = posixpath.join(self._rel_dir, basename) if self._rel_dir else basename
+            # append mode: skip names the existing inventory already owns —
+            # a fresh writer restarts its sequence at 0 and would otherwise
+            # overwrite the dataset it is meant to grow
+            if relpath not in p._existing_counts and relpath not in p._row_groups_per_file:
+                break
         full = posixpath.join(p._root, relpath)
         if self._rel_dir:
             p._fs.create_dir(posixpath.join(p._root, self._rel_dir), recursive=True)
@@ -279,7 +327,8 @@ class _PartitionWriter(object):
 
 @contextmanager
 def materialize_dataset(dataset_url, schema, row_group_size_mb=None, rows_per_row_group=None,
-                        rows_per_file=None, partition_by=None, compression='snappy'):
+                        rows_per_file=None, partition_by=None, compression='snappy',
+                        append=False):
     """Context manager bracketing a dataset write (reference
     etl/dataset_metadata.py:52-114). Yields a :class:`DatasetWriter`; on exit,
     closes it, writes ``_common_metadata`` with the JSON unischema and per-file
@@ -288,10 +337,14 @@ def materialize_dataset(dataset_url, schema, row_group_size_mb=None, rows_per_ro
     :param compression: parquet codec — dataset-wide string (``'snappy'``
         default, ``'zstd'``/``'lz4'``/``'none'`` equally fused-readable), a
         per-column ``{name: codec}`` dict, or ``None`` for uncompressed; see
-        :class:`DatasetWriter` for the already-compressed-payload override."""
+        :class:`DatasetWriter` for the already-compressed-payload override.
+    :param append: grow an existing dataset instead of starting one — part
+        names continue past the recorded inventory and the final metadata
+        merges with it (see :class:`DatasetWriter`); combine with
+        :meth:`DatasetWriter.publish` for tail-following readers."""
     writer = DatasetWriter(dataset_url, schema, row_group_size_mb=row_group_size_mb,
                            rows_per_row_group=rows_per_row_group, rows_per_file=rows_per_file,
-                           partition_by=partition_by, compression=compression)
+                           partition_by=partition_by, compression=compression, append=append)
     try:
         yield writer
     finally:

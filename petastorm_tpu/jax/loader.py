@@ -67,9 +67,12 @@ def collate_rows(rows, field_names=None):
             shapes = {np.shape(v) for v in values}
             if len(shapes) > 1:
                 raise PetastormTpuError(
-                    'Field {!r} has non-uniform shapes {} within a batch; use a '
-                    'TransformSpec to crop/pad it to a fixed shape, or exclude it via '
-                    'schema_fields.'.format(name, sorted(shapes)))
+                    'Field {!r} has non-uniform shapes {} within a batch. For '
+                    'variable-length sequences, pass collate_spec=CollateSpec('
+                    '{{{!r}: PadSpec(...)}}) for per-batch ragged padding '
+                    '(petastorm_tpu.sequence, docs/sequence.md); otherwise use a '
+                    'TransformSpec to crop/pad to a fixed shape, or exclude the '
+                    'field via schema_fields.'.format(name, sorted(shapes), name))
             raise
     return batch
 
@@ -157,11 +160,24 @@ class JaxDataLoader(object):
     :param resume_state: dict from :meth:`state_dict`. Restores the rows that
         were buffered client-side at checkpoint time; construct the underlying
         reader with its own ``resume_state=state['reader']``.
+    :param collate_spec: a :class:`petastorm_tpu.sequence.CollateSpec` —
+        ragged collation for variable-length fields (docs/sequence.md): each
+        batch pads the named fields to a per-batch length (``pad_to``
+        rounding / ``buckets`` ladder / ``max_length`` cap), emits
+        ``<field>_lengths`` companions, and tracks padding waste
+        (``diagnostics['padding_waste_fraction']``). Row-oriented readers
+        only; not supported with ngram windows.
+    :param bucket_boundaries: with ``collate_spec``, batch by length bucket:
+        rows are routed to length buckets and released only in same-bucket
+        runs of ``batch_size``, so each padded batch mixes near-equal
+        lengths. Deterministic and checkpoint-compatible (``seed`` drives the
+        within-bucket shuffle); replaces the shuffling buffer — pass
+        ``shuffling_queue_capacity=0``.
     """
 
     def __init__(self, reader, batch_size, shuffling_queue_capacity=0,
                  min_after_retrieve=None, seed=None, drop_last=True, to_device=None,
-                 resume_state=None):
+                 resume_state=None, collate_spec=None, bucket_boundaries=None):
         if batch_size < 1:
             raise ValueError('batch_size must be >= 1')
         self.reader = reader
@@ -179,6 +195,27 @@ class JaxDataLoader(object):
         # nested window blocks, buffered under flat (offset, field) keys.
         self._columnar = bool(reader.batched_output)
         self._columnar_ngram = self._columnar and self._ngram is not None
+        # ragged collation + bucket-by-length batching (docs/sequence.md)
+        self._collate_spec = collate_spec
+        self._bucket_boundaries = tuple(bucket_boundaries) if bucket_boundaries else None
+        self._pad_stats = {'real_tokens': 0, 'padded_tokens': 0}
+        if collate_spec is not None:
+            if self._columnar:
+                raise ValueError(
+                    "collate_spec requires a row-oriented reader (output='rows'): "
+                    'ragged collation pads per-row cells, and columnar blocks are '
+                    'already stacked')
+            if self._ngram is not None:
+                raise ValueError('collate_spec is not supported with ngram windows '
+                                 '(windows collate per offset, not per ragged field)')
+        if self._bucket_boundaries is not None:
+            if collate_spec is None:
+                raise ValueError('bucket_boundaries requires collate_spec: bucketing '
+                                 "batches by the spec's length field")
+            if shuffling_queue_capacity > 0:
+                raise ValueError('bucket_boundaries replaces the shuffling buffer '
+                                 '(seed drives the within-bucket shuffle); pass '
+                                 'shuffling_queue_capacity=0')
         # shuffle knob state: _make_buffer reads these LIVE, so a runtime
         # set_shuffle_capacity (the autotuner's shuffle knob) applies to the
         # current buffer and to every buffer built for later epochs
@@ -221,6 +258,11 @@ class JaxDataLoader(object):
         """Build the client-side buffer from the CURRENT shuffle knob values
         (one construction site for first iteration and every later epoch)."""
         capacity = self._shuffle_capacity
+        if self._bucket_boundaries is not None:
+            from petastorm_tpu.sequence.bucket import BucketBatchBuffer
+            return BucketBatchBuffer(self._bucket_boundaries, self.batch_size,
+                                     self._collate_spec.length_of,
+                                     seed=self._shuffle_seed)
         if self._columnar:
             from petastorm_tpu.columnar import FifoColumnarBuffer, ShuffledColumnarBuffer
             if capacity > 0:
@@ -465,6 +507,12 @@ class JaxDataLoader(object):
             sp.link(self.last_trace)
             if self._ngram is not None:
                 batch = self._collate_ngram(rows)
+            elif self._collate_spec is not None:
+                from petastorm_tpu.sequence.collate import (collate_ragged_rows,
+                                                            padding_waste_fraction)
+                batch = collate_ragged_rows(rows, self._collate_spec, self._pad_stats)
+                obs.gauge_set('padding_waste_fraction',
+                              padding_waste_fraction(self._pad_stats))
             else:
                 batch = collate_rows(rows)
         obs.count('loader_batches_total')
@@ -493,10 +541,16 @@ class JaxDataLoader(object):
             wait_fraction = round(self._reader_wait_s / elapsed, 4)
         else:
             wait_fraction = 0.0
+        if self._collate_spec is not None:
+            from petastorm_tpu.sequence.collate import padding_waste_fraction
+            waste = padding_waste_fraction(self._pad_stats)
+        else:
+            waste = 0.0
         out.update({
             'rows_emitted': self._rows_out,
             'reader_wait_s': round(self._reader_wait_s, 4),
             'reader_wait_fraction': wait_fraction,
+            'padding_waste_fraction': waste,
         })
         # zero-copy borrow accounting (docs/native.md): the loader's shuffle
         # buffer and prefetched batches are exactly the borrows that keep
@@ -557,8 +611,20 @@ def stack_ngram_time_axis(ngram_batch):
     common = set(ngram_batch[offsets[0]])
     for off in offsets[1:]:
         common &= set(ngram_batch[off])
-    return {name: np.stack([ngram_batch[off][name] for off in offsets], axis=1)
-            for name in sorted(common)}
+    out = {}
+    for name in sorted(common):
+        cols = [ngram_batch[off][name] for off in offsets]
+        try:
+            out[name] = np.stack(cols, axis=1)
+        except ValueError:
+            shapes = sorted({np.shape(c) for c in cols})
+            raise PetastormTpuError(
+                'NGram field {!r} has non-uniform shapes across timesteps '
+                '{}: {}. Pad/crop it to a fixed shape with a TransformSpec, or '
+                'collate ragged fields via petastorm_tpu.sequence '
+                '(docs/sequence.md) before stacking the time axis.'.format(
+                    name, offsets, shapes))
+    return out
 
 
 def make_jax_dataset(reader, batch_size, **loader_kwargs):

@@ -98,6 +98,18 @@ def stall_report(diagnostics):
     # them would misattribute recovery overhead to IO/decode
     recovery = {k: int(diagnostics.get(k, 0) or 0)
                 for k in ('worker_restarts', 'items_requeued', 'items_quarantined')}
+    # mixture accounting (docs/sequence.md): a starved mixture source skews
+    # the sampled distribution long before it stalls the pipeline, so the
+    # per-source counters ride along with the stall attribution
+    mixture = {}
+    i = 0
+    while 'mixture_source_{}_rows'.format(i) in diagnostics:
+        mixture[i] = {
+            'rows': int(diagnostics['mixture_source_{}_rows'.format(i)] or 0),
+            'tokens': int(diagnostics.get('mixture_source_{}_tokens'.format(i), 0) or 0),
+            'exhausted': bool(diagnostics.get('mixture_source_{}_exhausted'.format(i), 0)),
+        }
+        i += 1
     return {
         'reader_wait_s': round(wait, 4),
         'reader_wait_fraction': diagnostics.get('reader_wait_fraction'),
@@ -109,6 +121,7 @@ def stall_report(diagnostics):
         'hint': _HINTS.get(bottleneck),
         'worker_busy_s': {k: round(v, 4) for k, v in busy.items()},
         'recovery': recovery,
+        'mixture': mixture,
     }
 
 
@@ -153,4 +166,12 @@ def format_stall_report(report):
                          recovery.get('worker_restarts', 0),
                          recovery.get('items_requeued', 0),
                          recovery.get('items_quarantined', 0)))
+    mixture = report.get('mixture') or {}
+    if mixture:
+        lines.append('  mixture sources:')
+        total_rows = sum(src['rows'] for src in mixture.values()) or 1
+        for i, src in sorted(mixture.items()):
+            lines.append('    source {:<3d} {:>10d} rows ({:5.1f}%)  {:>12d} tokens{}'.format(
+                i, src['rows'], src['rows'] / total_rows * 100.0, src['tokens'],
+                '  [exhausted]' if src['exhausted'] else ''))
     return '\n'.join(lines)

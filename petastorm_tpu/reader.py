@@ -142,7 +142,8 @@ def make_reader(dataset_url,
                 protocol_monitor=None,
                 serve=None, serve_weight=1,
                 zero_copy=False,
-                elastic=None):
+                elastic=None,
+                piece_filter=None):
     """Reader for datasets written by :func:`materialize_dataset` — rows decoded
     through the stored Unischema's codecs (reference reader.py:50-174).
 
@@ -267,7 +268,18 @@ def make_reader(dataset_url,
         — bit-identical with or without churn. Not supported with
         ``elastic``: ``cur_shard``/``shard_count``, ``resume_state``
         (the pod-wide commit scoreboard IS the read position), ``serve``.
+    :param piece_filter: ``callable(RowGroupPiece) -> bool`` applied to the
+        piece list straight after ``load_row_groups``, BEFORE selector /
+        predicate / shard — scopes the reader to a subset of row groups
+        identified by ``(path, row_group)``. This is how
+        :class:`~petastorm_tpu.sequence.tail.TailFollowingReader` pins each
+        inner epoch to one published snapshot delta (docs/sequence.md); note
+        selector index sets and v2 resume cursors are then expressed in the
+        FILTERED enumeration. Not supported with ``serve``.
     """
+    if serve and piece_filter is not None:
+        raise ValueError('piece_filter is not supported with serve=: the shared '
+                         'daemon owns one static stream plan (docs/serve.md)')
     if serve and elastic:
         raise ValueError('elastic is not supported with serve=: the shared '
                          'daemon owns one static stream plan (docs/serve.md)')
@@ -340,7 +352,8 @@ def make_reader(dataset_url,
                   chunk_cache_size_limit=chunk_cache_size_limit,
                   telemetry=telemetry,
                   autotune=autotune,
-                  elastic=elastic)
+                  elastic=elastic,
+                  piece_filter=piece_filter)
 
 
 def _make_served(dataset_url, batch_reader, schema_fields, seed,
@@ -430,7 +443,8 @@ def make_batch_reader(dataset_url,
                       protocol_monitor=None,
                       serve=None, serve_weight=1,
                       zero_copy=False,
-                      elastic=None):
+                      elastic=None,
+                      piece_filter=None):
     """Columnar reader for ANY Parquet store (reference reader.py:177-289):
     yields one namedtuple of numpy column arrays per row group
     (``batched_output=True``). Schema is inferred from the Arrow schema unless
@@ -468,7 +482,13 @@ def make_batch_reader(dataset_url,
     ``elastic``: lease-based elastic pod sharding with exactly-once commit
     handoff (docs/parallelism.md) — identical semantics to
     :func:`make_reader`.
+
+    ``piece_filter``: row-group scoping predicate applied before any other
+    filtering (docs/sequence.md) — identical semantics to :func:`make_reader`.
     """
+    if serve and piece_filter is not None:
+        raise ValueError('piece_filter is not supported with serve=: the shared '
+                         'daemon owns one static stream plan (docs/serve.md)')
     if serve and elastic:
         raise ValueError('elastic is not supported with serve=: the shared '
                          'daemon owns one static stream plan (docs/serve.md)')
@@ -517,7 +537,8 @@ def make_batch_reader(dataset_url,
                   chunk_cache_size_limit=chunk_cache_size_limit,
                   telemetry=telemetry,
                   autotune=autotune,
-                  elastic=elastic)
+                  elastic=elastic,
+                  piece_filter=piece_filter)
 
 
 class Reader(object):
@@ -530,7 +551,7 @@ class Reader(object):
                  num_epochs=1, cur_shard=None, shard_count=None, cache=None,
                  transform_spec=None, ngram=None, columnar_ngram=False, resume_state=None,
                  storage_retry_policy=None, chunk_cache=None, chunk_cache_size_limit=None,
-                 telemetry=None, autotune=None, elastic=None):
+                 telemetry=None, autotune=None, elastic=None, piece_filter=None):
         if (cur_shard is None) != (shard_count is None):
             raise ValueError('cur_shard and shard_count must be specified together')
         if cur_shard is not None and not 0 <= cur_shard < shard_count:
@@ -588,6 +609,10 @@ class Reader(object):
         # load_row_groups enumeration, so it must run first) -> predicate -> shard
         pieces = dataset_metadata.load_row_groups(dataset_url, schema=schema,
                                                   retry_policy=storage_retry_policy)
+        if piece_filter is not None:
+            # scoping comes FIRST: everything downstream (selector index sets,
+            # the global resume cursor) is expressed in the filtered enumeration
+            pieces = [p for p in pieces if piece_filter(p)]
         if rowgroup_selector is not None:
             pieces = self._apply_rowgroup_selector(dataset_url, pieces, rowgroup_selector,
                                                    storage_retry_policy)

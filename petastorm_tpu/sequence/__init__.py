@@ -1,0 +1,51 @@
+"""Sequence data plane: variable-length (token) workloads as first-class
+citizens.
+
+The rest of the framework moves fixed-shape tensors; this package owns
+everything whose shape is data-dependent (docs/sequence.md):
+
+* :mod:`collate` — ragged/padded collation: per-field ``pad_to`` multiples /
+  bucket boundaries, per-batch length vectors, padding-waste telemetry
+  (``padding_waste_fraction``). Wired into
+  :class:`~petastorm_tpu.jax.loader.JaxDataLoader` via ``collate_spec=``.
+* :mod:`bucket` — bucket-by-length batching: a drop-in client-side loader
+  buffer that releases rows in same-bucket runs of ``batch_size``, so padded
+  batches waste almost nothing. Deterministic, seedable, and
+  checkpoint-compatible with the loader's ``state_dict()``.
+* :mod:`packing` — greedy first-fit-decreasing sequence packing into fixed
+  ``tokens_per_batch`` slots, emitting ``segment_ids``/``positions`` arrays
+  so attention masks can be reconstructed downstream
+  (``packing_efficiency`` telemetry).
+* :mod:`mixture` — :class:`MixtureReader`: hot-swappable per-source weights
+  (``set_weights()`` live, :class:`MixtureSchedule` at epoch boundaries),
+  per-source rows/tokens/exhaustion counters merged into ``diagnostics``
+  and the stall report.
+* :mod:`tail` — tail-following streaming ingest: iterate a dataset a
+  concurrent :func:`~petastorm_tpu.etl.dataset_metadata.materialize_dataset`
+  writer is still appending to. Epoch = one published snapshot
+  (``_snapshots/`` ``O_EXCL`` markers, the elastic generation log as the
+  template), exactly-once row delivery across snapshots, bounded poll
+  cadence, ``dataset_grew`` counter.
+
+Determinism contract: mixture/packing/bucket sampling decisions must never
+consume wall clocks or unseeded global RNG streams — rule PT1400
+(``petastorm_tpu/analysis/sequence_lints.py``) enforces it statically.
+"""
+
+from __future__ import annotations
+
+from petastorm_tpu.sequence.bucket import BucketBatchBuffer
+from petastorm_tpu.sequence.collate import (CollateSpec, PadSpec, collate_ragged_rows,
+                                            padded_length)
+from petastorm_tpu.sequence.mixture import MixtureReader, MixtureSchedule
+from petastorm_tpu.sequence.packing import (PackedSequenceLoader, first_fit_decreasing,
+                                            pack_rows)
+from petastorm_tpu.sequence.tail import (TailFollowingReader, latest_snapshot,
+                                         list_snapshots, publish_snapshot)
+
+__all__ = [
+    'BucketBatchBuffer', 'CollateSpec', 'MixtureReader', 'MixtureSchedule',
+    'PackedSequenceLoader', 'PadSpec', 'TailFollowingReader',
+    'collate_ragged_rows', 'first_fit_decreasing', 'latest_snapshot',
+    'list_snapshots', 'pack_rows', 'padded_length', 'publish_snapshot',
+]

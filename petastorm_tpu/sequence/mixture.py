@@ -1,0 +1,167 @@
+"""Hot-swappable dataset mixtures with per-source telemetry.
+
+:class:`MixtureReader` grows
+:class:`~petastorm_tpu.weighted_sampling_reader.WeightedSamplingReader` into
+the mixture surface LLM curricula need:
+
+* **live re-weighting** — ``set_weights([...])`` retargets the sampling
+  distribution between two ``next()`` calls (annealing code-vs-prose mid-run
+  without rebuilding readers);
+* **epoch schedules** — a :class:`MixtureSchedule` maps epoch index ->
+  weights, applied at each :meth:`MixtureReader.reset` boundary;
+* **per-source accounting** — rows, tokens (when ``token_field`` names the
+  sequence column) and exhaustion flags per source, surfaced as
+  ``mixture_source_*`` keys in :attr:`MixtureReader.diagnostics` and rendered
+  by the stall report (docs/observability.md).
+
+Determinism (rule PT1400): every sampling decision consumes the seeded
+constructor stream — never a wall clock, never the process-global RNG — so a
+fixed seed reproduces the interleaving exactly, including across
+``set_weights`` calls (a weight swap changes the distribution, not the
+stream).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from petastorm_tpu.errors import PetastormTpuError
+from petastorm_tpu.weighted_sampling_reader import WeightedSamplingReader
+
+
+class MixtureSchedule(object):
+    """Epoch-indexed weight schedule: ``{epoch: weights}`` (or a list of
+    ``(epoch, weights)``). Epoch E uses the entry with the LARGEST key <= E,
+    so ``{0: [9, 1], 3: [5, 5]}`` anneals at epoch 3 and holds after."""
+
+    def __init__(self, entries):
+        items = sorted(dict(entries).items())
+        if not items:
+            raise PetastormTpuError('MixtureSchedule needs at least one entry')
+        if items[0][0] != 0:
+            raise PetastormTpuError('MixtureSchedule must define epoch 0 '
+                                    '(got first epoch {})'.format(items[0][0]))
+        self._entries = [(int(e), tuple(float(w) for w in ws)) for e, ws in items]
+
+    def weights_for(self, epoch):
+        chosen = self._entries[0][1]
+        for e, ws in self._entries:
+            if e > epoch:
+                break
+            chosen = ws
+        return chosen
+
+    def __repr__(self):
+        return 'MixtureSchedule({})'.format(dict(self._entries))
+
+
+class MixtureReader(WeightedSamplingReader):
+    """
+    :param readers: sources to mix (same schema/batched-ness/NGram contract as
+        :class:`WeightedSamplingReader`)
+    :param weights: initial relative weights; ``None`` requires ``schedule``
+    :param seed: seeds the sampling stream
+    :param on_exhausted: ``'renormalize'`` (default) | ``'stop'``
+    :param schedule: optional :class:`MixtureSchedule` (or its ctor argument)
+        applied at construction (epoch 0) and at every :meth:`reset`
+    :param token_field: field whose per-row length counts as tokens in the
+        per-source accounting (``None`` counts rows only)
+    """
+
+    def __init__(self, readers, weights=None, seed=None, on_exhausted='renormalize',
+                 schedule=None, token_field=None):
+        if schedule is not None and not isinstance(schedule, MixtureSchedule):
+            schedule = MixtureSchedule(schedule)
+        if weights is None:
+            if schedule is None:
+                raise PetastormTpuError('MixtureReader needs weights or a schedule')
+            weights = schedule.weights_for(0)
+        super(MixtureReader, self).__init__(readers, weights, seed=seed,
+                                            on_exhausted=on_exhausted)
+        self._schedule = schedule
+        self._token_field = token_field
+        self._epoch = 0
+        self._weight_updates = 0
+        self._source_rows = [0] * len(self._readers)
+        self._source_tokens = [0] * len(self._readers)
+
+    # -- live weight control ------------------------------------------------
+
+    def set_weights(self, weights):
+        """Swap the sampling weights between two ``next()`` calls. Exhausted
+        sources stay exhausted (their new mass renormalizes over the live
+        set); the RNG stream is untouched, so a seeded run stays reproducible
+        across the swap."""
+        if len(weights) != len(self._readers):
+            raise PetastormTpuError('Expected {} weights, got {}'.format(
+                len(self._readers), len(weights)))
+        w = np.asarray(weights, dtype=np.float64)
+        if (w < 0).any() or float(w.sum()) <= 0:
+            raise PetastormTpuError('weights must be non-negative and sum to a '
+                                    'positive value')
+        self._weights = w / float(w.sum())
+        self._rebuild_cum()
+        self._weight_updates += 1
+
+    @property
+    def weights(self):
+        """The current normalized weight vector (including exhausted sources'
+        nominal mass — live renormalization happens at draw time)."""
+        return tuple(float(x) for x in self._weights)
+
+    @property
+    def epoch(self):
+        return self._epoch
+
+    def reset(self):
+        """Epoch boundary: reset every finished source for another pass, revive
+        exhausted ones, and apply the schedule's weights for the new epoch.
+        Infinite sources (``num_epochs=None``) just keep streaming across the
+        boundary — for them an epoch is only a weight-schedule step."""
+        for r in self._readers:
+            if getattr(r, 'last_row_consumed', False):
+                r.reset()
+        self._live = [True] * len(self._readers)
+        self._epoch += 1
+        if self._schedule is not None:
+            self.set_weights(self._schedule.weights_for(self._epoch))
+            self._weight_updates -= 1  # schedule steps are not user swaps
+        else:
+            self._rebuild_cum()
+        self.last_row_consumed = False
+
+    # -- telemetry hooks ----------------------------------------------------
+
+    def _on_row(self, choice, row):
+        if self.batched_output:
+            d = row._asdict() if hasattr(row, '_asdict') else row
+            first = next(iter(d.values()))
+            n = len(first)
+            self._source_rows[choice] += n
+            if self._token_field is not None:
+                col = d[self._token_field]
+                self._source_tokens[choice] += int(sum(len(c) for c in col))
+        else:
+            self._source_rows[choice] += 1
+            if self._token_field is not None:
+                cell = (row[self._token_field] if isinstance(row, dict)
+                        else getattr(row, self._token_field))
+                self._source_tokens[choice] += len(cell)
+
+    @property
+    def diagnostics(self):
+        """Union of every source's diagnostics (sources listed later win key
+        collisions) plus the ``mixture_source_*`` family the stall report
+        renders: per-source rows/tokens/exhausted, the live weight vector,
+        and the count of live weight swaps."""
+        out = {}
+        for r in self._readers:
+            out.update(getattr(r, 'diagnostics', {}) or {})
+        for i in range(len(self._readers)):
+            out['mixture_source_{}_rows'.format(i)] = self._source_rows[i]
+            out['mixture_source_{}_tokens'.format(i)] = self._source_tokens[i]
+            out['mixture_source_{}_exhausted'.format(i)] = int(not self._live[i])
+        out['mixture_weights'] = list(self.weights)
+        out['mixture_weight_updates'] = self._weight_updates
+        out['mixture_epoch'] = self._epoch
+        return out

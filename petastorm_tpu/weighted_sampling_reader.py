@@ -4,6 +4,17 @@ Parity: /root/reference/petastorm/weighted_sampling_reader.py:20-106 — each
 ``__next__`` draws one of the underlying readers from the cumulative probability
 vector; schemas and batched-ness must match. RNG is seedable here (the
 reference's is not).
+
+Beyond the reference: sources usually have DIFFERENT lengths, so "one reader
+raised StopIteration" and "the mixture is exhausted" are different events. The
+``on_exhausted`` policy makes the distinction explicit — ``'renormalize'``
+(default) drops the exhausted source and redistributes its probability mass
+over the live ones, so the mixture ends only when every source is dry;
+``'stop'`` preserves the reference's behavior (and the proportions: stopping at
+the first exhaustion never over-samples the longer sources). The richer
+mixture surface (live ``set_weights``, epoch schedules, per-source telemetry)
+lives in :class:`petastorm_tpu.sequence.mixture.MixtureReader`, which builds
+on this class.
 """
 
 from __future__ import annotations
@@ -14,15 +25,35 @@ from petastorm_tpu.errors import PetastormTpuError
 
 
 class WeightedSamplingReader(object):
-    def __init__(self, readers, probabilities, seed=None):
+    """
+    :param readers: readers to mix; schemas, batched-ness and NGram specs must
+        agree
+    :param probabilities: relative sampling weights (normalized internally)
+    :param seed: seeds the sampling stream; ``None`` = nondeterministic
+    :param on_exhausted: ``'renormalize'`` (default) — when one source
+        exhausts, renormalize the remaining probability mass over the live
+        sources and keep going until ALL are dry; ``'stop'`` — first
+        exhausted source ends the whole mixture (the original petastorm
+        behavior).
+    """
+
+    def __init__(self, readers, probabilities, seed=None, on_exhausted='renormalize'):
         if len(readers) != len(probabilities) or not readers:
             raise PetastormTpuError('readers and probabilities must be non-empty, same length')
+        if on_exhausted not in ('stop', 'renormalize'):
+            raise PetastormTpuError(
+                "on_exhausted must be 'stop' or 'renormalize', got {!r}".format(on_exhausted))
         total = float(sum(probabilities))
         if total <= 0:
             raise PetastormTpuError('probabilities must sum to a positive value')
         self._readers = list(readers)
-        self._cum = np.cumsum(np.asarray(probabilities, dtype=np.float64) / total)
+        self._weights = np.asarray(probabilities, dtype=np.float64) / total
+        self._live = [True] * len(readers)
+        self._cum = None
+        self._live_indices = None
+        self._rebuild_cum()
         self._rng = np.random.default_rng(seed)
+        self._on_exhausted = on_exhausted
 
         first = self._readers[0]
         for other in self._readers[1:]:
@@ -37,19 +68,51 @@ class WeightedSamplingReader(object):
         self.transformed_schema = first.transformed_schema
         self.last_row_consumed = False
 
+    def _rebuild_cum(self):
+        """Cumulative probability vector over the LIVE sources only — the
+        renormalization step: dead sources' mass redistributes proportionally."""
+        self._live_indices = [i for i, alive in enumerate(self._live) if alive]
+        if not self._live_indices:
+            self._cum = np.empty(0, dtype=np.float64)
+            return
+        live_w = self._weights[self._live_indices]
+        total = float(live_w.sum())
+        if total <= 0:  # every live weight is 0 (set_weights zeroed them): uniform
+            live_w = np.ones(len(self._live_indices), dtype=np.float64)
+            total = float(len(self._live_indices))
+        self._cum = np.cumsum(live_w / total)
+
     def __iter__(self):
         return self
 
     def __next__(self):
-        choice = int(np.searchsorted(self._cum, self._rng.random(), side='right'))
-        choice = min(choice, len(self._readers) - 1)
-        try:
-            return next(self._readers[choice])
-        except StopIteration:
-            self.last_row_consumed = True
-            raise
+        while self._live_indices:
+            pos = int(np.searchsorted(self._cum, self._rng.random(), side='right'))
+            pos = min(pos, len(self._live_indices) - 1)
+            choice = self._live_indices[pos]
+            try:
+                row = next(self._readers[choice])
+            except StopIteration:
+                self._on_source_exhausted(choice)
+                if self._on_exhausted == 'stop':
+                    break
+                continue
+            self._on_row(choice, row)
+            return row
+        self.last_row_consumed = True
+        raise StopIteration
 
     next = __next__
+
+    # -- subclass hooks (MixtureReader telemetry) ---------------------------
+
+    def _on_row(self, choice, row):
+        """Called after each successfully drawn row; base class does nothing."""
+
+    def _on_source_exhausted(self, choice):
+        """Mark a source dry and renormalize the live mass."""
+        self._live[choice] = False
+        self._rebuild_cum()
 
     def stop(self):
         for r in self._readers:

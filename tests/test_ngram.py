@@ -114,3 +114,96 @@ class TestNgramEndToEnd:
             starts = sorted(w[0].id for w in reader)
         expected = sorted(i for i in range(100) if i % 10 <= 8)
         assert starts == expected
+
+
+class TestFormNgramColumnarParity:
+    """form_ngram_columnar must agree window-for-window with the row path."""
+
+    def _block(self, ids):
+        ids = np.asarray(ids, dtype=np.int64)
+        return {'id': ids, 'id2': ids * 10}
+
+    def _row_windows(self, ngram, ids):
+        rows = [{'id': int(i), 'id2': int(i) * 10} for i in ids]
+        return ngram.form_ngram(rows, TestSchema)
+
+    def _assert_parity(self, ngram, ids):
+        row_out = self._row_windows(ngram, ids)
+        col_out = ngram.form_ngram_columnar(self._block(ids))
+        if not row_out:
+            assert col_out is None
+            return
+        offsets = sorted(row_out[0])
+        for t in offsets:
+            col_ids = col_out[t]['id'] if 'id' in col_out[t] else None
+            if col_ids is not None:
+                assert [w[t]['id'] for w in row_out] == list(col_ids)
+            if 'id2' in col_out[t]:
+                assert [w[t]['id2'] for w in row_out] == list(col_out[t]['id2'])
+
+    def test_parity_sorted_contiguous(self):
+        self._assert_parity(_ts_ngram(length=3), range(8))
+
+    def test_parity_unsorted_with_gaps(self):
+        self._assert_parity(_ts_ngram(length=2, delta_threshold=1),
+                            [9, 3, 1, 0, 5, 6, 2, 12, 13])
+
+    def test_parity_no_overlap_greedy(self):
+        self._assert_parity(_ts_ngram(length=2, overlap=False),
+                            [4, 0, 1, 2, 3, 5, 8, 9])
+
+    def test_parity_no_qualifying_window(self):
+        self._assert_parity(_ts_ngram(length=2, delta_threshold=1), [0, 5, 10])
+
+    def test_parity_per_timestep_fields(self):
+        ngram = NGram({0: [TestSchema.id, TestSchema.id2], 1: [TestSchema.id]},
+                      delta_threshold=1, timestamp_field=TestSchema.id)
+        row_out = self._row_windows(ngram, range(5))
+        col_out = ngram.form_ngram_columnar(self._block(range(5)))
+        assert set(col_out[0]) == {'id', 'id2'}
+        assert set(col_out[1]) == {'id'}
+        assert [w[1]['id'] for w in row_out] == list(col_out[1]['id'])
+
+
+class TestColumnarNgramEndToEnd:
+    def test_columnar_reader_parity_on_shuffled_store(self, synthetic_dataset):
+        """Same windows from the row and columnar paths over a shuffled
+        multi-row-group store (order may differ; the window SET must not)."""
+        def starts(output):
+            ngram = _ts_ngram(length=3, delta_threshold=1)
+            with make_reader(synthetic_dataset.url, reader_pool_type='dummy',
+                             ngram=ngram, output=output,
+                             shuffle_row_groups=True, seed=123) as reader:
+                result = []
+                for item in reader:
+                    if output == 'columnar':
+                        result.extend(int(i) for i in item[0]['id'])
+                    else:
+                        result.append(int(item[0].id))
+                return sorted(result)
+
+        assert starts('rows') == starts('columnar')
+
+    def test_stack_ngram_time_axis_parity(self, synthetic_dataset):
+        from petastorm_tpu.jax.loader import stack_ngram_time_axis
+        ngram = _ts_ngram(length=3, delta_threshold=1)
+        with make_reader(synthetic_dataset.url, reader_pool_type='dummy',
+                         ngram=ngram, output='columnar',
+                         shuffle_row_groups=False) as reader:
+            block = next(iter(reader))
+        stacked = stack_ngram_time_axis(block)
+        w = len(block[0]['id'])
+        assert stacked['id'].shape == (w, 3)
+        # time axis is offset order: consecutive ids within each window
+        np.testing.assert_array_equal(stacked['id'][:, 1], stacked['id'][:, 0] + 1)
+        np.testing.assert_array_equal(stacked['id'][:, 2], stacked['id'][:, 0] + 2)
+        by_id = {r['id']: r['id2'] for r in synthetic_dataset.data}
+        expected_id2 = np.vectorize(by_id.get)(stacked['id'])
+        np.testing.assert_array_equal(stacked['id2'], expected_id2)
+
+
+def test_stack_ngram_time_axis_ragged_field_error():
+    from petastorm_tpu.jax.loader import stack_ngram_time_axis
+    batch = {0: {'id': np.zeros((4, 3))}, 1: {'id': np.zeros((4, 5))}}
+    with pytest.raises(PetastormTpuError, match="'id'.*TransformSpec"):
+        stack_ngram_time_axis(batch)

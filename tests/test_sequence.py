@@ -1,0 +1,589 @@
+"""Sequence data plane: ragged collation, bucketed batching, token packing,
+hot-swappable mixtures, tail-following ingest (docs/sequence.md)."""
+
+import pickle
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from petastorm_tpu.codecs import NdarrayCodec, ScalarCodec
+from petastorm_tpu.errors import PetastormTpuError
+from petastorm_tpu.etl.dataset_metadata import DatasetWriter, materialize_dataset
+from petastorm_tpu.reader import make_reader
+from petastorm_tpu.sequence import (BucketBatchBuffer, CollateSpec, MixtureReader,
+                                    MixtureSchedule, PackedSequenceLoader, PadSpec,
+                                    TailFollowingReader, collate_ragged_rows,
+                                    first_fit_decreasing, latest_snapshot,
+                                    list_snapshots, pack_rows, padded_length,
+                                    publish_snapshot)
+from petastorm_tpu.unischema import Unischema, UnischemaField
+
+TokenSchema = Unischema('TokenSchema', [
+    UnischemaField('id', np.int64, (), ScalarCodec(), False),
+    UnischemaField('tokens', np.int32, (None,), NdarrayCodec(), False),
+])
+
+
+def _token_rows(num_rows, seed=7, max_len=64):
+    rng = np.random.default_rng(seed)
+    rows = []
+    for i in range(num_rows):
+        # zipf-ish mix: mostly short rows, a heavy tail
+        n = int(min(rng.zipf(1.6), max_len))
+        rows.append({'id': i, 'tokens': rng.integers(0, 1000, n, dtype=np.int32)})
+    return rows
+
+
+def _write_token_dataset(path, num_rows=60, rows_per_row_group=10, seed=7,
+                         id_offset=0):
+    url = 'file://' + str(path)
+    rows = _token_rows(num_rows, seed=seed)
+    for r in rows:
+        r['id'] += id_offset
+    with materialize_dataset(url, TokenSchema,
+                             rows_per_row_group=rows_per_row_group) as writer:
+        for row in rows:
+            writer.write(row)
+    return url, rows
+
+
+@pytest.fixture(scope='module')
+def token_dataset(tmp_path_factory):
+    path = tmp_path_factory.mktemp('token_ds')
+    url, rows = _write_token_dataset(path, num_rows=60)
+    return url, rows
+
+
+def _token_reader(url, **kwargs):
+    kwargs.setdefault('reader_pool_type', 'dummy')
+    kwargs.setdefault('shuffle_row_groups', False)
+    return make_reader(url, **kwargs)
+
+
+# -- padded_length / collate_ragged_rows ------------------------------------
+
+def test_padded_length_rounding_and_buckets():
+    assert padded_length(5, PadSpec(pad_to=8)) == 8
+    assert padded_length(8, PadSpec(pad_to=8)) == 8
+    assert padded_length(9, PadSpec(pad_to=8)) == 16
+    assert padded_length(3, PadSpec(buckets=(4, 16, 64))) == 4
+    assert padded_length(17, PadSpec(buckets=(4, 16, 64))) == 64
+    # past the ladder: pad_to rounding (default 1) takes over
+    assert padded_length(65, PadSpec(buckets=(4, 16, 64))) == 65
+    assert padded_length(100, PadSpec(pad_to=8, max_length=32)) == 32
+    assert padded_length(0, PadSpec(pad_to=1)) == 1
+
+
+def test_collate_ragged_rows_pads_and_reports_waste():
+    rows = [{'id': i, 'tokens': np.arange(n, dtype=np.int32)}
+            for i, n in enumerate([3, 5, 2])]
+    spec = CollateSpec({'tokens': PadSpec(pad_to=4, pad_value=-1)})
+    stats = {'real_tokens': 0, 'padded_tokens': 0}
+    batch = collate_ragged_rows(rows, spec, stats)
+    assert batch['tokens'].shape == (3, 8)  # max len 5 -> pad_to 4 -> 8
+    assert batch['tokens'].dtype == np.int32
+    assert list(batch['tokens_lengths']) == [3, 5, 2]
+    assert batch['id'].tolist() == [0, 1, 2]
+    np.testing.assert_array_equal(batch['tokens'][0], [0, 1, 2, -1, -1, -1, -1, -1])
+    assert stats['real_tokens'] == 10
+    assert stats['padded_tokens'] == 24
+
+
+def test_collate_ragged_rows_truncates_at_max_length():
+    rows = [{'tokens': np.arange(n, dtype=np.int32)} for n in (2, 9)]
+    spec = CollateSpec({'tokens': PadSpec(pad_to=1, max_length=4)})
+    batch = collate_ragged_rows(rows, spec)
+    assert batch['tokens'].shape == (2, 4)
+    assert list(batch['tokens_lengths']) == [2, 4]
+    np.testing.assert_array_equal(batch['tokens'][1], [0, 1, 2, 3])
+
+
+def test_collate_rows_error_points_at_collate_spec(token_dataset):
+    from petastorm_tpu.jax.loader import collate_rows
+    rows = [{'tokens': np.arange(3)}, {'tokens': np.arange(5)}]
+    with pytest.raises(PetastormTpuError, match='collate_spec=CollateSpec'):
+        collate_rows(rows)
+
+
+# -- loader integration ------------------------------------------------------
+
+def test_loader_ragged_collation_end_to_end(token_dataset):
+    from petastorm_tpu.jax import JaxDataLoader
+    url, rows = token_dataset
+    by_id = {r['id']: r for r in rows}
+    spec = CollateSpec({'tokens': PadSpec(pad_to=8)})
+    with _token_reader(url) as reader:
+        loader = JaxDataLoader(reader, batch_size=10, drop_last=False,
+                               collate_spec=spec)
+        seen = 0
+        for batch in loader:
+            lengths = batch['tokens_lengths']
+            assert batch['tokens'].shape[1] % 8 == 0
+            assert batch['tokens'].shape[1] >= int(lengths.max())
+            for row_id, length, padded in zip(batch['id'], lengths, batch['tokens']):
+                np.testing.assert_array_equal(
+                    padded[:length], by_id[int(row_id)]['tokens'])
+                assert not padded[length:].any()  # pad_value 0
+                seen += 1
+        assert seen == len(rows)
+        waste = loader.diagnostics['padding_waste_fraction']
+        assert 0.0 < waste < 1.0
+
+
+def test_loader_diagnostics_carry_padding_waste_key(token_dataset):
+    from petastorm_tpu.jax import JaxDataLoader
+    url, _ = token_dataset
+    with _token_reader(url) as reader:
+        loader = JaxDataLoader(reader, batch_size=10)
+        # key-set-always-present contract, zero before iteration
+        assert loader.diagnostics['padding_waste_fraction'] == 0.0
+
+
+def test_loader_collate_spec_rejects_columnar(token_dataset):
+    from petastorm_tpu.jax import JaxDataLoader
+    url, _ = token_dataset
+    with _token_reader(url, output='columnar') as reader:
+        with pytest.raises(ValueError, match='row-oriented'):
+            JaxDataLoader(reader, batch_size=10,
+                          collate_spec=CollateSpec({'tokens': PadSpec(pad_to=8)}))
+
+
+def test_loader_bucket_boundaries_require_collate_spec(token_dataset):
+    from petastorm_tpu.jax import JaxDataLoader
+    url, _ = token_dataset
+    with _token_reader(url) as reader:
+        with pytest.raises(ValueError, match='collate_spec'):
+            JaxDataLoader(reader, batch_size=10, bucket_boundaries=(8, 32))
+        with pytest.raises(ValueError, match='shuffling buffer'):
+            JaxDataLoader(reader, batch_size=10, shuffling_queue_capacity=20,
+                          collate_spec=CollateSpec({'tokens': PadSpec(pad_to=8)}),
+                          bucket_boundaries=(8, 32))
+
+
+def _bucketed_batches(url, seed, limit=None):
+    from petastorm_tpu.jax import JaxDataLoader
+    spec = CollateSpec({'tokens': PadSpec(buckets=(4, 8, 16, 64))})
+    batches = []
+    with _token_reader(url, seed=seed) as reader:
+        loader = JaxDataLoader(reader, batch_size=5, drop_last=False, seed=seed,
+                               collate_spec=spec, bucket_boundaries=(4, 8, 16, 64))
+        for batch in loader:
+            batches.append(batch)
+            if limit is not None and len(batches) >= limit:
+                break
+    return batches
+
+
+def test_bucketed_batching_groups_by_length_and_is_deterministic(token_dataset):
+    url, rows = token_dataset
+    first = _bucketed_batches(url, seed=21)
+    again = _bucketed_batches(url, seed=21)
+    assert len(first) == len(again)
+    for a, b in zip(first, again):
+        np.testing.assert_array_equal(a['id'], b['id'])
+        np.testing.assert_array_equal(a['tokens'], b['tokens'])
+    # full batches released from a filled bucket span one bucket each; the
+    # boundary ladder means their padded width is the bucket boundary
+    boundaries = (4, 8, 16, 64)
+    full = [b for b in first if len(b['id']) == 5]
+    assert full, 'expected at least one full bucket release'
+    for batch in full[:len(full) - len(boundaries)]:
+        assert batch['tokens'].shape[1] in boundaries
+    # every row is delivered exactly once
+    delivered = [int(i) for b in first for i in b['id']]
+    assert sorted(delivered) == [r['id'] for r in rows]
+
+
+def test_bucketed_batching_checkpoint_resume(token_dataset):
+    from petastorm_tpu.jax import JaxDataLoader
+    url, rows = token_dataset
+    spec = CollateSpec({'tokens': PadSpec(buckets=(4, 8, 16, 64))})
+
+    def build(resume=None, reader_state=None):
+        reader = _token_reader(url, seed=33, resume_state=reader_state)
+        loader = JaxDataLoader(reader, batch_size=5, drop_last=False, seed=33,
+                               collate_spec=spec, bucket_boundaries=(4, 8, 16, 64),
+                               resume_state=resume)
+        return reader, loader
+
+    reader, loader = build()
+    it = iter(loader)
+    first = [int(i) for _ in range(4) for i in next(it)['id']]
+    state = pickle.loads(pickle.dumps(loader.state_dict()))
+    reader.stop(); reader.join()
+
+    reader2, resumed = build(resume=state, reader_state=state['reader'])
+    rest = [int(i) for b in resumed for i in b['id']]
+    reader2.stop(); reader2.join()
+
+    combined = first + rest
+    all_ids = {r['id'] for r in rows}
+    assert set(combined) == all_ids
+    # dupes only from the row group partially pulled out of the reader
+    dupes = [i for i in all_ids if combined.count(i) > 1]
+    assert len(dupes) <= 10, sorted(dupes)
+
+
+def test_bucket_buffer_rejects_bad_args():
+    with pytest.raises(ValueError):
+        BucketBatchBuffer((), 4, 'tokens')
+    with pytest.raises(ValueError):
+        BucketBatchBuffer((4, 8), 0, 'tokens')
+
+
+# -- packing -----------------------------------------------------------------
+
+def test_first_fit_decreasing_respects_capacity():
+    lengths = [7, 2, 5, 5, 3, 1]
+    bins = first_fit_decreasing(lengths, capacity=8)
+    flat = sorted(i for b in bins for i in b)
+    assert flat == list(range(len(lengths)))
+    for b in bins:
+        assert sum(lengths[i] for i in b) <= 8
+    with pytest.raises(PetastormTpuError, match='exceeds tokens_per_batch'):
+        first_fit_decreasing([9], capacity=8)
+
+
+def test_pack_rows_segments_and_positions():
+    rows = [{'tokens': np.arange(n, dtype=np.int32) + 10 * n} for n in (5, 3, 4)]
+    batch, stats = pack_rows(rows, tokens_per_batch=8, sequence_fields=['tokens'])
+    # FFD order: 5 then 4 won't fit slot 0 (5+4>8) -> new slot; 3 joins slot 0
+    assert batch['tokens'].shape == (2, 8)
+    np.testing.assert_array_equal(batch['segment_ids'][0], [1, 1, 1, 1, 1, 2, 2, 2])
+    np.testing.assert_array_equal(batch['positions'][0], [0, 1, 2, 3, 4, 0, 1, 2])
+    np.testing.assert_array_equal(batch['segment_ids'][1], [1, 1, 1, 1, 0, 0, 0, 0])
+    assert batch['num_segments'].tolist() == [2, 1]
+    assert stats['real_tokens'] == 12
+    assert stats['slot_tokens'] == 16
+    assert stats['packing_efficiency'] == 0.75
+
+
+def test_packed_sequence_loader_delivers_all_tokens(token_dataset):
+    url, rows = token_dataset
+    total_real = sum(len(r['tokens']) for r in rows)
+    with _token_reader(url) as reader:
+        loader = PackedSequenceLoader(reader, tokens_per_batch=64,
+                                      sequence_fields=['tokens'],
+                                      slots_per_batch=4, pool_rows=32)
+        delivered = 0
+        for batch in loader:
+            mask = batch['segment_ids'] > 0
+            delivered += int(mask.sum())
+            assert batch['tokens'].shape[1] == 64
+        assert delivered == total_real
+        assert loader.packing_efficiency > 0.5
+        diag = loader.diagnostics
+        assert diag['packed_real_tokens'] == total_real
+        assert diag['packed_batches'] > 0
+
+
+def test_packed_sequence_loader_deterministic(token_dataset):
+    url, _ = token_dataset
+
+    def run():
+        out = []
+        with _token_reader(url) as reader:
+            loader = PackedSequenceLoader(reader, tokens_per_batch=64,
+                                          sequence_fields=['tokens'],
+                                          slots_per_batch=4, pool_rows=32)
+            for batch in loader:
+                out.append(batch['tokens'].copy())
+        return out
+
+    a, b = run(), run()
+    assert len(a) == len(b)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+
+
+def test_packed_sequence_loader_checkpoint_roundtrip(token_dataset):
+    url, _ = token_dataset
+    with _token_reader(url) as reader:
+        loader = PackedSequenceLoader(reader, tokens_per_batch=64,
+                                      sequence_fields=['tokens'],
+                                      slots_per_batch=2, pool_rows=16)
+        it = iter(loader)
+        next(it)
+        state = pickle.loads(pickle.dumps(loader.state_dict()))
+    assert state['version'] == 1
+    assert isinstance(state['rows'], list)
+    with _token_reader(url, resume_state=state['reader']) as reader2:
+        resumed = PackedSequenceLoader(reader2, tokens_per_batch=64,
+                                       sequence_fields=['tokens'],
+                                       slots_per_batch=2, pool_rows=16,
+                                       resume_state=state)
+        batches = list(resumed)
+        assert batches  # pooled rows + remaining stream keep flowing
+
+
+# -- mixtures ----------------------------------------------------------------
+
+def _two_source_urls(tmp_path_factory):
+    p1 = tmp_path_factory.mktemp('mix_a')
+    p2 = tmp_path_factory.mktemp('mix_b')
+    url_a, rows_a = _write_token_dataset(p1, num_rows=40, seed=1)
+    url_b, rows_b = _write_token_dataset(p2, num_rows=10, seed=2, id_offset=1000)
+    return (url_a, rows_a), (url_b, rows_b)
+
+
+@pytest.fixture(scope='module')
+def mixture_sources(tmp_path_factory):
+    return _two_source_urls(tmp_path_factory)
+
+
+def test_weighted_sampling_renormalizes_after_exhaustion(mixture_sources):
+    # regression: one dry source used to end the WHOLE mixture, silently
+    # truncating every longer source
+    (url_a, rows_a), (url_b, rows_b) = mixture_sources
+    with _token_reader(url_a) as ra, _token_reader(url_b) as rb:
+        mixed = MixtureReader([ra, rb], weights=[0.5, 0.5], seed=17)
+        ids = [int(r.id) for r in mixed]
+    assert len(ids) == len(rows_a) + len(rows_b)
+    assert {i for i in ids if i >= 1000} == {r['id'] for r in rows_b}
+    assert mixed.diagnostics['mixture_source_1_exhausted'] == 1
+
+
+def test_weighted_sampling_stop_policy_preserves_reference_behavior(mixture_sources):
+    from petastorm_tpu.weighted_sampling_reader import WeightedSamplingReader
+    (url_a, rows_a), (url_b, rows_b) = mixture_sources
+    with _token_reader(url_a) as ra, _token_reader(url_b) as rb:
+        mixed = WeightedSamplingReader([ra, rb], [0.5, 0.5], seed=17,
+                                       on_exhausted='stop')
+        ids = [int(r.id) for r in mixed]
+    assert len(ids) < len(rows_a) + len(rows_b)
+    assert mixed.last_row_consumed
+
+
+def test_weighted_sampling_rejects_bad_policy(mixture_sources):
+    from petastorm_tpu.weighted_sampling_reader import WeightedSamplingReader
+    (url_a, _), (url_b, _) = mixture_sources
+    with _token_reader(url_a) as ra, _token_reader(url_b) as rb:
+        with pytest.raises(PetastormTpuError, match='on_exhausted'):
+            WeightedSamplingReader([ra, rb], [1, 1], on_exhausted='ignore')
+
+
+def test_mixture_set_weights_live_and_validated(mixture_sources):
+    (url_a, _), (url_b, _) = mixture_sources
+    with _token_reader(url_a, num_epochs=None) as ra, \
+            _token_reader(url_b, num_epochs=None) as rb:
+        mixed = MixtureReader([ra, rb], weights=[1, 0], seed=5,
+                              token_field='tokens')
+        for _ in range(20):
+            next(mixed)
+        assert mixed.diagnostics['mixture_source_1_rows'] == 0
+        mixed.set_weights([0, 1])
+        for _ in range(20):
+            next(mixed)
+        diag = mixed.diagnostics
+        assert diag['mixture_source_0_rows'] == 20
+        assert diag['mixture_source_1_rows'] == 20
+        assert diag['mixture_source_1_tokens'] > 0
+        assert diag['mixture_weight_updates'] == 1
+        with pytest.raises(PetastormTpuError):
+            mixed.set_weights([1])  # wrong arity
+        with pytest.raises(PetastormTpuError):
+            mixed.set_weights([-1, 2])
+        mixed.stop(); mixed.join()
+
+
+def test_mixture_determinism_under_seed(mixture_sources):
+    (url_a, _), (url_b, _) = mixture_sources
+
+    def run():
+        with _token_reader(url_a) as ra, _token_reader(url_b) as rb:
+            mixed = MixtureReader([ra, rb], weights=[0.7, 0.3], seed=99)
+            return [int(r.id) for r in mixed]
+
+    assert run() == run()
+
+
+def test_mixture_schedule_applies_at_epoch_boundary(mixture_sources):
+    (url_a, _), (url_b, _) = mixture_sources
+    schedule = MixtureSchedule({0: [1, 0], 1: [0, 1]})
+    assert schedule.weights_for(0) == (1.0, 0.0)
+    assert schedule.weights_for(5) == (0.0, 1.0)
+    with _token_reader(url_a, num_epochs=None) as ra, \
+            _token_reader(url_b, num_epochs=None) as rb:
+        mixed = MixtureReader([ra, rb], seed=3, schedule=schedule)
+        assert mixed.weights == (1.0, 0.0)
+        for _ in range(5):
+            next(mixed)
+        mixed.reset()
+        assert mixed.epoch == 1
+        assert mixed.weights == (0.0, 1.0)
+        for _ in range(5):
+            next(mixed)
+        diag = mixed.diagnostics
+        assert diag['mixture_epoch'] == 1
+        assert diag['mixture_weight_updates'] == 0  # schedule steps don't count
+        assert diag['mixture_source_0_rows'] == 5
+        assert diag['mixture_source_1_rows'] == 5
+        mixed.stop(); mixed.join()
+
+
+def test_mixture_schedule_requires_epoch_zero():
+    with pytest.raises(PetastormTpuError, match='epoch 0'):
+        MixtureSchedule({1: [1, 1]})
+
+
+def test_stall_report_renders_mixture_sources(mixture_sources):
+    from petastorm_tpu.observability.report import format_stall_report, stall_report
+    (url_a, _), (url_b, _) = mixture_sources
+    with _token_reader(url_a) as ra, _token_reader(url_b) as rb:
+        mixed = MixtureReader([ra, rb], weights=[0.5, 0.5], seed=17,
+                              token_field='tokens')
+        for _ in range(10):
+            next(mixed)
+        report = stall_report(mixed.diagnostics)
+        assert set(report['mixture']) == {0, 1}
+        rendered = format_stall_report(report)
+        assert 'mixture sources' in rendered
+        assert 'source 0' in rendered
+        mixed.stop(); mixed.join()
+
+
+# -- tail following ----------------------------------------------------------
+
+def _append_rows(url, rows, rows_per_row_group=5, final=False):
+    writer = DatasetWriter(url, TokenSchema, rows_per_row_group=rows_per_row_group,
+                           append=True)
+    for row in rows:
+        writer.write(row)
+    snap = writer.publish(final=final)
+    writer.close()
+    return snap
+
+
+def test_publish_snapshot_and_listing(tmp_path):
+    url, _ = _write_token_dataset(tmp_path / 'ds', num_rows=10,
+                                  rows_per_row_group=5)
+    snap0 = publish_snapshot(url)
+    assert snap0 == 0
+    snaps = list_snapshots(url)
+    assert [s for s, _ in snaps] == [0]
+    info = latest_snapshot(url)
+    assert len(info['pieces']) == 2  # 10 rows / 5 per group
+    assert info['final'] is False
+
+
+def test_append_writer_extends_dataset(tmp_path):
+    url, rows = _write_token_dataset(tmp_path / 'ds', num_rows=10,
+                                     rows_per_row_group=5)
+    publish_snapshot(url)
+    extra = _token_rows(10, seed=11)
+    for r in extra:
+        r['id'] += 100
+    snap = _append_rows(url, extra)
+    assert snap == 1
+    info = latest_snapshot(url)
+    assert len(info['pieces']) == 4  # cumulative inventory
+    # the whole dataset reads back: no part-file collision clobbered anything
+    with _token_reader(url, schema_fields=['id']) as reader:
+        ids = sorted(int(r.id) for r in reader)
+    assert ids == sorted([r['id'] for r in rows] + [r['id'] for r in extra])
+
+
+def test_tail_following_exactly_once_across_cycles(tmp_path):
+    url, rows = _write_token_dataset(tmp_path / 'ds', num_rows=10,
+                                     rows_per_row_group=5)
+    publish_snapshot(url)
+    expected = [r['id'] for r in rows]
+    # three append/publish cycles beyond the initial snapshot
+    for cycle in range(3):
+        extra = _token_rows(10, seed=20 + cycle)
+        for r in extra:
+            r['id'] += 100 * (cycle + 1)
+        _append_rows(url, extra, final=(cycle == 2))
+        expected.extend(r['id'] for r in extra)
+
+    with TailFollowingReader(url, poll_interval=0.05, idle_timeout=30,
+                             reader_pool_type='dummy',
+                             shuffle_row_groups=False) as tail:
+        ids = [int(r.id) for r in tail]
+    assert sorted(ids) == sorted(expected)
+    assert len(ids) == len(set(ids)), 'duplicate delivery'
+    diag = tail.diagnostics
+    assert diag['dataset_grew'] == 4  # initial + 3 growth snapshots
+    assert diag['tail_rows_delivered'] == len(expected)
+
+
+def test_tail_following_concurrent_writer(tmp_path):
+    url, rows = _write_token_dataset(tmp_path / 'ds', num_rows=10,
+                                     rows_per_row_group=5)
+    publish_snapshot(url)
+    expected = {r['id'] for r in rows}
+    lock = threading.Lock()
+
+    def writer_thread():
+        for cycle in range(3):
+            time.sleep(0.2)
+            extra = _token_rows(10, seed=40 + cycle)
+            for r in extra:
+                r['id'] += 100 * (cycle + 1)
+            with lock:
+                expected.update(r['id'] for r in extra)
+            _append_rows(url, extra, final=(cycle == 2))
+
+    t = threading.Thread(target=writer_thread)
+    t.start()
+    try:
+        with TailFollowingReader(url, poll_interval=0.05, idle_timeout=30,
+                                 reader_pool_type='dummy',
+                                 shuffle_row_groups=False) as tail:
+            ids = [int(r.id) for r in tail]
+    finally:
+        t.join()
+    assert len(ids) == len(set(ids)), 'duplicate delivery under concurrency'
+    assert set(ids) == expected
+
+
+def test_tail_following_checkpoint_resume(tmp_path):
+    url, rows = _write_token_dataset(tmp_path / 'ds', num_rows=10,
+                                     rows_per_row_group=5)
+    publish_snapshot(url)
+    expected = [r['id'] for r in rows]
+    for cycle in range(2):
+        extra = _token_rows(10, seed=60 + cycle)
+        for r in extra:
+            r['id'] += 100 * (cycle + 1)
+        _append_rows(url, extra, final=(cycle == 1))
+        expected.extend(r['id'] for r in extra)
+
+    tail = TailFollowingReader(url, poll_interval=0.05, idle_timeout=30,
+                               reader_pool_type='dummy',
+                               shuffle_row_groups=False)
+    first = [int(next(tail).id) for _ in range(15)]  # 3 full 5-row groups
+    state = pickle.loads(pickle.dumps(tail.state_dict()))
+    tail.stop(); tail.join()
+
+    resumed = TailFollowingReader(url, poll_interval=0.05, idle_timeout=30,
+                                  reader_pool_type='dummy',
+                                  shuffle_row_groups=False, resume_state=state)
+    rest = [int(r.id) for r in resumed]
+    resumed.stop(); resumed.join()
+
+    combined = first + rest
+    assert sorted(combined) == sorted(expected)
+    assert len(combined) == len(set(combined)), 'resume re-delivered rows'
+
+
+def test_tail_following_idle_timeout(tmp_path):
+    url, _ = _write_token_dataset(tmp_path / 'ds', num_rows=10,
+                                  rows_per_row_group=5)
+    publish_snapshot(url)  # never marked final
+    tail = TailFollowingReader(url, poll_interval=0.05, idle_timeout=0.3,
+                               reader_pool_type='dummy',
+                               shuffle_row_groups=False)
+    with pytest.raises(PetastormTpuError, match='idle_timeout'):
+        for _ in tail:
+            pass
+    tail.stop(); tail.join()
+
+
+def test_tail_following_rejects_owned_kwargs(tmp_path):
+    url, _ = _write_token_dataset(tmp_path / 'ds', num_rows=10,
+                                  rows_per_row_group=5)
+    with pytest.raises(PetastormTpuError, match='num_epochs'):
+        TailFollowingReader(url, num_epochs=3)
