@@ -707,6 +707,12 @@ def main(argv=None):
                              'a zipf-length token store, with the packing '
                              'efficiency and a same-seed bit-exactness check '
                              '(docs/sequence.md)')
+    parser.add_argument('--blackbox-overhead', action='store_true',
+                        help='additionally measure the flight-recorder '
+                             'overhead guard: the same read with recording '
+                             'off (PSTPU_FLIGHT=0) and on, reported against '
+                             'the <=2%% budget (docs/observability.md, '
+                             '"Flight recorder")')
     parser.add_argument('--protocol-monitor', action='store_true',
                         help='attach the worker-pool protocol conformance monitor '
                              '(docs/protocol.md) to every measured reader: a chaos '
@@ -800,6 +806,9 @@ def main(argv=None):
 
     autotune = _autotune_section(url, headline_rate=value) if args.autotune else None
 
+    blackbox_overhead = (_blackbox_overhead_section(url)
+                         if args.blackbox_overhead else None)
+
     duty = _duty_section(tpu_seen_early=tpu_seen_early)
 
     if args.trace_out:
@@ -837,6 +846,7 @@ def main(argv=None):
         'compression_sweep': compression_sweep,
         'duty': duty,
         'autotune': autotune,
+        'blackbox_overhead': blackbox_overhead,
         'chaos': _chaos_section() if args.chaos else None,
         # per-batch critical-path attribution over the capture's span trees
         # (spans level only): traced-batch count + the slowest batches with
@@ -894,6 +904,64 @@ def _autotune_section(url, headline_rate):
         'workers_start': 1,
         'workers_final': workers_final,
         'decisions': decisions,
+    }
+    print(json.dumps(section), flush=True)
+    return {k: v for k, v in section.items() if k != 'metric'}
+
+
+def _blackbox_overhead_section(url):
+    """Flight-recorder overhead guard (docs/observability.md, "Flight
+    recorder"): the measured read once with recording structurally off
+    (``PSTPU_FLIGHT=0``) and once with the recorder enabled into a throwaway
+    run dir. The counters-level recording budget is <=2% — the recorder adds
+    one activity-slot ``pack_into`` per stage execution plus a 1 Hz snapshot
+    thread, so anything above that is a regression in the hot-path hook."""
+    import functools
+    import tempfile
+
+    from petastorm_tpu import make_reader
+    from petastorm_tpu.observability import blackbox
+    from petastorm_tpu.tools.throughput import reader_throughput
+
+    def one():
+        return reader_throughput(url, warmup_cycles=100, measure_cycles=4000,
+                                 pool_type='thread', workers_count=3,
+                                 shuffle_row_groups=True, read_method='python',
+                                 make_reader_fn=functools.partial(make_reader,
+                                                                  seed=0)
+                                 ).samples_per_second
+
+    def phase(runs=3):
+        return statistics.median(one() for _ in range(runs))
+
+    prev_env = os.environ.get('PSTPU_FLIGHT')
+    try:
+        blackbox.disable()
+        os.environ['PSTPU_FLIGHT'] = '0'
+        rate_off = phase()
+        os.environ.pop('PSTPU_FLIGHT', None)
+        run_dir = tempfile.mkdtemp(prefix='bench_flight_')
+        blackbox.enable('bench', run_dir=run_dir)
+        rate_on = phase()
+    except Exception as e:  # noqa: BLE001 - the guard must never sink the headline capture
+        section = {'metric': 'blackbox_overhead', 'error': str(e)}
+        print(json.dumps(section), flush=True)
+        return {'error': str(e)}
+    finally:
+        from petastorm_tpu.observability import blackbox as _bb
+        _bb.disable()
+        if prev_env is None:
+            os.environ.pop('PSTPU_FLIGHT', None)
+        else:
+            os.environ['PSTPU_FLIGHT'] = prev_env
+    overhead = (1.0 - rate_on / rate_off) if rate_off else None
+    section = {
+        'metric': 'blackbox_overhead',
+        'rate_off': round(rate_off, 2),
+        'rate_on': round(rate_on, 2),
+        'overhead_fraction': round(overhead, 4) if overhead is not None else None,
+        'budget_fraction': 0.02,
+        'within_budget': (overhead is not None and overhead <= 0.02),
     }
     print(json.dumps(section), flush=True)
     return {k: v for k, v in section.items() if k != 'metric'}

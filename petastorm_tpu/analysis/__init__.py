@@ -43,6 +43,13 @@ Rule families (see ``docs/analysis.md`` for bad/good examples):
   calls, no hand-rolled ``trace=``/``span=``/``parent=`` identity kwargs.
   An orphan span drops out of every batch's causal tree
   (docs/observability.md, "Causal tracing").
+* **PT704** async-signal-safety — code reachable from a ``signal.signal``
+  handler (the flight recorder's crash-footer path,
+  ``observability/blackbox.py``) must not acquire locks, log, import, open
+  files, or allocate through serializers/``Struct.pack``: the interrupted
+  frame may hold the very lock (or be mid-``malloc``), deadlocking or
+  corrupting the process the handler is trying to describe
+  (``analysis/signal_safety.py``).
 * **PT800/PT801** worker-pool protocol discipline — consumer switches over
   results-channel message kinds must cover every kind declared in
   ``workers/protocol.MESSAGE_KINDS`` (or carry an else); protocol
@@ -120,6 +127,7 @@ from petastorm_tpu.analysis.protocol_lints import ProtocolLintChecker
 from petastorm_tpu.analysis.races import RaceChecker
 from petastorm_tpu.analysis.sequence_lints import SequenceDeterminismChecker
 from petastorm_tpu.analysis.serve_lints import ServeActuatorChecker
+from petastorm_tpu.analysis.signal_safety import SignalSafetyChecker
 from petastorm_tpu.analysis.telemetry import TelemetrySpanChecker
 from petastorm_tpu.analysis.trace_lints import TraceContextChecker
 
@@ -133,6 +141,7 @@ ALL_CHECKERS = (
     HashabilityChecker,
     TelemetrySpanChecker,
     BaseExceptionContainmentChecker,
+    SignalSafetyChecker,
     AutotuneActionChecker,
     TraceContextChecker,
     ProtocolLintChecker,
@@ -188,7 +197,7 @@ __all__ = [
     'LockDisciplineChecker',
     'NativeBufferChecker', 'ProtocolLintChecker', 'RaceChecker',
     'ResourceLifecycleChecker', 'SequenceDeterminismChecker',
-    'ServeActuatorChecker',
+    'ServeActuatorChecker', 'SignalSafetyChecker',
     'SourceFile', 'TelemetrySpanChecker', 'TraceContextChecker',
     'collect_sources', 'load_baseline', 'run_analysis', 'run_checkers',
 ]

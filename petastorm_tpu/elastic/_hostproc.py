@@ -43,7 +43,11 @@ def main(argv=None):
     args = _parse_args(argv if argv is not None else sys.argv[1:])
     from petastorm_tpu import make_reader
     from petastorm_tpu.elastic import ElasticConfig
+    from petastorm_tpu.observability import blackbox
 
+    # label flight files by host id so a post-mortem over the run directory
+    # can name WHICH elastic host died (the chaos driver SIGKILLs one)
+    blackbox.maybe_enable('elastic-host-' + args.host)
     cfg = ElasticConfig(coord_dir=args.coord, host_id=args.host,
                         lease_s=args.lease_s, poll_s=args.poll_s)
     out = open(args.out, 'a')

@@ -66,6 +66,17 @@ class FaultPlan(object):
     :param error_times: fire each ``error_items`` entry only on its first N
         attempts (requires ``state_dir``); ``None`` = every attempt — a
         *poison* item.
+    :param segv_items: piece indices whose processing raises ``SIGSEGV`` in
+        the worker process mid-item — a native-crash stand-in (a decoder
+        segfault) for the flight-recorder post-mortem path: unlike SIGKILL,
+        the crash leaves a faulthandler sidecar behind. Process pools only;
+        degrades to :class:`FaultInjectedError` elsewhere. One-shot per
+        index (requires ``state_dir``) unless ``segv_once=False``.
+    :param hang_items: piece indices whose processing wedges for ``hang_s``
+        seconds inside a ``fault.fault_hang`` stage before proceeding — the
+        deterministic stall the hang watchdog must catch. One-shot per index
+        (requires ``state_dir``) unless ``hang_once=False``.
+    :param hang_s: how long each ``hang_items`` entry sleeps.
     :param storage_fail_first: the first N storage operations per process
         routed through :meth:`petastorm_tpu.retry.RetryPolicy.call` raise a
         transient ``OSError(ECONNRESET)``.
@@ -73,24 +84,36 @@ class FaultPlan(object):
     """
 
     def __init__(self, kill_items=(), kill_once=True, error_items=(),
-                 error_times=None, storage_fail_first=0, state_dir=None):
+                 error_times=None, segv_items=(), segv_once=True,
+                 hang_items=(), hang_once=True, hang_s=5.0,
+                 storage_fail_first=0, state_dir=None):
         self.kill_items = tuple(kill_items)
         self.kill_once = bool(kill_once)
         self.error_items = tuple(error_items)
         self.error_times = error_times
+        self.segv_items = tuple(segv_items)
+        self.segv_once = bool(segv_once)
+        self.hang_items = tuple(hang_items)
+        self.hang_once = bool(hang_once)
+        self.hang_s = float(hang_s)
         self.storage_fail_first = int(storage_fail_first)
         self.state_dir = state_dir
         if (self.kill_items and self.kill_once) or \
-                (self.error_items and self.error_times is not None):
+                (self.error_items and self.error_times is not None) or \
+                (self.segv_items and self.segv_once) or \
+                (self.hang_items and self.hang_once):
             if not state_dir:
-                raise ValueError('one-shot faults (kill_once / error_times) need a '
-                                 'state_dir for cross-process coordination')
+                raise ValueError('one-shot faults (kill_once / error_times / '
+                                 'segv_once / hang_once) need a state_dir for '
+                                 'cross-process coordination')
 
     def __repr__(self):
         return ('FaultPlan(kill_items={}, kill_once={}, error_items={}, '
-                'error_times={}, storage_fail_first={})'.format(
+                'error_times={}, segv_items={}, hang_items={}, hang_s={}, '
+                'storage_fail_first={})'.format(
                     self.kill_items, self.kill_once, self.error_items,
-                    self.error_times, self.storage_fail_first))
+                    self.error_times, self.segv_items, self.hang_items,
+                    self.hang_s, self.storage_fail_first))
 
 
 #: the process-wide installed plan (None = fault injection disabled, the
@@ -164,6 +187,32 @@ def on_item(kwargs):
             raise FaultInjectedError(
                 'injected kill on piece_index={} (degraded to an error: not a '
                 'spawned worker process)'.format(piece_index))
+    if piece_index in plan.segv_items:
+        fire = (not plan.segv_once or
+                _claim_one_shot(plan.state_dir, 'segv_{}'.format(piece_index)))
+        if fire:
+            if _IN_SPAWNED_WORKER:
+                logger.warning('fault injection: SIGSEGV on piece_index=%s (pid %s)',
+                               piece_index, os.getpid())
+                # a real signal, not a python exception: faulthandler (armed
+                # by the flight recorder) writes the crash sidecar exactly as
+                # it would for a native decoder bug
+                os.kill(os.getpid(), signal.SIGSEGV)
+            raise FaultInjectedError(
+                'injected segfault on piece_index={} (degraded to an error: not '
+                'a spawned worker process)'.format(piece_index))
+    if piece_index in plan.hang_items:
+        fire = (not plan.hang_once or
+                _claim_one_shot(plan.state_dir, 'hang_{}'.format(piece_index)))
+        if fire:
+            import time
+            from petastorm_tpu import observability as obs
+            logger.warning('fault injection: hanging %.1fs on piece_index=%s (pid %s)',
+                           plan.hang_s, piece_index, os.getpid())
+            # wedge inside a named stage so the watchdog's activity slot
+            # shows fault.fault_hang in the stack dump it takes
+            with obs.stage('fault_hang', cat='fault'):
+                time.sleep(plan.hang_s)
     if piece_index in plan.error_items:
         if plan.error_times is None:
             raise FaultInjectedError('injected poison on piece_index={}'.format(piece_index))

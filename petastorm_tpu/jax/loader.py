@@ -24,6 +24,7 @@ import numpy as np
 
 from petastorm_tpu import observability as obs
 from petastorm_tpu.errors import PetastormTpuError
+from petastorm_tpu.observability import blackbox
 from petastorm_tpu.jax.infeed import stage_batch
 from petastorm_tpu.shuffling_buffer import default_min_after, make_shuffling_buffer_factory
 
@@ -253,6 +254,12 @@ class JaxDataLoader(object):
         tuner = getattr(reader, 'autotuner', None)
         if tuner is not None and hasattr(tuner, 'attach_loader'):
             tuner.attach_loader(self)
+        # flight recorder (docs/observability.md): batches emitted are the
+        # training loop's progress signal — the watchdog calls a run stalled
+        # only when a stage is open AND this stops advancing
+        if blackbox.maybe_enable('loader') is not None:
+            blackbox.watch_progress('loader_batches', lambda: obs.get_registry()
+                                    .counter('loader_batches_total').value)
 
     def _make_buffer(self):
         """Build the client-side buffer from the CURRENT shuffle knob values
@@ -584,6 +591,15 @@ class JaxDataLoader(object):
     # -- lifecycle ----------------------------------------------------------
 
     def stop(self):
+        # stamp the final stall attribution into the flight ring so a
+        # post-mortem can report the last-known bottleneck without the
+        # process's diagnostics surface (which dies with it)
+        if blackbox.get_recorder() is not None:
+            try:
+                blackbox.record_stall(obs.stall_report(self.diagnostics))
+            except Exception:  # noqa: BLE001 - teardown forensics must never mask stop()
+                pass
+            blackbox.unwatch_progress('loader_batches')
         self.reader.stop()
 
     def join(self):

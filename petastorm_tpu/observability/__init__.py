@@ -34,8 +34,12 @@ from __future__ import annotations
 
 import time as _time
 
+from petastorm_tpu.observability import blackbox as _blackbox
 from petastorm_tpu.observability import metrics as _metrics
 from petastorm_tpu.observability import trace as _trace
+from petastorm_tpu.observability.blackbox import (FlightRecorder,  # noqa: F401
+                                                  format_postmortem, load_flight,
+                                                  postmortem_report)
 from petastorm_tpu.observability.critical_path import (critical_path,  # noqa: F401
                                                        critical_path_summary,
                                                        format_critical_path,
@@ -138,7 +142,7 @@ class _StageTimer(object):
     the span to a context discovered only mid-flight (``pool_wait``)."""
 
     __slots__ = ('name', 'cat', 'args', '_t0', '_wall0', '_spans', '_ctx',
-                 '_link', '_sid', '_pushed')
+                 '_link', '_sid', '_pushed', '_act', '_act_prev')
 
     def __init__(self, name, cat, args, spans):
         self.name = name
@@ -149,6 +153,12 @@ class _StageTimer(object):
         self._pushed = False
 
     def __enter__(self):
+        # flight-recorder activity slot (docs/observability.md, "Flight
+        # recorder"): one load + None compare when recording is off
+        act = _blackbox._ACTIVITY
+        self._act = act
+        if act is not None:
+            self._act_prev = act.enter(self.cat + '.' + self.name)
         if self._spans:
             self._wall0 = _time.time()
             ctx = _trace.current_trace()
@@ -171,6 +181,8 @@ class _StageTimer(object):
     def __exit__(self, exc_type, exc_value, tb):
         dur = _time.perf_counter() - self._t0
         _metrics.get_registry().stage_timer(self.name).record(dur)
+        if self._act is not None:
+            self._act.exit(self._act_prev)
         if self._spans:
             if self._pushed:
                 _trace._pop_trace()
@@ -232,13 +244,14 @@ def absorb_trace_events(events):
 
 
 __all__ = [
-    'HistoryRecorder',
+    'FlightRecorder', 'HistoryRecorder',
     'JsonlExporter', 'TelemetryConfig', 'TraceContext', 'absorb_trace_events',
     'add_seconds', 'chrome_trace', 'configure', 'count', 'counters_on',
     'critical_path', 'critical_path_summary', 'current_config', 'current_trace',
     'decode_collate_share', 'detect_regression', 'drain_trace_events',
     'export_chrome_trace', 'flatten_snapshot', 'format_critical_path',
-    'format_pod_report', 'format_slowest_batches', 'format_span_tree',
+    'format_pod_report', 'format_postmortem', 'format_slowest_batches',
+    'format_span_tree', 'load_flight', 'postmortem_report',
     'format_stall_report', 'gauge_set', 'get_registry', 'get_ring',
     'history_windows', 'host_identity', 'instant', 'load_history',
     'load_host_series', 'load_pod', 'merge_snapshots', 'mint_trace', 'observe',

@@ -27,6 +27,11 @@ the pod report names per-host throughput/stall and the straggler host
 (``observability/podagg.py``). Combine with ``--watch SECONDS`` to re-render
 live as the hosts keep exporting.
 
+``--postmortem [DIR]`` reconstructs a dead or hung run from the flight
+recorder's crash-persistent files (``observability/blackbox.py``): per-process
+crash cause, the stage each process died in, and the last window's stall
+report — equivalent to the ``petastorm-tpu-blackbox`` console script.
+
 Open traces in https://ui.perfetto.dev (or chrome://tracing). See
 ``docs/observability.md`` for how to read the output and
 ``docs/troubleshooting.md`` ("reading a stall report") for the remedies.
@@ -354,6 +359,15 @@ def main(argv=None):
                              'the pod report (per-host throughput/stall, '
                              'straggler callout); combine with --watch to '
                              're-render live')
+    parser.add_argument('--postmortem', metavar='DIR', nargs='?', const='',
+                        default=None,
+                        help='instead of reading a dataset, merge the crash-'
+                             'persistent flight files under DIR (default: the '
+                             'PSTPU_FLIGHT_DIR run dir) and print the post-'
+                             'mortem: per-process crash cause, dying stage, '
+                             'windowed stall report (docs/troubleshooting.md)')
+    parser.add_argument('--last', type=float, default=30.0, metavar='SECONDS',
+                        help='with --postmortem: the stall-report window')
     parser.add_argument('--batch', metavar='TRACE_ID', default=None,
                         help="after the measured read, print the slowest-"
                              "batches table plus this batch's span tree and "
@@ -383,6 +397,20 @@ def main(argv=None):
                              'ticks (0 = run until interrupted)')
     args = parser.parse_args(argv)
 
+    if args.postmortem is not None:
+        from petastorm_tpu.observability import blackbox
+        run_dir = args.postmortem or blackbox.default_dir()
+        if not os.path.isdir(run_dir):
+            print('no flight directory at {} (was recording enabled? '
+                  'PSTPU_FLIGHT_DIR relocates it)'.format(run_dir),
+                  file=sys.stderr)
+            return 1
+        report = blackbox.postmortem_report(run_dir, last_s=args.last)
+        if args.as_json:
+            print(json.dumps(report, default=repr))
+        else:
+            print(blackbox.format_postmortem(report))
+        return 0
     if args.serve is not None:
         return diagnose_serve(args.serve, as_json=args.as_json)
     if args.pod is not None:

@@ -7,6 +7,7 @@ the live process registry and on cross-process merges
 
 from __future__ import annotations
 
+import atexit
 import json
 import os
 import re
@@ -106,8 +107,11 @@ def _count_lines(path):
 class JsonlExporter(object):
     """Background thread appending one JSON line per interval to ``path``:
     ``{"ts": <epoch s>, "host": {...}, "metrics": {<flat name: value>}}``.
-    Deterministic release via :meth:`stop` (or the context manager); the final
-    flush runs on stop so short-lived runs still record their last state.
+    Deterministic release via :meth:`stop`/:meth:`close` (or the context
+    manager); the final flush runs on stop so short-lived runs still record
+    their last state. A started exporter also registers an atexit hook, so a
+    process that exits without stopping it still flushes the tail interval
+    (the window a post-mortem needs most).
 
     Every line carries this process's :func:`host_identity` stamp so exports
     from several hosts can be merged by the pod aggregator
@@ -144,7 +148,21 @@ class JsonlExporter(object):
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name='pstpu-metrics-jsonl')
         self._thread.start()
+        # a process that exits without stop() (crash-adjacent teardown, a
+        # script that just returns) would otherwise silently drop the tail
+        # interval — exactly the window a post-mortem needs most
+        atexit.register(self._atexit_flush)
         return self
+
+    def _atexit_flush(self):
+        """Final-window flush at interpreter exit for exporters never
+        stopped explicitly. Routed through :meth:`stop` so the behavior is
+        identical to a deliberate shutdown."""
+        if self._thread is not None:
+            try:
+                self.stop()
+            except Exception:  # noqa: BLE001 - interpreter teardown must never raise from an atexit hook
+                pass
 
     def _maybe_rotate(self, pending_bytes):
         if (self._max_bytes is None or self._bytes == 0
@@ -177,7 +195,14 @@ class JsonlExporter(object):
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
+            try:
+                atexit.unregister(self._atexit_flush)
+            except Exception:  # noqa: BLE001 - interpreter-shutdown race
+                pass
         self._flush()
+
+    #: deliberate alias: `close()` is the conventional name callers reach for
+    close = stop
 
     def __enter__(self):
         return self.start()

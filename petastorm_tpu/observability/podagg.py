@@ -144,7 +144,12 @@ def pod_report(source, seconds=None, straggler_ratio=DEFAULT_STRAGGLER_RATIO,
                  # a host stuck on an old generation after a reshard is the
                  # elastic analogue of a straggler (docs/parallelism.md)
                  'elastic_generation': newest.get('elastic_generation'),
-                 'elastic_members': newest.get('elastic_member_count')}
+                 'elastic_members': newest.get('elastic_member_count'),
+                 # hang-watchdog evidence (observability/blackbox.py): a host
+                 # with stall dumps is wedged, not merely slow — different
+                 # remedy (post-mortem the flight files, not tune knobs)
+                 'watchdog_stalls': int(newest.get('watchdog_stall_total', 0) or 0),
+                 'watchdog_last_dump_ts': newest.get('watchdog_last_dump_ts')}
         if win is not None:
             rep = _report.stall_report(win)
             entry.update({'window_s': win.get('window_s'),
@@ -223,6 +228,12 @@ def format_pod_report(report):
                      'a reshard is in progress, or a host cannot reach the '
                      'coordination directory'.format(
                          report['elastic']['generations']))
+    wedged = [r for r in report['hosts'] if r.get('watchdog_stalls')]
+    for r in wedged:
+        lines.append('WATCHDOG {}: {} stall dump(s) recorded — the host stopped '
+                     'making progress mid-stage; run `petastorm-tpu-blackbox` '
+                     'on its flight directory for the wedged stacks'.format(
+                         r['host'], r['watchdog_stalls']))
     s = report['straggler']
     if s is None:
         lines.append('no straggler: the pod is balanced within thresholds')

@@ -110,6 +110,14 @@ def stall_report(diagnostics):
             'exhausted': bool(diagnostics.get('mixture_source_{}_exhausted'.format(i), 0)),
         }
         i += 1
+    # hang-watchdog evidence (observability/blackbox.py): a run that STOPPED
+    # making progress looks identical to a slow one in the rate counters —
+    # the watchdog's stall dumps are the discriminator, so they ride along
+    watchdog = {'stalls': int(diagnostics.get('watchdog_stall_total', 0) or 0)}
+    last_dump = diagnostics.get('watchdog_last_dump_ts')
+    if last_dump:
+        import time as _time
+        watchdog['last_dump_age_s'] = round(max(_time.time() - float(last_dump), 0.0), 1)
     return {
         'reader_wait_s': round(wait, 4),
         'reader_wait_fraction': diagnostics.get('reader_wait_fraction'),
@@ -122,6 +130,7 @@ def stall_report(diagnostics):
         'worker_busy_s': {k: round(v, 4) for k, v in busy.items()},
         'recovery': recovery,
         'mixture': mixture,
+        'watchdog': watchdog,
     }
 
 
@@ -174,4 +183,12 @@ def format_stall_report(report):
             lines.append('    source {:<3d} {:>10d} rows ({:5.1f}%)  {:>12d} tokens{}'.format(
                 i, src['rows'], src['rows'] / total_rows * 100.0, src['tokens'],
                 '  [exhausted]' if src['exhausted'] else ''))
+    watchdog = report.get('watchdog') or {}
+    if watchdog.get('stalls'):
+        age = watchdog.get('last_dump_age_s')
+        lines.append('  watchdog: {} stall dump(s) recorded{} — run '
+                     '`petastorm-tpu-blackbox` on the flight directory for the '
+                     'wedged stacks (docs/troubleshooting.md)'.format(
+                         watchdog['stalls'],
+                         ', last {}s ago'.format(age) if age is not None else ''))
     return '\n'.join(lines)

@@ -23,6 +23,7 @@ import sys
 import time
 
 from petastorm_tpu import observability as obs
+from petastorm_tpu.observability import blackbox
 from petastorm_tpu.errors import (ConsumerEvictedError, EmptyResultError,
                                   ServeDaemonDiedError, ServeError)
 from petastorm_tpu.serializers import NumpyBlockSerializer
@@ -362,6 +363,14 @@ class ServedReader(object):
             self.transformed_schema)
         self.last_row_consumed = False
         self._stopped = False
+        # flight recorder: a wedged served consumer + a dead daemon pid is the
+        # canonical post-mortem pairing (docs/troubleshooting.md)
+        flight = blackbox.maybe_enable('serve-client')
+        if flight is not None:
+            flight.record(blackbox.K_EVENT,
+                          {'event': 'serve_attach', 'tenant_id': self.tenant_id,
+                           'stream_id': self.stream_id,
+                           'daemon_pid': reply['daemon_pid']})
 
     @property
     def batched_output(self):

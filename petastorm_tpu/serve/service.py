@@ -38,6 +38,7 @@ import time
 
 from petastorm_tpu import observability as obs
 from petastorm_tpu.errors import EmptyResultError, ServeError
+from petastorm_tpu.observability import blackbox
 from petastorm_tpu.serializers import NumpyBlockSerializer
 from petastorm_tpu.serve.worker import (DEFAULT_SERVE_BLOB_THRESHOLD, BlobRef,
                                         FusedBlobRef, MultiplexWorker,
@@ -194,6 +195,15 @@ class ReaderService(object):
     def start(self):
         os.makedirs(os.path.join(self.service_dir, 'streams'), exist_ok=True)
         obs.configure(self._telemetry)  # None keeps the ambient level
+        # before the pool starts so the flight file carries the daemon label
+        # (enable() is a per-process singleton; first caller names it)
+        flight = blackbox.maybe_enable('serve-daemon')
+        if flight is not None:
+            flight.register_lock('serve.state_lock', self._lock)
+            # re-fetch through the registry each probe: tests reset() the
+            # registry, which would orphan a captured Counter object
+            flight.watch('serve_published', lambda: obs.get_registry()
+                         .counter('serve_batches_published_total').value)
         from petastorm_tpu.reader import _make_pool
         # the fleet is resilient by default: a poison item quarantines (loud,
         # counted) instead of killing every tenant's stream

@@ -22,6 +22,7 @@ from collections import deque
 
 from petastorm_tpu import faults, observability as obs
 from petastorm_tpu.errors import EmptyResultError
+from petastorm_tpu.observability import blackbox
 # canonical message-kind vocabulary + dispatch-id allocator (workers/protocol.py);
 # PT801 rejects local kind definitions
 from petastorm_tpu.workers.protocol import MSG_DATA, MSG_DONE, DispatchIds
@@ -80,6 +81,10 @@ class DummyPool(object):
     def start(self, worker_class, worker_setup_args=None, ventilator=None):
         if self._worker is not None:
             raise RuntimeError('Pool already started')
+        flight = blackbox.maybe_enable('consumer')
+        if flight is not None:
+            flight.register_lock('dummy_pool.exec_lock', self._exec_lock)
+            flight.watch('pool_completed', lambda: self._completed_items)
         with self._exec_lock:
             self._worker = worker_class(0, self._publish, worker_setup_args)
         if ventilator is not None:

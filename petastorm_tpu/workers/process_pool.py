@@ -50,6 +50,7 @@ import uuid
 import zmq
 
 from petastorm_tpu import faults, observability as obs
+from petastorm_tpu.observability import blackbox
 from petastorm_tpu.errors import (EmptyResultError, PoisonItemError,
                                   TimeoutWaitingForResultError, WorkerPoolDepletedError)
 from petastorm_tpu.native.lifetime import (RingBorrowLedger,
@@ -354,6 +355,12 @@ class ProcessPool(object):
     def start(self, worker_class, worker_setup_args=None, ventilator=None):
         if self._processes:
             raise RuntimeError('Pool already started')
+        # flight recorder (docs/observability.md): on by default at counters
+        # level, one global check when already enabled
+        flight = blackbox.maybe_enable('consumer')
+        if flight is not None:
+            flight.register_lock('process_pool.state_lock', self._state_lock)
+            flight.watch('pool_completed', lambda: self._completed_items)
         self._context = zmq.Context()
         self._ipc_dir = tempfile.mkdtemp(prefix='pstpu_pool_')
         vent_addr = 'ipc://' + os.path.join(self._ipc_dir, 'vent')
@@ -413,6 +420,12 @@ class ProcessPool(object):
         if isinstance(worker_setup_args, dict) and 'fault_plan' not in worker_setup_args \
                 and faults.get_plan() is not None:
             worker_setup_args = dict(worker_setup_args, fault_plan=faults.get_plan())
+        # the flight-file run dir rides along too, so every worker's recorder
+        # lands next to the consumer's and one post-mortem sees the whole pool
+        if flight is not None and isinstance(worker_setup_args, dict) \
+                and 'flight_dir' not in worker_setup_args:
+            worker_setup_args = dict(worker_setup_args,
+                                     flight_dir=os.path.dirname(flight.path))
 
         # spawn (NOT fork): forked children inherit locked mutexes/threads from
         # Arrow, JAX, etc. (reference process_pool.py:15-17 for the JVM analog)
@@ -882,6 +895,10 @@ class ProcessPool(object):
         else:
             logger.warning('Worker %d (pid %s) died with exitcode %s; draining its results',
                            worker_id, p.pid, p.exitcode)
+            # flight-recorder evidence: a negative exitcode names the signal
+            # (-11 = SIGSEGV) even when the worker's own file never got a footer
+            blackbox.record_event({'event': 'worker_death', 'worker_id': worker_id,
+                                   'pid': p.pid, 'exitcode': p.exitcode})
         self._deaths_seen = True
         with self._ring_lock:
             # autotune's grow path appends to _rings concurrently; the index
@@ -913,6 +930,8 @@ class ProcessPool(object):
         if owned is not None:
             logger.warning('Dead worker %d owned item dispatch=%s; scheduling requeue',
                            worker_id, owned)
+            blackbox.record_event({'event': 'worker_owned_item', 'worker_id': worker_id,
+                                   'pid': p.pid, 'dispatch': owned})
             self._orphans.setdefault(owned, now)
         if worker_id in self._retiring:
             # deliberate retire (autotune shrink): the slot sheds cleanly —
@@ -961,6 +980,8 @@ class ProcessPool(object):
             return
         self._worker_restarts += 1
         obs.count('worker_restarts')
+        blackbox.record_event({'event': 'worker_respawned', 'worker_id': worker_id,
+                               'pid': self._processes[worker_id].pid})
         self._worker_state[worker_id] = {'pid': self._processes[worker_id].pid, 'busy': None,
                                          'last_hb': now, 'claimed_since_spawn': False}
         logger.warning('Respawned worker %d as pid %s', worker_id,
@@ -1248,6 +1269,12 @@ def _worker_bootstrap(worker_id, main_pid, setup_blob, vent_addr, result_addr, c
     faults.mark_in_spawned_worker()
     if isinstance(worker_setup_args, dict) and worker_setup_args.get('fault_plan') is not None:
         faults.install(worker_setup_args['fault_plan'])
+    # the worker's own flight recorder, in the consumer's run dir: when this
+    # process SIGSEGVs mid-item the file names the dying stage and signal.
+    # The key is popped — it is pool plumbing, not the worker's setup args.
+    flight_run_dir = (worker_setup_args.pop('flight_dir', None)
+                      if isinstance(worker_setup_args, dict) else None)
+    blackbox.maybe_enable('worker{}'.format(worker_id), run_dir=flight_run_dir)
 
     _start_orphan_monitor(main_pid)
 
@@ -1491,13 +1518,18 @@ def _worker_bootstrap(worker_id, main_pid, setup_blob, vent_addr, result_addr, c
                 # supervisor knows exactly what to requeue
                 send_heartbeat(dispatch, blocking=True)
                 try:
-                    faults.on_item(kwargs)
-                    # the item's TraceContext (minted in the main process)
-                    # becomes this thread's active context: worker stages
-                    # land in the item's cross-process span tree, and the
-                    # events ship back on the existing MSG_METRICS piggyback
-                    with obs.use_trace(trace_ctx):
-                        worker.process(*args, **kwargs)
+                    # the item wrapper stage keeps the flight recorder's
+                    # activity slot non-empty for the whole item, so a death
+                    # before the worker's first inner stage still names a
+                    # dying stage (and the hang watchdog covers fault hooks)
+                    with obs.stage('item', cat='worker', dispatch=dispatch):
+                        faults.on_item(kwargs)
+                        # the item's TraceContext (minted in the main process)
+                        # becomes this thread's active context: worker stages
+                        # land in the item's cross-process span tree, and the
+                        # events ship back on the existing MSG_METRICS piggyback
+                        with obs.use_trace(trace_ctx):
+                            worker.process(*args, **kwargs)
                     send(MSG_DONE, current['seq'])
                     flush_telemetry()
                 except Exception:  # noqa: BLE001 - forwarded to the main process

@@ -28,6 +28,7 @@ import threading
 
 from petastorm_tpu import faults, observability as obs
 from petastorm_tpu.errors import EmptyResultError, WorkerTerminationRequested
+from petastorm_tpu.observability import blackbox
 # in-process pools speak the same canonical message-kind vocabulary as the
 # wire protocol (workers/protocol.py): results-queue records are
 # (kind, seq, payload, dispatch_id, trace_ctx) tuples, dispatch ids are
@@ -93,6 +94,12 @@ class ThreadPool(object):
     def start(self, worker_class, worker_setup_args=None, ventilator=None):
         if self._threads:
             raise RuntimeError('Pool already started')
+        # flight recorder (docs/observability.md): threads share the consumer
+        # process, so one recorder covers pool + consumer
+        flight = blackbox.maybe_enable('consumer')
+        if flight is not None:
+            flight.register_lock('thread_pool.counter_lock', self._counter_lock)
+            flight.watch('pool_completed', lambda: self._completed_items)
         # kept for runtime slot growth (add_worker_slot spawns identical workers)
         self._worker_class = worker_class
         self._worker_setup_args = worker_setup_args

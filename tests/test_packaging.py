@@ -31,7 +31,7 @@ def test_console_scripts_declared_and_resolvable():
                             'petastorm-tpu-lint', 'petastorm-tpu-race',
                             'petastorm-tpu-diagnose',
                             'petastorm-tpu-modelcheck', 'petastorm-tpu-autotune',
-                            'petastorm-tpu-serve'}
+                            'petastorm-tpu-serve', 'petastorm-tpu-blackbox'}
     import importlib
     for target in scripts.values():
         mod_name, func_name = target.split(':')
