@@ -142,6 +142,119 @@ def _run_chaos(args):
     return 0
 
 
+def _run_fabric(args):
+    """The ``--fabric`` lane: N simulated hosts sharing chunks peer-to-peer.
+
+    Each host gets its own chunk-mirror root and a ``FabricNode`` (server +
+    lease membership + client); hosts read the same ``mock-remote://`` store
+    one after another with that host's fabric client installed. Host 0 finds
+    no peers and reads everything from the object store; every later host
+    should source (nearly) every chunk from an earlier peer's mirror — on a
+    healthy N-host run the verdict reports ≈1 object-store read plus (N-1)
+    LAN copies per chunk. ``--chaos net`` injects a connection reset and a
+    truncated payload into the peer serves and asserts the readers still
+    complete with the losses accounted as fallbacks.
+
+    The emitted ``pod_fabric`` line carries the conservation check straight
+    off the counters: every chunk-mirror miss must be satisfied exactly once,
+    by a peer copy or by an object-store fallback (docs/fabric.md).
+    """
+    from petastorm_tpu import fabric, faults, make_reader, native
+    from petastorm_tpu import observability as obs
+    from petastorm_tpu.chunkstore import ChunkCacheConfig, cache_diagnostics
+
+    if not native.is_available():
+        print(json.dumps({'metric': 'pod_fabric', 'skipped': True,
+                          'reason': 'native kernel unavailable (chunk mirrors '
+                                    'need the page scanner)'}), flush=True)
+        return 0
+
+    obs.configure('counters')
+    tmpdir = tempfile.mkdtemp(prefix='bench_pod_fabric_')
+    store_path = os.path.join(tmpdir, 'store')
+    build_sequence_store('file://' + store_path, args.rows, args.feature_dim)
+    url = 'mock-remote://' + store_path
+    coord = os.path.join(tmpdir, 'coord')
+    hosts = max(2, min(args.hosts, 4))
+
+    faults_injected = 0
+    if args.chaos == 'net':
+        faults_injected = 2
+        faults.install_net(faults.NetFaultPlan(reset_payloads=1,
+                                               truncate_payloads=1))
+
+    def counters():
+        flat = obs.flatten_snapshot(obs.snapshot())
+        return {k: flat.get(k, 0) for k in ('fabric_peer_hits',
+                                            'fabric_fallbacks',
+                                            'fabric_bytes_from_peers',
+                                            'fabric_breaker_open')}
+
+    nodes = []
+    rows_ok = True
+    misses_total = 0
+    t0 = time.perf_counter()
+    try:
+        for h in range(hosts):
+            cache = ChunkCacheConfig(root=os.path.join(tmpdir, 'cache%d' % h),
+                                     size_limit_bytes=1 << 30)
+            node = fabric.start_node(fabric.FabricConfig(
+                coord_dir=coord, host_id='host%d' % h, cache=cache))
+            nodes.append(node)
+            fabric.install(node)
+            before = counters()
+            try:
+                with make_reader(url, reader_pool_type='thread',
+                                 workers_count=args.workers, num_epochs=1,
+                                 shuffle_row_groups=False,
+                                 chunk_cache=cache) as reader:
+                    rows_read = sum(1 for _ in reader)
+            finally:
+                fabric.uninstall()
+            after = counters()
+            misses = cache_diagnostics(cache)['chunk_cache_misses']
+            misses_total += misses
+            rows_ok = rows_ok and rows_read == args.rows
+            print(json.dumps({
+                'metric': 'pod_fabric_host', 'host': h, 'rows': rows_read,
+                'chunk_misses': misses,
+                'peer_copies': after['fabric_peer_hits'] - before['fabric_peer_hits'],
+                'object_store_reads':
+                    after['fabric_fallbacks'] - before['fabric_fallbacks'],
+            }), flush=True)
+        final = counters()
+    finally:
+        fabric.uninstall()
+        for node in nodes:
+            node.stop()
+        if args.chaos == 'net':
+            faults.uninstall_net()
+
+    dt = time.perf_counter() - t0
+    peer_copies = final['fabric_peer_hits']
+    object_store_reads = final['fabric_fallbacks']
+    # conservation: every mirror miss is satisfied exactly once — by a peer
+    # copy or by an object-store fallback (never neither, never both)
+    accounted = (peer_copies + object_store_reads) == misses_total
+    ok = rows_ok and accounted and peer_copies > 0
+    if args.chaos != 'net':
+        # healthy pod: host 0 pays the object store once per chunk, every
+        # later host rides the fabric
+        chunks = misses_total // hosts
+        ok = ok and object_store_reads == chunks \
+            and peer_copies == (hosts - 1) * chunks
+    print(json.dumps({
+        'metric': 'pod_fabric', 'hosts': hosts, 'rows': args.rows,
+        'chunk_misses': misses_total, 'peer_copies': peer_copies,
+        'object_store_reads': object_store_reads,
+        'bytes_from_peers': final['fabric_bytes_from_peers'],
+        'breakers_tripped': final['fabric_breaker_open'],
+        'chaos': args.chaos, 'faults_injected': faults_injected,
+        'accounted': accounted, 'elapsed_s': round(dt, 2), 'ok': ok,
+    }), flush=True)
+    return 0 if ok else 1
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument('--hosts', type=int, default=4)
@@ -158,17 +271,32 @@ def main(argv=None):
                         help='write one host-stamped telemetry JSONL per '
                              '(simulated) host into DIR — the input format of '
                              'petastorm-tpu-diagnose --pod (docs/observability.md)')
-    parser.add_argument('--chaos', action='store_true',
-                        help='elastic churn lane (docs/parallelism.md): run '
-                             'the pod as REAL host subprocesses with '
-                             'elastic=True, SIGKILL one mid-epoch and join a '
-                             'replacement, then assert exactly-once pod-wide '
-                             'coverage from the commit scoreboard. No '
-                             'devices needed; emits a pod_chaos JSON line.')
+    parser.add_argument('--chaos', nargs='?', const='churn', default=None,
+                        choices=('churn', 'net'),
+                        help='fault lane: bare --chaos (= "churn") runs '
+                             'elastic pod churn (docs/parallelism.md) — REAL '
+                             'host subprocesses, SIGKILL one mid-epoch, join '
+                             'a replacement, assert exactly-once coverage '
+                             'from the commit scoreboard; "--chaos net" '
+                             '(with --fabric) injects connection resets and '
+                             'truncated payloads into the peer transfers '
+                             'instead. No devices needed.')
     parser.add_argument('--chaos-kill-after', type=int, default=4,
                         help='commit count that triggers the --chaos kill')
+    parser.add_argument('--fabric', action='store_true',
+                        help='peer-to-peer chunk fabric lane (docs/fabric.md): '
+                             'N simulated hosts with per-host chunk mirrors '
+                             'read the same remote store in turn; the verdict '
+                             'reports object-store reads vs LAN peer copies '
+                             '(healthy: ~1 + (N-1) copies per chunk). Combine '
+                             'with --chaos net for fault injection. No '
+                             'devices needed; emits a pod_fabric JSON line.')
     args = parser.parse_args(argv)
 
+    if args.chaos == 'net' and not args.fabric:
+        parser.error('--chaos net is a fabric fault lane — pass --fabric too')
+    if args.fabric:
+        return _run_fabric(args)
     if args.chaos:
         return _run_chaos(args)
 

@@ -100,6 +100,12 @@ Rule families (see ``docs/analysis.md`` for bad/good examples):
   seed: wall-clock reads, module-global RNG draws and lexically-unseeded
   RNG constructors are rejected, so a training run's data order stays a
   checkpointable fact (``analysis/sequence_lints.py``).
+* **PT1500** fabric socket discipline — every blocking socket primitive in
+  ``petastorm_tpu/fabric/`` must carry an explicit per-operation timeout
+  (``settimeout`` armed in-function, or the socket arrives alongside a
+  ``deadline`` parameter) and — for data-moving ops — run under an
+  end-to-end ``protocol.Deadline`` budget, so one stalled peer can never
+  wedge a reader thread (``analysis/fabric_lints.py``, ``docs/fabric.md``).
 
 Suppress a single finding with ``# noqa: PT###`` (reason encouraged) on its
 line; absorb pre-existing findings with an ``analysis_baseline.json`` (see
@@ -118,6 +124,7 @@ from petastorm_tpu.analysis.cpp_safety import CppSafetyChecker
 from petastorm_tpu.analysis.elastic_lints import ElasticDeterminismChecker
 from petastorm_tpu.analysis.exceptions import (BaseExceptionContainmentChecker,
                                                ExceptionHygieneChecker)
+from petastorm_tpu.analysis.fabric_lints import FabricSocketChecker
 from petastorm_tpu.analysis.hashability import HashabilityChecker
 from petastorm_tpu.analysis.jax_purity import JaxPurityChecker
 from petastorm_tpu.analysis.lifecycle import ResourceLifecycleChecker
@@ -152,6 +159,7 @@ ALL_CHECKERS = (
     ElasticDeterminismChecker,
     RaceChecker,
     SequenceDeterminismChecker,
+    FabricSocketChecker,
 )
 
 #: every individual rule id the registered checkers can emit — the linter
@@ -192,7 +200,8 @@ __all__ = [
     'ALL_CHECKERS', 'ALL_RULE_CODES', 'AbiConformanceChecker',
     'AutotuneActionChecker', 'Baseline',
     'BaseExceptionContainmentChecker', 'Checker', 'CppSafetyChecker',
-    'ElasticDeterminismChecker', 'ExceptionHygieneChecker', 'Finding',
+    'ElasticDeterminismChecker', 'ExceptionHygieneChecker',
+    'FabricSocketChecker', 'Finding',
     'HashabilityChecker', 'JaxPurityChecker', 'LifetimeChecker',
     'LockDisciplineChecker',
     'NativeBufferChecker', 'ProtocolLintChecker', 'RaceChecker',

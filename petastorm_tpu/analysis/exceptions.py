@@ -65,7 +65,7 @@ class ExceptionHygieneChecker(Checker):
              '*reader.py', '*row_worker.py', '*batch_worker.py', '*serializers.py',
              '*shuffling_buffer.py', '*columnar.py', '*rebatch.py',
              '*cache.py', '*local_disk_cache.py', '*retry.py',
-             '*chunkstore/*.py')
+             '*chunkstore/*.py', '*fabric/*.py')
 
     def check(self, src):
         for node in ast.walk(src.tree):

@@ -148,7 +148,7 @@ class LockDisciplineChecker(Checker):
                    'lock-acquisition-order cycles (PT101)')
     scope = ('*workers/*.py', '*shuffling_buffer.py', '*cache.py', '*reader.py',
              '*jax/*.py', '*native/*.py', '*local_disk_cache.py',
-             '*chunkstore/*.py')
+             '*chunkstore/*.py', '*fabric/*.py')
 
     def check(self, src):
         for node in ast.walk(src.tree):

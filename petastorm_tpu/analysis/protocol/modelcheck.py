@@ -265,6 +265,13 @@ def main(argv=None):
                              'handoff; docs/parallelism.md) instead of the '
                              'supervision protocol; --mutate then takes an '
                              'elastic mutation')
+    parser.add_argument('--fabric', action='store_true',
+                        help='check the chunk-fabric transfer protocol '
+                             '(peer-first fetch, circuit breakers, verified '
+                             'population, guaranteed fallback; '
+                             'docs/fabric.md) instead of the supervision '
+                             'protocol; --mutate then takes a fabric '
+                             'mutation')
     parser.add_argument('--workers', type=int, default=DEFAULT_SCOPE['workers'])
     parser.add_argument('--items', type=int, default=DEFAULT_SCOPE['items'])
     parser.add_argument('--crashes', type=int, default=DEFAULT_SCOPE['crashes'])
@@ -276,9 +283,11 @@ def main(argv=None):
                         help='do not model the payload message as a separate '
                              'step (smaller space, weaker delivery invariant)')
     from petastorm_tpu.analysis.protocol import elastic_spec as EL
+    from petastorm_tpu.analysis.protocol import fabric_spec as FB
     from petastorm_tpu.analysis.protocol import serve_spec as SV
     parser.add_argument('--mutate',
-                        choices=S.MUTATIONS + SV.MUTATIONS + EL.MUTATIONS,
+                        choices=S.MUTATIONS + SV.MUTATIONS + EL.MUTATIONS
+                        + FB.MUTATIONS,
                         default=None,
                         help='seed one protocol defect; the checker must then '
                              'produce a counterexample')
@@ -291,14 +300,18 @@ def main(argv=None):
     parser.add_argument('--json', action='store_true')
     try:
         args = parser.parse_args(argv)
-        if args.serve and args.elastic:
-            raise ValueError('--serve and --elastic are mutually exclusive')
+        if sum((args.serve, args.elastic, args.fabric)) > 1:
+            raise ValueError('--serve, --elastic, and --fabric are mutually '
+                             'exclusive')
         if args.serve:
             cfg = SV.ServeSpecConfig(mutation=args.mutate,
                                      **SV.DEFAULT_SERVE_SCOPE)
         elif args.elastic:
             cfg = EL.ElasticSpecConfig(mutation=args.mutate,
                                        **EL.DEFAULT_ELASTIC_SCOPE)
+        elif args.fabric:
+            cfg = FB.FabricSpecConfig(mutation=args.mutate,
+                                      **FB.DEFAULT_FABRIC_SCOPE)
         else:
             cfg = S.SpecConfig(workers=args.workers, items=args.items,
                                crashes=args.crashes, retries=args.retries,
@@ -310,15 +323,16 @@ def main(argv=None):
         print('error: {}'.format(e), file=sys.stderr)
         return 2
 
-    if args.serve or args.elastic:
-        module = SV if args.serve else EL
+    if args.serve or args.elastic or args.fabric:
+        module = SV if args.serve else (EL if args.elastic else FB)
         result = module.check(cfg, budget_s=args.budget_s,
                               max_states=args.max_states)
+        plane = 'serve' if args.serve else ('elastic' if args.elastic
+                                            else 'fabric')
         if args.json:
             print(json.dumps(result.to_dict(), indent=2))
         else:
-            print('{} scope: {}'.format('serve' if args.serve else 'elastic',
-                                        cfg.describe()))
+            print('{} scope: {}'.format(plane, cfg.describe()))
             print('explored {} canonical states, {} transitions, depth {}, '
                   '{} terminal, in {:.2f}s'.format(
                       result.states, result.transitions, result.depth,

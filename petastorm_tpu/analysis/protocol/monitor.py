@@ -364,6 +364,95 @@ class ElasticMonitor(object):
                     'events_checked': self.events_checked}
 
 
+class FabricMonitor(object):
+    """Runtime conformance monitor for the chunk-fabric transfer protocol
+    (``docs/fabric.md``; spec in ``analysis/protocol/fabric_spec.py``). Each
+    fetching process checks its observable projection:
+
+    * a request is only ever issued to a peer whose breaker admitted it
+      (``on_request`` with ``allowed=False`` is the violation — a breaker
+      that opened mid-flight on an already-issued request is NOT one, which
+      is why the client reports the admission decision, not the later state);
+    * bytes are only populated into the mirror after verification
+      (``on_populate`` with ``verified=False``), and a chunk is populated at
+      most once per process between invalidations (``on_invalidate`` is how
+      an eviction legitimately re-opens a chunk for population);
+    * every fetch resolves through exactly one of the spec's terminal
+      outcomes: ``peer``, ``fallback``, or ``error`` (``on_outcome``).
+
+    Violations raise :class:`~petastorm_tpu.errors.ProtocolViolation`.
+    """
+
+    _OUTCOMES = ('peer', 'fallback', 'error')
+
+    def __init__(self, name='fabric'):
+        self._name = name
+        self._lock = threading.Lock()
+        self._populated = set()     # digests currently mirrored (our view)
+        self.events_checked = 0
+
+    def _fail(self, message):
+        raise ProtocolViolation('[fabric monitor: {}] {}'.format(self._name,
+                                                                 message))
+
+    def on_request(self, peer, allowed):
+        with self._lock:
+            self.events_checked += 1
+            if not allowed:
+                self._fail('request issued to peer {} whose circuit breaker '
+                           'is open — an open breaker must shed load, not '
+                           'shape it'.format(peer))
+
+    def on_populate(self, digest, verified):
+        with self._lock:
+            self.events_checked += 1
+            if not verified:
+                self._fail('unverified bytes for chunk {} reached the mirror '
+                           '— bytes that fail the content hash must be '
+                           'discarded'.format(digest))
+            if digest in self._populated:
+                self._fail('chunk {} populated twice without an intervening '
+                           'invalidation — population must be exactly-once '
+                           'per host'.format(digest))
+            self._populated.add(digest)
+
+    def on_invalidate(self, digest):
+        """The mirror for ``digest`` was evicted: population is legal again."""
+        with self._lock:
+            self.events_checked += 1
+            self._populated.discard(digest)
+
+    def on_outcome(self, key, outcome):
+        with self._lock:
+            self.events_checked += 1
+            if outcome not in self._OUTCOMES:
+                self._fail('fetch of {!r} resolved with unknown outcome {!r} '
+                           '(must be one of {})'.format(key, outcome,
+                                                        self._OUTCOMES))
+
+    @property
+    def snapshot(self):
+        with self._lock:
+            return {'populated': len(self._populated),
+                    'events_checked': self.events_checked}
+
+
+def fabric_monitor_from_env(explicit, name):
+    """Resolve a fabric ``monitor`` argument exactly like
+    :func:`monitor_from_env`, honoring ``PSTPU_FABRIC_MONITOR`` (with
+    ``PSTPU_PROTOCOL_MONITOR`` as the umbrella opt-in)."""
+    import os
+    if explicit is None:
+        env = os.environ.get('PSTPU_FABRIC_MONITOR',
+                             os.environ.get('PSTPU_PROTOCOL_MONITOR', ''))
+        explicit = env not in ('', '0')
+    if not explicit:
+        return None
+    if isinstance(explicit, FabricMonitor):
+        return explicit
+    return FabricMonitor(name=name)
+
+
 def elastic_monitor_from_env(explicit, name):
     """Resolve an elastic ``monitor`` argument exactly like
     :func:`monitor_from_env`, honoring ``PSTPU_ELASTIC_MONITOR`` (with
@@ -412,6 +501,7 @@ def monitor_from_env(explicit, name):
     return ProtocolMonitor(name=name)
 
 
-__all__ = ['ElasticMonitor', 'ProtocolMonitor', 'ProtocolViolation',
-           'ServeMonitor', 'elastic_monitor_from_env', 'monitor_from_env',
+__all__ = ['ElasticMonitor', 'FabricMonitor', 'ProtocolMonitor',
+           'ProtocolViolation', 'ServeMonitor', 'elastic_monitor_from_env',
+           'fabric_monitor_from_env', 'monitor_from_env',
            'serve_monitor_from_env']

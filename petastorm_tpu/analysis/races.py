@@ -415,7 +415,7 @@ class RaceChecker(ProgramChecker):
                    '(PT1303)')
     scope = ('*workers/*.py', '*serve/*.py', '*elastic/*.py', '*autotune/*.py',
              '*chunkstore/*.py', '*observability/*.py', '*jax/*.py',
-             '*shuffling_buffer.py', '*native/lifetime.py')
+             '*fabric/*.py', '*shuffling_buffer.py', '*native/lifetime.py')
 
     def check_program(self, sources):
         models = []

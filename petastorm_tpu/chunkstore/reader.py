@@ -82,9 +82,10 @@ class ChunkCachedParquetFile(object):
 
     # -- remote IO -----------------------------------------------------------
 
-    def _fetch_range(self, offset, length):
+    def _fetch_range(self, offset, length, deadline_s=None):
         from petastorm_tpu.retry import fetch_range
-        return fetch_range(self._fs, self.path, offset, length)
+        return fetch_range(self._fs, self.path, offset, length,
+                           deadline_s=deadline_s)
 
     def _chunk_key(self, offset, length):
         return '{}|{}+{}'.format(self._file_id, offset, length)
@@ -189,7 +190,12 @@ class ChunkCachedParquetFile(object):
         return plan
 
     def _range_fetcher(self, offset, length):
-        return lambda: self._fetch_range(offset, length)
+        def fetch(deadline_s=None):
+            return self._fetch_range(offset, length, deadline_s=deadline_s)
+        # the fabric client hands what remains of its transfer budget to the
+        # object-store fallback through this (duck-typed) capability flag
+        fetch.supports_deadline = True
+        return fetch
 
     # -- reading -------------------------------------------------------------
 

@@ -44,6 +44,7 @@ Design invariants:
 
 from __future__ import annotations
 
+import contextlib
 import hashlib
 import json
 import logging
@@ -81,6 +82,15 @@ _BUMP_INTERVAL_S = 1.0
 #: recently-used mappings kept alive by the store itself so repeat reads skip
 #: the open+mmap round trip; bounded, and released on demand by the evictor
 _STRONG_POOL_SIZE = 64
+
+#: chunk-fabric hook (``petastorm_tpu.fabric``): when installed, every miss
+#: routes ``(key, length, fetch_fn)`` through the fabric client, which tries
+#: a pod peer's mirror first and degrades to ``fetch_fn`` (the object-store
+#: read) itself — :meth:`ChunkStore.ensure` then persists whichever bytes
+#: came back through the SAME atomic temp+rename path, so a peer-populated
+#: mirror is indistinguishable from a fetched one. None (the production
+#: default) costs one global load per miss — never per hit.
+PEER_SOURCE = None
 
 
 class ChunkCacheConfig(object):
@@ -163,12 +173,24 @@ class ChunkStore(object):
         # "the slot has live borrows" and blocked evictions land in the
         # process-wide lifetime_blocked_reclaims counter.
         self._mmaps = {}
+        # digest -> lifetime Slot holding one manual borrow per in-flight
+        # fabric send of a chunk that has no live mapping here: the evictor
+        # consults it exactly like the mmap slots, so a mirror being streamed
+        # to a peer is refused (counted skip), never truncated mid-transfer
+        self._send_pins = {}
         # digest -> np.memmap: bounded LRU of strong refs so hot chunks stay
         # mapped across batches; the evictor pops an entry before judging the
         # weakref, so the pool itself never pins anything against eviction
         self._strong = OrderedDict()
         # digest -> monotonic time of the last mtime bump (throttle)
         self._bumped = {}
+        # digest -> [fetch mutex, refcount]: single-flight per chunk. The
+        # mutex covers the whole miss path — re-stat, fetch (peer or object
+        # store), mirror write — so concurrent demands for the same chunk
+        # produce exactly ONE fetch and ONE population per host; followers
+        # re-stat under the mutex and account a hit. Entries are refcounted
+        # away so the map stays bounded by in-flight fetches, not history.
+        self._fetch_locks = {}
         self._stats_dir = os.path.join(root, 'stats')
         os.makedirs(self._stats_dir, exist_ok=True)
         self._stats_path = os.path.join(self._stats_dir,
@@ -294,12 +316,66 @@ class ChunkStore(object):
                 self._count({'hits': 1})
                 obs.instant('chunk_hit', cat='chunkstore', bytes=length)
             return path, st.st_mtime_ns, False
+        # single-flight: the whole miss path — re-stat, fetch, mirror write —
+        # runs under a per-digest mutex, so a chunk is fetched and populated
+        # exactly once per host no matter how many threads demand it at once
+        with self._lock:
+            entry = self._fetch_locks.get(digest)
+            if entry is None:
+                entry = [threading.Lock(), 0]
+                self._fetch_locks[digest] = entry
+            entry[1] += 1
+        try:
+            with entry[0]:
+                return self._fetch_and_install(key, digest, path, length,
+                                               fetch_fn, for_prefetch)
+        finally:
+            with self._lock:
+                entry[1] -= 1
+                if not entry[1]:
+                    self._fetch_locks.pop(digest, None)
+
+    def _fetch_and_install(self, key, digest, path, length, fetch_fn,
+                           for_prefetch):
+        """The serialized miss path (caller holds the digest's fetch mutex)."""
+        try:
+            st = os.stat(path)
+        except OSError:
+            st = None
+        if st is not None and st.st_size == length:
+            # single-flight follower: the fetch this thread queued behind
+            # already populated the mirror
+            if not for_prefetch:
+                self._maybe_bump(digest, path)
+                self._count({'hits': 1})
+                obs.instant('chunk_hit', cat='chunkstore', bytes=length)
+            return path, st.st_mtime_ns, False
         # separate stage names: demand fetches happen INSIDE the worker read
         # stage (the stall report subtracts them from read IO), prefetches on
         # the prefetcher's own thread (they must not skew that subtraction)
         with obs.stage('chunk_prefetch' if for_prefetch else 'chunk_fetch',
                        cat='chunkstore', bytes=length):
-            data = fetch_fn()
+            peer_source = PEER_SOURCE
+            if peer_source is not None:
+                data = peer_source(key, length, fetch_fn)
+            else:
+                data = fetch_fn()
+        if data is None:
+            # a peer-source single-flight follower (another LOCAL caller of
+            # the same client raced this one): re-stat and account the result
+            # as a hit (exactly-once population per host)
+            try:
+                st = os.stat(path)
+            except OSError:
+                st = None
+            if st is not None and st.st_size == length:
+                if not for_prefetch:
+                    self._count({'hits': 1})
+                    obs.instant('chunk_hit', cat='chunkstore', bytes=length)
+                return path, st.st_mtime_ns, False
+            raise IOError(
+                'peer source reported chunk {!r} populated, but no mirror of '
+                '{} bytes exists'.format(key, length))
         if len(data) != length:
             raise IOError('chunk fetch for {!r} returned {} bytes, expected {}'.format(
                 key, len(data), length))
@@ -333,6 +409,49 @@ class ChunkStore(object):
             return os.stat(path).st_size == length
         except OSError:
             return False
+
+    # -- fabric serving ------------------------------------------------------
+
+    @contextlib.contextmanager
+    def pin_for_send(self, key):
+        """Pin ``key``'s mirror against eviction for the duration of a fabric
+        send, yielding its path (or None when the chunk is not mirrored here).
+
+        The pin is a manual borrow on the chunk's lifetime slot — the mmap
+        slot when a mapping is live, a dedicated ``fabric-send`` slot
+        otherwise — so :meth:`_try_evict_entry`'s ``try_reclaim`` refuses
+        (counted skip, ``lifetime_blocked_reclaims``) instead of unlinking a
+        file mid-stream and truncating the transfer on the peer's side."""
+        digest = self.digest(key)
+        path = self._entry_path(digest)
+        with self._lock:
+            slot = None
+            entry = self._mmaps.get(digest)
+            if entry is not None:
+                try:
+                    slot = entry[2].retain()
+                except RuntimeError:
+                    slot = None  # released between lookup and retain
+            if slot is None:
+                pin = self._send_pins.get(digest)
+                if pin is None or pin.released:
+                    pin = lifetime_registry().open_slot(label='fabric-send')
+                    self._send_pins[digest] = pin
+                slot = pin.retain()
+        try:
+            present = False
+            try:
+                present = os.path.exists(path)
+            except OSError:
+                present = False
+            yield path if present else None
+        finally:
+            with self._lock:
+                slot.drop()
+                pin = self._send_pins.get(digest)
+                if pin is slot and not slot.live:
+                    del self._send_pins[digest]
+                    slot.seal()  # zero borrows: releases immediately
 
     # -- mapping -------------------------------------------------------------
 
@@ -393,6 +512,11 @@ class ChunkStore(object):
         the process-wide ``lifetime_blocked_reclaims``."""
         with self._lock:
             self._strong.pop(digest, None)
+            pin = self._send_pins.get(digest)
+            if pin is not None:
+                if not pin.try_reclaim():
+                    return False  # a fabric send is streaming this mirror
+                del self._send_pins[digest]
             entry = self._mmaps.get(digest)
             if entry is not None:
                 if not entry[2].try_reclaim():

@@ -74,22 +74,26 @@ DEFAULT_LEASE_RETRY = RetryPolicy(max_attempts=4, initial_backoff_s=0.02,
 
 
 class MemberInfo(object):
-    """One member's decoded lease, plus the liveness verdict."""
+    """One member's decoded lease, plus the liveness verdict. ``notes`` is
+    the holder's annotation dict (e.g. its fabric endpoint) — empty when the
+    lease predates annotations or could not be read."""
 
-    __slots__ = ('host', 'pid', 'lease_s', 'renewed', 'alive', 'expired')
+    __slots__ = ('host', 'pid', 'lease_s', 'renewed', 'alive', 'expired',
+                 'notes')
 
-    def __init__(self, host, pid, lease_s, renewed, alive, expired):
+    def __init__(self, host, pid, lease_s, renewed, alive, expired, notes=None):
         self.host = host
         self.pid = pid
         self.lease_s = lease_s
         self.renewed = renewed
         self.alive = alive
         self.expired = expired
+        self.notes = notes if notes is not None else {}
 
     def to_dict(self):
         return {'host': self.host, 'pid': self.pid, 'lease_s': self.lease_s,
                 'renewed': self.renewed, 'alive': self.alive,
-                'expired': self.expired}
+                'expired': self.expired, 'notes': self.notes}
 
 
 class MembershipRegistry(object):
@@ -103,14 +107,21 @@ class MembershipRegistry(object):
     :param retry: :class:`RetryPolicy` for lease I/O (default bounded
         short-backoff policy); tests inject flaky-fs faults through the
         policy's ``FAULT_POINT`` hook
+    :param annotations: optional JSON-serializable dict carried inside every
+        lease renewal (surfaced to peers as :attr:`MemberInfo.notes`) — how a
+        host publishes per-host metadata such as its chunk-fabric endpoint
+        WITHOUT a second discovery protocol: the annotation lives and dies
+        with the lease itself
     """
 
-    def __init__(self, coord_dir, host_id, lease_s=5.0, retry=None):
+    def __init__(self, coord_dir, host_id, lease_s=5.0, retry=None,
+                 annotations=None):
         if lease_s <= 0:
             raise ValueError('lease_s must be positive, got {!r}'.format(lease_s))
         self.coord_dir = coord_dir
         self.host_id = str(host_id)
         self.lease_s = float(lease_s)
+        self.annotations = dict(annotations) if annotations else {}
         self._retry = retry if retry is not None else DEFAULT_LEASE_RETRY
         self._members_dir = os.path.join(coord_dir, 'members')
         self._lease_path = os.path.join(self._members_dir,
@@ -159,10 +170,13 @@ class MembershipRegistry(object):
     # -- lease renewal -----------------------------------------------------
 
     def _renew(self):
-        payload = json.dumps({'host': self.host_id, 'pid': os.getpid(),
-                              'machine': _machine_id(),
-                              'lease_s': self.lease_s,
-                              'renewed': time.time()})
+        record = {'host': self.host_id, 'pid': os.getpid(),
+                  'machine': _machine_id(),
+                  'lease_s': self.lease_s,
+                  'renewed': time.time()}
+        if self.annotations:
+            record['notes'] = self.annotations
+        payload = json.dumps(record)
         tmp = self._lease_path + '.tmp.{}'.format(os.getpid())
 
         def write_and_swap():
@@ -223,6 +237,9 @@ class MembershipRegistry(object):
             pid = data.get('pid')
             lease_s = float(data.get('lease_s') or self.lease_s)
             renewed = float(data.get('renewed') or 0.0)
+            notes = data.get('notes')
+            if not isinstance(notes, dict):
+                notes = {}
             fresh = (now - renewed) <= lease_s
             if fresh and pid is not None and os.getpid() != pid \
                     and data.get('machine') == _machine_id() \
@@ -231,7 +248,8 @@ class MembershipRegistry(object):
                 # SIGKILLed); no need to wait out the remaining lease time.
                 fresh = False
             infos.append(MemberInfo(host, pid, lease_s, renewed,
-                                    alive=fresh, expired=not fresh))
+                                    alive=fresh, expired=not fresh,
+                                    notes=notes))
         return infos
 
     def alive_members(self, now=None):

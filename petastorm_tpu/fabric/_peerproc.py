@@ -1,0 +1,81 @@
+"""One fabric peer as a subprocess — the chaos-test SIGKILL target.
+
+``python -m petastorm_tpu.fabric._peerproc --url ... --coord ... --host pA
+--cache-root ...`` warms its local chunk mirror by reading the dataset once,
+then joins the pod membership (publishing its fabric endpoint as a lease
+annotation) and serves chunks until killed. The chaos drill
+(``tests/test_fabric.py``) arms ``--stall-s`` so every payload send sleeps
+first, waits for ``--request-marker`` to appear (a transfer is now in
+flight), and SIGKILLs this process mid-transfer — proving the fetching side
+degrades to the object store and still populates its mirror exactly once.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+
+def _parse_args(argv):
+    parser = argparse.ArgumentParser(prog='pstpu-fabric-peer')
+    parser.add_argument('--url', required=True)
+    parser.add_argument('--coord', required=True)
+    parser.add_argument('--host', required=True)
+    parser.add_argument('--cache-root', required=True)
+    parser.add_argument('--lease-s', type=float, default=2.0)
+    parser.add_argument('--stall-s', type=float, default=0.0,
+                        help='stall every payload send this long (the '
+                             'SIGKILL window for the chaos drill)')
+    parser.add_argument('--request-marker', default=None,
+                        help='file touched when the first request arrives')
+    parser.add_argument('--ready-file', default=None,
+                        help='touched once warmed, joined, and serving')
+    return parser.parse_args(argv)
+
+
+def main(argv=None):
+    args = _parse_args(argv if argv is not None else sys.argv[1:])
+    from petastorm_tpu import fabric, faults, make_reader
+    from petastorm_tpu.chunkstore import ChunkCacheConfig
+    from petastorm_tpu.observability import blackbox
+
+    blackbox.maybe_enable('fabric-peer-' + args.host)
+    cache = ChunkCacheConfig(args.cache_root)
+    # warm the mirror: one full epoch mirrors every cacheable chunk locally
+    with make_reader(args.url, reader_pool_type='dummy',
+                     shuffle_row_groups=False, chunk_cache=cache) as reader:
+        for _ in reader:
+            pass
+
+    if args.stall_s:
+        faults.install_net(faults.NetFaultPlan(stall_payloads=1_000_000,
+                                               stall_s=args.stall_s))
+
+    def on_request(key):
+        if args.request_marker:
+            tmp = args.request_marker + '.tmp'
+            with open(tmp, 'w') as f:
+                f.write(key)
+            os.replace(tmp, args.request_marker)
+
+    node = fabric.start_node(
+        fabric.FabricConfig(args.coord, args.host, cache, serve=True,
+                            join=True, lease_s=args.lease_s),
+        on_request=on_request)
+    try:
+        if args.ready_file:
+            tmp = args.ready_file + '.tmp'
+            with open(tmp, 'w') as f:
+                f.write(str(os.getpid()))
+            os.replace(tmp, args.ready_file)
+        while True:  # serve until SIGKILLed (or terminated) by the driver
+            time.sleep(0.2)
+    finally:
+        node.stop()
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -1,0 +1,100 @@
+"""Per-peer circuit breaker: stop hammering a peer that keeps failing.
+
+Classic three-state breaker (``docs/fabric.md``, "Breaker semantics"):
+
+* **closed** — requests flow; each success resets the consecutive-failure
+  count, each failure increments it; K consecutive failures trip the breaker
+  **open**;
+* **open** — requests are refused locally (the client goes straight to the
+  object-store fallback, costing zero network round trips on a peer that is
+  known-bad) until ``reset_after_s`` has elapsed;
+* **half-open** — after the cooldown, exactly ONE probe request is let
+  through: success closes the breaker, failure re-opens it (and restarts the
+  cooldown clock).
+
+The breaker is deliberately per-peer and local — no coordination, no shared
+state: each host learns its own view of which peers are healthy, which is
+exactly the view that predicts ITS next request's fate.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+CLOSED, OPEN, HALF_OPEN = 'closed', 'open', 'half-open'
+
+
+class CircuitBreaker(object):
+    """Thread-safe per-peer breaker.
+
+    :param failure_threshold: consecutive failures that trip the breaker open
+    :param reset_after_s: cooldown before an open breaker admits one probe
+    :param clock: monotonic time source (tests inject a fake)
+    """
+
+    def __init__(self, failure_threshold=3, reset_after_s=5.0,
+                 clock=time.monotonic):
+        if failure_threshold < 1:
+            raise ValueError('failure_threshold must be >= 1, got {}'.format(
+                failure_threshold))
+        self.failure_threshold = int(failure_threshold)
+        self.reset_after_s = float(reset_after_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = CLOSED
+        self._failures = 0
+        self._opened_at = 0.0
+        self._probe_in_flight = False
+
+    @property
+    def state(self):
+        with self._lock:
+            return self._state
+
+    def allow(self):
+        """May a request be sent to this peer right now?
+
+        Open breakers whose cooldown elapsed transition to half-open and
+        admit exactly one probe; further calls are refused until that probe
+        resolves through :meth:`record_success` / :meth:`record_failure`.
+        """
+        with self._lock:
+            if self._state == CLOSED:
+                return True
+            if self._state == OPEN:
+                if self._clock() - self._opened_at < self.reset_after_s:
+                    return False
+                self._state = HALF_OPEN
+                self._probe_in_flight = True
+                return True
+            # HALF_OPEN: one probe at a time
+            if self._probe_in_flight:
+                return False
+            self._probe_in_flight = True
+            return True
+
+    def record_success(self):
+        """A request to this peer completed (bytes verified)."""
+        with self._lock:
+            self._state = CLOSED
+            self._failures = 0
+            self._probe_in_flight = False
+
+    def record_failure(self):
+        """A request to this peer failed (connect/timeout/torn/corrupt).
+        Returns True when THIS failure tripped the breaker open — the
+        caller's signal to count a ``fabric_breaker_open`` transition."""
+        with self._lock:
+            self._failures += 1
+            self._probe_in_flight = False
+            if self._state == HALF_OPEN or \
+                    self._failures >= self.failure_threshold:
+                opened = self._state != OPEN
+                self._state = OPEN
+                self._opened_at = self._clock()
+                return opened
+            return False
+
+
+__all__ = ['CLOSED', 'CircuitBreaker', 'HALF_OPEN', 'OPEN']

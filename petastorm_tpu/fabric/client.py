@@ -1,0 +1,300 @@
+"""Fabric fetching side: peer-first chunk acquisition with graceful fallback.
+
+:class:`FabricClient.fetch` is the ``chunkstore.store.PEER_SOURCE`` hook — it
+runs on a chunkstore miss, inside the fetch stage, and returns the chunk
+bytes (peer or object store) or ``None`` when a concurrent fetch of the same
+chunk already populated the mirror (the single-flight follower path; the
+store re-stats and treats it as a hit).
+
+The failure contract is strict: a fabric problem NEVER fails the batch. Every
+peer-path failure — refused connect, reset, timeout, torn stream, corrupt
+payload, protocol garbage — lands in the object-store fallback
+(``retry.fetch_range`` via the reader's ordinary ``fetch_fn``), and only a
+genuine storage error from that fallback propagates. Peer bytes are admitted
+only after the sha256 in the response header verifies; anything else is
+discarded on the spot.
+
+Per-peer circuit breakers (``breaker.py``) keep a flaky peer from taxing
+every fetch: once open, requests skip the peer entirely (zero round trips)
+until a half-open probe proves it healthy again.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import socket
+import tempfile
+import threading
+import time
+
+from petastorm_tpu import faults
+from petastorm_tpu import observability as obs
+from petastorm_tpu.fabric import protocol as P
+from petastorm_tpu.fabric.breaker import CircuitBreaker
+from petastorm_tpu.fabric.peers import rank_peers
+from petastorm_tpu.observability import blackbox
+
+logger = logging.getLogger(__name__)
+
+#: how often the per-peer stats file is rewritten at most (plus on close)
+_STATS_FLUSH_INTERVAL_S = 0.5
+
+#: bound on how long a single-flight follower waits for the leader before
+#: assuming it died and taking over the fetch itself
+_INFLIGHT_WAIT_S = 60.0
+
+
+def _new_peer_stats():
+    return {'hits': 0, 'failures': 0, 'fallbacks': 0, 'bytes': 0,
+            'latency_sum': 0.0, 'latency_n': 0}
+
+
+class FabricClient(object):
+    """Peer-first chunk fetcher for one host.
+
+    :param store: the host's :class:`ChunkStore` (for digests + the
+        single-flight follower's populated check)
+    :param peer_registry: a :class:`~petastorm_tpu.fabric.peers.PeerRegistry`
+        over the pod's membership leases
+    :param coord_dir: the pod coordination directory; per-peer stats are
+        flushed under ``<coord_dir>/fabric/stats/`` for ``diagnose --fabric``
+    :param deadline_s: end-to-end budget for one peer transfer (connect +
+        request + response + payload); what remains after a failed peer
+        attempt is handed to the fallback as its retry deadline
+    :param io_timeout_s: per-socket-operation timeout
+    :param connect_timeout_s: TCP connect timeout (kept tight — a dead peer
+        must cost little)
+    :param failure_threshold: consecutive failures that open a peer's breaker
+    :param breaker_reset_s: open-breaker cooldown before a half-open probe
+    :param monitor: optional :class:`~petastorm_tpu.analysis.protocol.
+        monitor.FabricMonitor` asserting protocol invariants at runtime
+    """
+
+    def __init__(self, store, peer_registry, coord_dir, deadline_s=10.0,
+                 io_timeout_s=2.0, connect_timeout_s=1.0,
+                 failure_threshold=3, breaker_reset_s=5.0, monitor=None):
+        self._store = store
+        self._peers = peer_registry
+        self._coord_dir = coord_dir
+        self.deadline_s = float(deadline_s)
+        self.io_timeout_s = float(io_timeout_s)
+        self.connect_timeout_s = float(connect_timeout_s)
+        self._failure_threshold = int(failure_threshold)
+        self._breaker_reset_s = float(breaker_reset_s)
+        self._monitor = monitor
+        self._lock = threading.Lock()
+        self._breakers = {}     # peer host -> CircuitBreaker
+        self._inflight = {}     # chunk digest -> threading.Event
+        self._peer_stats = {}   # peer host -> counter dict
+        self._last_flush = 0.0
+        self._stats_dir = os.path.join(coord_dir, 'fabric', 'stats')
+        self._stats_path = os.path.join(
+            self._stats_dir, '{}-pid{}.json'.format(
+                peer_registry.host_id, os.getpid()))
+
+    # -- the PEER_SOURCE hook ------------------------------------------------
+
+    def fetch(self, key, length, fetch_fn):
+        """Produce ``length`` bytes for ``key``: peer first, object store on
+        any fabric trouble, ``None`` when a concurrent fetch won the race.
+
+        Exactly one thread per chunk runs the transfer (single-flight):
+        concurrent callers wait, then report ``None`` so the chunkstore
+        re-stats the now-populated mirror instead of fetching twice.
+        """
+        digest = self._store.digest(key)
+        while True:
+            with self._lock:
+                event = self._inflight.get(digest)
+                if event is None:
+                    self._inflight[digest] = threading.Event()
+                    break
+            event.wait(timeout=_INFLIGHT_WAIT_S)
+            if self._store.contains(key, length):
+                return None  # leader populated it; ensure() re-stats as a hit
+            # leader failed or died without populating: loop to take over
+        try:
+            return self._fetch_once(key, length, digest, fetch_fn)
+        finally:
+            with self._lock:
+                event = self._inflight.pop(digest, None)
+            if event is not None:
+                event.set()
+
+    def _fetch_once(self, key, length, digest, fetch_fn):
+        if self._monitor is not None:
+            # reaching here means ensure() missed: any earlier population of
+            # this chunk has been evicted, so populating again is legitimate
+            self._monitor.on_invalidate(digest)
+        deadline = P.Deadline(self.deadline_s)
+        peer = self._pick_peer(digest)
+        if peer is not None:
+            t0 = time.monotonic()
+            try:
+                with obs.stage('fabric_peer_fetch', cat='fabric',
+                               bytes=length, peer=peer.host):
+                    data = self._fetch_from_peer(peer, key, length, deadline)
+            except (OSError, P.FabricError) as e:
+                self._note_failure(peer, e)
+            else:
+                if data is not None:
+                    self._note_success(peer, key, digest, length,
+                                       time.monotonic() - t0)
+                    return data
+                # miss: the peer is healthy, it just does not mirror this
+                # chunk — no breaker penalty, straight to the fallback
+        return self._fallback(key, length, peer, deadline, fetch_fn)
+
+    # -- peer path -----------------------------------------------------------
+
+    def _pick_peer(self, digest):
+        """The rendezvous-best alive peer whose breaker admits a request."""
+        for peer in rank_peers(digest, self._peers.alive_peers()):
+            if self._breaker_for(peer.host).allow():
+                if self._monitor is not None:
+                    self._monitor.on_request(peer.host, allowed=True)
+                return peer
+        return None
+
+    def _fetch_from_peer(self, peer, key, length, deadline):
+        faults.on_net_connect()
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        try:
+            sock.settimeout(deadline.op_timeout(self.connect_timeout_s))
+            sock.connect(peer.endpoint)
+            P.send_frame(sock, P.encode_request(key, length), deadline,
+                         self.io_timeout_s)
+            msg = P.decode_message(
+                P.recv_frame(sock, deadline, self.io_timeout_s))
+            status = msg.get('status')
+            if status == 'miss':
+                return None
+            if status != 'ok':
+                raise P.FabricProtocolError('peer {} answered {}: {}'.format(
+                    peer.host, status, msg.get('message')))
+            n = int(msg.get('length') or 0)
+            if n != length:
+                raise P.FabricProtocolError(
+                    'peer {} offered {} bytes for a {} byte chunk'.format(
+                        peer.host, n, length))
+            data = P.recv_exactly(sock, n, deadline, self.io_timeout_s)
+            if P.content_hash(data) != msg.get('sha256'):
+                raise P.FabricProtocolError(
+                    'content hash mismatch from peer {} — {} bytes '
+                    'discarded'.format(peer.host, n))
+            return data
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _breaker_for(self, host):
+        with self._lock:
+            breaker = self._breakers.get(host)
+            if breaker is None:
+                breaker = CircuitBreaker(
+                    failure_threshold=self._failure_threshold,
+                    reset_after_s=self._breaker_reset_s)
+                self._breakers[host] = breaker
+            return breaker
+
+    def _note_success(self, peer, key, digest, length, latency_s):
+        self._breaker_for(peer.host).record_success()
+        obs.count('fabric_peer_hits')
+        obs.count('fabric_bytes_from_peers', length)
+        if self._monitor is not None:
+            self._monitor.on_populate(digest, verified=True)
+            self._monitor.on_outcome(key, 'peer')
+        blackbox.record_event({'kind': 'fabric', 'op': 'peer_hit',
+                               'peer': peer.host, 'key': key,
+                               'bytes': length,
+                               'latency_ms': round(latency_s * 1e3, 3)})
+        with self._lock:
+            stats = self._peer_stats.setdefault(peer.host, _new_peer_stats())
+            stats['hits'] += 1
+            stats['bytes'] += length
+            stats['latency_sum'] += latency_s
+            stats['latency_n'] += 1
+        self._flush_stats()
+
+    def _note_failure(self, peer, error):
+        tripped = self._breaker_for(peer.host).record_failure()
+        logger.debug('fabric fetch from peer %s failed: %s', peer.host, error)
+        if tripped:
+            obs.count('fabric_breaker_open')
+            blackbox.record_event({'kind': 'fabric', 'op': 'breaker_open',
+                                   'peer': peer.host, 'error': str(error)[:200]})
+        with self._lock:
+            stats = self._peer_stats.setdefault(peer.host, _new_peer_stats())
+            stats['failures'] += 1
+        self._flush_stats()
+
+    # -- fallback path -------------------------------------------------------
+
+    def _fallback(self, key, length, peer, deadline, fetch_fn):
+        obs.count('fabric_fallbacks')
+        blackbox.record_event({'kind': 'fabric', 'op': 'fallback', 'key': key,
+                               'peer': peer.host if peer else None})
+        with self._lock:
+            host = peer.host if peer is not None else '-'
+            stats = self._peer_stats.setdefault(host, _new_peer_stats())
+            stats['fallbacks'] += 1
+        self._flush_stats()
+        try:
+            with obs.stage('fabric_fallback', cat='fabric', bytes=length):
+                remaining = deadline.remaining()
+                if remaining > 0 and getattr(fetch_fn, 'supports_deadline',
+                                             False):
+                    data = fetch_fn(deadline_s=remaining)
+                else:
+                    # budget burned on a stalled peer (or plain fetch_fn):
+                    # the fallback still runs under its own retry policy —
+                    # degradation must not turn into failure
+                    data = fetch_fn()
+        except Exception:
+            if self._monitor is not None:
+                self._monitor.on_outcome(key, 'error')
+            raise  # a genuine storage error: the one thing we do propagate
+        if self._monitor is not None:
+            digest = self._store.digest(key)
+            self._monitor.on_populate(digest, verified=True)
+            self._monitor.on_outcome(key, 'fallback')
+        return data
+
+    # -- stats for diagnose --------------------------------------------------
+
+    def _flush_stats(self, force=False):
+        now = time.monotonic()
+        with self._lock:
+            if not force and now - self._last_flush < _STATS_FLUSH_INTERVAL_S:
+                return
+            self._last_flush = now
+            snapshot = {
+                'host': self._peers.host_id,
+                'peers': {h: dict(s) for h, s in self._peer_stats.items()},
+                'breakers': {h: b.state for h, b in self._breakers.items()},
+            }
+        try:
+            os.makedirs(self._stats_dir, exist_ok=True)
+            fd, tmp = tempfile.mkstemp(dir=self._stats_dir, suffix='.tmp')
+            with os.fdopen(fd, 'w') as f:
+                json.dump(snapshot, f)
+            os.replace(tmp, self._stats_path)
+        except OSError as e:
+            logger.debug('fabric stats flush failed: %s', e)
+
+    def close(self):
+        self._flush_stats(force=True)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+__all__ = ['FabricClient']

@@ -1,0 +1,86 @@
+"""Peer discovery for the chunk fabric: ride the elastic lease machinery.
+
+There is deliberately NO new discovery protocol here. A fabric host publishes
+its endpoint as an annotation inside the membership lease it already renews
+(``elastic/membership.py``): ``notes = {'fabric': [address, port]}``. Peer
+liveness is therefore EXACTLY lease liveness — a host whose lease expires is
+a dead peer, a host that left gracefully disappears with its lease, and the
+false-expiry window documented for elastic sharding applies verbatim.
+
+Peer selection uses rendezvous (highest-random-weight) hashing of
+``(chunk digest, peer host)``: every host independently ranks the same peers
+in the same order for a given chunk, so a pod's fetches for one chunk
+converge on one peer (its mirror warms once and serves everyone) while the
+overall key space spreads evenly across peers — and a peer's death only
+remaps the chunks it owned.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+
+class PeerInfo(object):
+    """One live fabric peer: lease identity + published endpoint."""
+
+    __slots__ = ('host', 'address', 'port')
+
+    def __init__(self, host, address, port):
+        self.host = host
+        self.address = address
+        self.port = int(port)
+
+    @property
+    def endpoint(self):
+        return (self.address, self.port)
+
+    def __repr__(self):
+        return 'PeerInfo(host={!r}, endpoint={}:{})'.format(
+            self.host, self.address, self.port)
+
+
+class PeerRegistry(object):
+    """Live fabric peers, read straight off the membership lease scan.
+
+    :param membership: a :class:`~petastorm_tpu.elastic.membership.
+        MembershipRegistry` over the pod's coordination directory. It does
+        not need to be joined — a fetch-only process (e.g. a spawned worker)
+        scans leases without holding one.
+    """
+
+    def __init__(self, membership):
+        self._membership = membership
+
+    @property
+    def host_id(self):
+        return self._membership.host_id
+
+    def alive_peers(self):
+        """Every OTHER host with a live lease and a published fabric
+        endpoint, sorted by host id (deterministic iteration order)."""
+        peers = []
+        for m in self._membership.scan():
+            if not m.alive or m.host == self._membership.host_id:
+                continue
+            endpoint = m.notes.get('fabric') if m.notes else None
+            if (not isinstance(endpoint, (list, tuple)) or len(endpoint) != 2):
+                continue
+            try:
+                peers.append(PeerInfo(m.host, str(endpoint[0]), int(endpoint[1])))
+            except (TypeError, ValueError):
+                continue
+        peers.sort(key=lambda p: p.host)
+        return peers
+
+
+def rank_peers(digest, peers):
+    """Rendezvous-hash ranking of ``peers`` for one chunk ``digest``: best
+    candidate first. Stable across hosts for identical peer sets."""
+    def weight(peer):
+        h = hashlib.sha1('{}|{}'.format(digest, peer.host).encode('utf-8'))
+        return h.hexdigest()
+
+    return sorted(peers, key=weight, reverse=True)
+
+
+__all__ = ['PeerInfo', 'PeerRegistry', 'rank_peers']

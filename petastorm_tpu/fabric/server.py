@@ -1,0 +1,215 @@
+"""Fabric serving side: a small daemon thread that streams mirrored chunks.
+
+One listener socket, one accept-loop daemon thread, one short-lived handler
+thread per connection (a connection carries exactly one request/response
+exchange, so handlers are bounded by the peer's deadline budget). The server
+never fetches anything: it answers ``miss`` for chunks its local
+:class:`~petastorm_tpu.chunkstore.store.ChunkStore` does not mirror, and the
+asking client falls back to the object store — serving is strictly a cache
+tier, never a dependency.
+
+While a chunk is being read and streamed, its mirror file is pinned through
+:meth:`ChunkStore.pin_for_send` (a manual borrow on the chunk's lifetime
+slot), so the LRU evictor refuses it with a counted skip instead of
+unlinking a file out from under an in-flight transfer.
+
+Injected network faults (``faults.NetFaultPlan``) act at the payload-send
+point: stalls sleep before the body (the window a chaos driver SIGKILLs a
+peer in), resets abort the TCP stream mid-body, truncations close it cleanly
+half-way, corruptions flip bytes — the content hash in the header is always
+computed from the TRUE bytes, so every destructive fault is detectable on
+the receiving side.
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import struct
+import threading
+import time
+
+from petastorm_tpu import faults
+from petastorm_tpu import observability as obs
+from petastorm_tpu.fabric import protocol as P
+from petastorm_tpu.observability import blackbox
+
+logger = logging.getLogger(__name__)
+
+#: accept-loop poll period: how fast stop() is noticed, NOT a request timeout
+_ACCEPT_POLL_S = 0.2
+
+
+class FabricServer(object):
+    """Chunk-serving daemon for one host's chunkstore mirror.
+
+    :param store: the host's :class:`ChunkStore` (chunks it mirrors locally)
+    :param listen_host: bind address (default loopback; a real pod binds the
+        pod-network interface)
+    :param port: bind port (default 0 = ephemeral; read :attr:`endpoint`)
+    :param io_timeout_s: per-socket-operation timeout for request/response IO
+    :param request_deadline_s: end-to-end budget for one exchange — a client
+        that stops reading cannot pin a handler thread forever
+    :param on_request: optional callable ``(key)`` invoked when a request
+        arrives (chaos drills use it to mark "a transfer is now in flight")
+    """
+
+    def __init__(self, store, listen_host='127.0.0.1', port=0,
+                 io_timeout_s=2.0, request_deadline_s=30.0, on_request=None):
+        self._store = store
+        self._listen_host = listen_host
+        self._port = int(port)
+        self.io_timeout_s = float(io_timeout_s)
+        self.request_deadline_s = float(request_deadline_s)
+        self._on_request = on_request
+        self._sock = None
+        self._thread = None
+        self._stop = threading.Event()
+        self._endpoint = None
+
+    @property
+    def endpoint(self):
+        """``(address, port)`` once started, else None."""
+        return self._endpoint
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self):
+        """Bind, listen, and start the accept-loop daemon thread."""
+        if self._thread is not None:
+            return self
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        try:
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            sock.bind((self._listen_host, self._port))
+            sock.listen(16)
+            sock.settimeout(_ACCEPT_POLL_S)
+        except BaseException:
+            sock.close()
+            raise
+        self._sock = sock
+        self._endpoint = sock.getsockname()[:2]
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._accept_loop, name='pstpu-fabric-serve', daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        """Stop accepting and close the listener. In-flight handler threads
+        finish their (deadline-bounded) exchange on their own."""
+        self._stop.set()
+        thread = self._thread
+        self._thread = None
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+        if thread is not None:
+            thread.join(timeout=_ACCEPT_POLL_S * 10)
+        self._endpoint = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -- serving -------------------------------------------------------------
+
+    def _accept_loop(self):
+        sock = self._sock
+        while not self._stop.is_set():
+            try:
+                sock.settimeout(_ACCEPT_POLL_S)
+                conn, addr = sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break  # listener closed by stop()
+            handler = threading.Thread(
+                target=self._handle, args=(conn, addr),
+                name='pstpu-fabric-handler', daemon=True)
+            handler.start()
+
+    def _handle(self, conn, addr):
+        try:
+            deadline = P.Deadline(self.request_deadline_s)
+            msg = P.decode_message(
+                P.recv_frame(conn, deadline, self.io_timeout_s))
+            if msg.get('op') != 'get':
+                P.send_frame(conn, P.encode_error(
+                    'unsupported op {!r}'.format(msg.get('op'))),
+                    deadline, self.io_timeout_s)
+                return
+            key = msg.get('key')
+            length = int(msg.get('length') or 0)
+            if not isinstance(key, str) or length <= 0:
+                P.send_frame(conn, P.encode_error('malformed get request'),
+                             deadline, self.io_timeout_s)
+                return
+            if self._on_request is not None:
+                self._on_request(key)
+            with obs.stage('fabric_serve', cat='fabric', bytes=length):
+                self._serve_chunk(conn, key, length, deadline)
+        except (OSError, P.FabricError) as e:
+            # a dead/flaky CLIENT is not this host's problem: log and move on
+            logger.debug('fabric handler for %s failed: %s', addr, e)
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _serve_chunk(self, conn, key, length, deadline):
+        with self._store.pin_for_send(key) as path:
+            data = None
+            if path is not None:
+                try:
+                    with open(path, 'rb') as f:
+                        data = f.read()
+                except OSError:
+                    data = None
+            if data is None or len(data) != length:
+                P.send_frame(conn, P.encode_miss(), deadline, self.io_timeout_s)
+                return
+            # the header hash is ALWAYS of the true bytes: any injected
+            # corruption/truncation below is detectable by the receiver
+            digest = P.content_hash(data)
+            action = faults.net_payload_action()
+            if action is not None and action[0] == 'corrupt':
+                corrupted = bytearray(data)
+                mid = len(corrupted) // 2
+                corrupted[mid] ^= 0xFF
+                corrupted[0] ^= 0xFF
+                data = bytes(corrupted)
+            P.send_frame(conn, P.encode_ok(length, digest), deadline,
+                         self.io_timeout_s)
+            if action is not None and action[0] == 'stall':
+                self._stall(action[1])
+            if action is not None and action[0] == 'reset':
+                P.send_all(conn, data[:length // 2], deadline, self.io_timeout_s)
+                # RST instead of FIN: the client sees ECONNRESET mid-body
+                conn.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                                struct.pack('ii', 1, 0))
+                return
+            if action is not None and action[0] == 'truncate':
+                P.send_all(conn, data[:length // 2], deadline, self.io_timeout_s)
+                return
+            P.send_all(conn, data, deadline, self.io_timeout_s)
+        obs.count('fabric_chunks_served')
+        obs.count('fabric_bytes_served', length)
+        blackbox.record_event({'kind': 'fabric', 'op': 'serve', 'key': key,
+                               'bytes': length})
+
+    def _stall(self, stall_s):
+        """Sleep in small slices so stop() is still honored mid-stall."""
+        t_end = time.monotonic() + float(stall_s)
+        while time.monotonic() < t_end and not self._stop.is_set():
+            time.sleep(min(0.05, max(0.0, t_end - time.monotonic())))
+
+
+__all__ = ['FabricServer']

@@ -237,6 +237,122 @@ def _storage_fault_point():
 
 
 # ---------------------------------------------------------------------------
+# network faults for the chunk-transfer fabric (docs/fabric.md)
+# ---------------------------------------------------------------------------
+
+class NetFaultPlan(object):
+    """Seeded network faults for the peer-to-peer chunk fabric.
+
+    Each budget arms the first N occurrences of its hook point — connect
+    attempts for ``refuse_connects``, payload sends for the rest — so a chaos
+    run replays the identical failure schedule. With a ``state_dir`` the
+    shots coordinate across processes through the same ``O_CREAT|O_EXCL``
+    sentinel files item faults use; without one they count per process.
+
+    :param refuse_connects: first N fabric connect attempts raise
+        ``ConnectionRefusedError`` (the peer's port is gone).
+    :param reset_payloads: first N payload sends abort mid-transfer with
+        ``ConnectionResetError`` after a partial body — the receiver sees a
+        torn frame and must discard it.
+    :param truncate_payloads: first N payload sends deliver only half the
+        body then close cleanly — a byte-level truncation the content hash
+        must catch.
+    :param corrupt_payloads: first N payload sends flip bytes in the body —
+        length-preserving corruption only the hash can catch.
+    :param stall_payloads: first N payload sends sleep ``stall_s`` before the
+        body — the slow-peer case the client's deadline budget must bound.
+    :param stall_s: how long each ``stall_payloads`` shot sleeps.
+    :param state_dir: directory for cross-process one-shot coordination.
+    """
+
+    def __init__(self, refuse_connects=0, reset_payloads=0,
+                 truncate_payloads=0, corrupt_payloads=0, stall_payloads=0,
+                 stall_s=5.0, state_dir=None):
+        self.refuse_connects = int(refuse_connects)
+        self.reset_payloads = int(reset_payloads)
+        self.truncate_payloads = int(truncate_payloads)
+        self.corrupt_payloads = int(corrupt_payloads)
+        self.stall_payloads = int(stall_payloads)
+        self.stall_s = float(stall_s)
+        self.state_dir = state_dir
+        self._fired = {}
+
+    def __repr__(self):
+        return ('NetFaultPlan(refuse_connects={}, reset_payloads={}, '
+                'truncate_payloads={}, corrupt_payloads={}, stall_payloads={}, '
+                'stall_s={})'.format(
+                    self.refuse_connects, self.reset_payloads,
+                    self.truncate_payloads, self.corrupt_payloads,
+                    self.stall_payloads, self.stall_s))
+
+
+_NET_PLAN = None
+
+
+def install_net(plan):
+    """Install a :class:`NetFaultPlan` process-wide (``None`` disarms)."""
+    global _NET_PLAN
+    _NET_PLAN = plan
+    return plan
+
+
+def uninstall_net():
+    install_net(None)
+
+
+def get_net_plan():
+    return _NET_PLAN
+
+
+def _claim_counted(plan, kind, budget):
+    """True for the first ``budget`` calls with this ``kind`` — coordinated
+    across processes when the plan has a state_dir, per-process otherwise."""
+    if budget <= 0:
+        return False
+    if plan.state_dir:
+        for shot in range(budget):
+            if _claim_one_shot(plan.state_dir, 'net_{}_{}'.format(kind, shot)):
+                return True
+        return False
+    fired = plan._fired.get(kind, 0)
+    if fired < budget:
+        plan._fired[kind] = fired + 1
+        return True
+    return False
+
+
+def on_net_connect():
+    """Connect-time hook: the fabric client calls this immediately before
+    ``socket.connect``. No-op without an installed net plan."""
+    plan = _NET_PLAN
+    if plan is None:
+        return
+    if _claim_counted(plan, 'refuse', plan.refuse_connects):
+        raise ConnectionRefusedError(
+            'injected connection refusal (fabric net fault)')
+
+
+def net_payload_action():
+    """Payload-send hook: the fabric server consults this once per payload
+    and honors the returned action. Returns ``('reset'|'truncate'|'corrupt'|
+    'stall', stall_s_or_None)`` or None. At most one action fires per call;
+    stalls win over the destructive actions so a stalled transfer can also
+    be the one a chaos driver SIGKILLs mid-flight."""
+    plan = _NET_PLAN
+    if plan is None:
+        return None
+    if _claim_counted(plan, 'stall', plan.stall_payloads):
+        return ('stall', plan.stall_s)
+    if _claim_counted(plan, 'reset', plan.reset_payloads):
+        return ('reset', None)
+    if _claim_counted(plan, 'truncate', plan.truncate_payloads):
+        return ('truncate', None)
+    if _claim_counted(plan, 'corrupt', plan.corrupt_payloads):
+        return ('corrupt', None)
+    return None
+
+
+# ---------------------------------------------------------------------------
 # elastic-pod host churn (docs/parallelism.md, "Elastic pod sharding")
 # ---------------------------------------------------------------------------
 
@@ -322,6 +438,8 @@ def drive_host_churn(coord_dir, procs, plan, spawn_joiner=None,
     return timeline
 
 
-__all__ = ['FaultInjectedError', 'FaultPlan', 'HostChurnPlan',
-           'count_committed', 'drive_host_churn', 'get_plan', 'install',
-           'mark_in_spawned_worker', 'on_item', 'uninstall']
+__all__ = ['FaultInjectedError', 'FaultPlan', 'HostChurnPlan', 'NetFaultPlan',
+           'count_committed', 'drive_host_churn', 'get_net_plan', 'get_plan',
+           'install', 'install_net', 'mark_in_spawned_worker',
+           'net_payload_action', 'on_item', 'on_net_connect', 'uninstall',
+           'uninstall_net']

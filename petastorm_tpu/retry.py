@@ -99,22 +99,43 @@ class RetryPolicy(object):
     follow ``initial_backoff_s * multiplier**k`` capped at ``max_backoff_s``,
     each scaled by ``1 ± jitter`` so synchronized workers do not re-stampede
     the endpoint that just throttled them.
+
+    ``deadline_s`` is an optional END-TO-END budget per :meth:`call`: once the
+    total elapsed time plus the next backoff sleep would exceed it, the retry
+    loop stops and re-raises the final error instead of burning the remaining
+    attempt count. Callers on a latency budget (the fabric's degraded
+    object-store fallback, anything feeding an accelerator step) bound their
+    worst case without giving up the early retries that usually succeed.
     """
 
     def __init__(self, max_attempts=4, initial_backoff_s=0.1, multiplier=2.0,
-                 max_backoff_s=5.0, jitter=0.25, classify=is_transient_io_error):
+                 max_backoff_s=5.0, jitter=0.25, classify=is_transient_io_error,
+                 deadline_s=None):
         if max_attempts < 1:
             raise ValueError('max_attempts must be >= 1, got {}'.format(max_attempts))
+        if deadline_s is not None and deadline_s <= 0:
+            raise ValueError('deadline_s must be positive, got {!r}'.format(deadline_s))
         self.max_attempts = max_attempts
         self.initial_backoff_s = initial_backoff_s
         self.multiplier = multiplier
         self.max_backoff_s = max_backoff_s
         self.jitter = jitter
         self.classify = classify
+        self.deadline_s = deadline_s
+
+    def with_deadline(self, deadline_s):
+        """A copy of this policy under an end-to-end ``deadline_s`` budget
+        (``None`` removes the budget)."""
+        return RetryPolicy(max_attempts=self.max_attempts,
+                           initial_backoff_s=self.initial_backoff_s,
+                           multiplier=self.multiplier,
+                           max_backoff_s=self.max_backoff_s,
+                           jitter=self.jitter, classify=self.classify,
+                           deadline_s=deadline_s)
 
     def _key(self):
         return (self.max_attempts, self.initial_backoff_s, self.multiplier,
-                self.max_backoff_s, self.jitter, self.classify)
+                self.max_backoff_s, self.jitter, self.classify, self.deadline_s)
 
     def __eq__(self, other):
         return type(self) is type(other) and self._key() == other._key()
@@ -133,6 +154,7 @@ class RetryPolicy(object):
         runs after each backoff sleep, before the re-attempt — e.g. reopening
         a broken stream."""
         attempt = 1
+        t0 = time.monotonic() if self.deadline_s is not None else None
         while True:
             try:
                 if FAULT_POINT is not None:
@@ -142,6 +164,11 @@ class RetryPolicy(object):
                 if attempt >= self.max_attempts or not self.classify(e):
                     raise
                 sleep_s = self.backoff_s(attempt)
+                if t0 is not None and \
+                        (time.monotonic() - t0) + sleep_s > self.deadline_s:
+                    # the end-to-end budget is spent: sleeping and retrying
+                    # would blow the deadline — surface the final error now
+                    raise
                 logger.warning('Transient storage error (attempt %d/%d, retrying in %.2fs): %s',
                                attempt, self.max_attempts, sleep_s, e)
                 time.sleep(sleep_s)
@@ -284,17 +311,23 @@ def wrap_retrying(fs, policy=None):
     return pafs.PyFileSystem(RetryingHandler(fs, policy))
 
 
-def fetch_range(fs, path, offset, length, policy=None):
+def fetch_range(fs, path, offset, length, policy=None, deadline_s=None):
     """Read exactly ``[offset, offset + length)`` of ``path`` as ONE retried
     unit: each attempt opens a FRESH stream (a positional read that failed
     leaves an object-store stream in an unknown state), reads the range, and
     closes it. A short body raises and is classified transient, so a truncated
     transfer retries instead of caching garbage.
 
+    ``deadline_s`` (optional) bounds the whole retried fetch end to end — the
+    fabric's degraded fallback path passes its remaining transfer budget here
+    so a throttling object store cannot stall a batch past the deadline.
+
     This is the chunk store's fetch primitive. ``fs`` may be raw or already
     retry-wrapped — in the wrapped case the inner ops retry individually too,
     which only tightens the elasticity."""
     policy = policy or RetryPolicy()
+    if deadline_s is not None:
+        policy = policy.with_deadline(deadline_s)
 
     def _attempt():
         f = fs.open_input_file(path)

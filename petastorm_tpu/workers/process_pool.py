@@ -426,6 +426,14 @@ class ProcessPool(object):
                 and 'flight_dir' not in worker_setup_args:
             worker_setup_args = dict(worker_setup_args,
                                      flight_dir=os.path.dirname(flight.path))
+        # an installed chunk fabric ships its fetch-only config the same way:
+        # worker processes miss on the same chunkstore and should try pod
+        # peers before the object store, exactly like the consumer does
+        if isinstance(worker_setup_args, dict) and 'fabric' not in worker_setup_args:
+            from petastorm_tpu import fabric
+            fabric_cfg = fabric.shippable_config()
+            if fabric_cfg is not None:
+                worker_setup_args = dict(worker_setup_args, fabric=fabric_cfg)
 
         # spawn (NOT fork): forked children inherit locked mutexes/threads from
         # Arrow, JAX, etc. (reference process_pool.py:15-17 for the JVM analog)
@@ -1275,6 +1283,17 @@ def _worker_bootstrap(worker_id, main_pid, setup_blob, vent_addr, result_addr, c
     flight_run_dir = (worker_setup_args.pop('flight_dir', None)
                       if isinstance(worker_setup_args, dict) else None)
     blackbox.maybe_enable('worker{}'.format(worker_id), run_dir=flight_run_dir)
+    # a shipped fabric config installs a fetch-only node (no server, no
+    # lease) so this worker's chunk misses try pod peers first. Popped — it
+    # is pool plumbing, not the worker's setup args.
+    fabric_cfg = (worker_setup_args.pop('fabric', None)
+                  if isinstance(worker_setup_args, dict) else None)
+    if fabric_cfg is not None:
+        from petastorm_tpu import fabric
+        try:
+            fabric.install_from_config(fabric_cfg)
+        except Exception as e:  # noqa: BLE001 - fabric is an optimization tier only
+            logger.warning('fabric install failed in worker %s: %s', worker_id, e)
 
     _start_orphan_monitor(main_pid)
 

@@ -82,15 +82,18 @@ class TestChunkStore:
         assert not store.contains('k1', 100)
 
     def test_concurrent_population_is_atomic(self, tmp_path):
-        """Racing writers (the process-pool scenario, here with threads) must
-        each observe a COMPLETE chunk: the rename is atomic, last write wins
-        with identical bytes."""
-        store = ChunkStore(str(tmp_path / 'c'))
+        """Racing writers from DIFFERENT processes (modeled as one store
+        instance per thread — the per-digest single-flight mutex is
+        per-process) must each observe a COMPLETE chunk: the rename is
+        atomic, last write wins with identical bytes. In-process racers are
+        single-flighted instead (test_fabric.py covers exactly-once)."""
         payload = bytes(range(256)) * 40
         barrier = threading.Barrier(4)
         results = []
 
         def worker():
+            store = ChunkStore(str(tmp_path / 'c'))
+
             def fetch():
                 barrier.wait(timeout=10)
                 return payload

@@ -1,0 +1,777 @@
+"""Fault-tolerant peer-to-peer chunk fabric (docs/fabric.md).
+
+Covers the fabric's failure contract end to end:
+
+  * wire protocol: framed round-trips, truncation/garbage rejection, the
+    end-to-end Deadline budget;
+  * per-peer circuit breaker state machine (trip, cooldown, half-open probe);
+  * client behavior against a live server: verified peer fetch, miss vs
+    failure classification, corrupt/reset/truncated/stalled payloads all
+    degrading to the object-store fallback without failing the fetch;
+  * mirror files pinned against eviction while being served to a peer;
+  * the chunkstore's per-digest single-flight (exactly-once population);
+  * the executable spec (analysis/protocol/fabric_spec.py): exhaustion over
+    the default scope above the state floor, a counterexample per seeded
+    mutation, and random-walk conformance between spec and runtime monitor;
+  * the chaos drill: >=3 hosts on a mock-remote store, one peer SIGKILLed
+    mid-transfer and another serving reset+truncated payloads — the reader
+    completes its epoch, mirrors hash-verify, no chunk is populated twice,
+    and every failed peer fetch is accounted as a fallback.
+"""
+
+import json
+import os
+import signal
+import socket
+import struct
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from petastorm_tpu import fabric, faults
+from petastorm_tpu.analysis.protocol import fabric_spec
+from petastorm_tpu.analysis.protocol.monitor import FabricMonitor
+from petastorm_tpu.chunkstore import ChunkCacheConfig, cache_diagnostics
+from petastorm_tpu.chunkstore.store import ChunkStore
+from petastorm_tpu.errors import ProtocolViolation
+from petastorm_tpu.fabric import protocol as P
+from petastorm_tpu.fabric.breaker import CircuitBreaker
+from petastorm_tpu.fabric.peers import PeerInfo, rank_peers
+from petastorm_tpu.fabric.server import FabricServer
+
+
+# ---------------------------------------------------------------------------
+# wire protocol
+# ---------------------------------------------------------------------------
+
+def _pair():
+    a, b = socket.socketpair()
+    a.settimeout(5.0)
+    b.settimeout(5.0)
+    return a, b
+
+
+class TestProtocol:
+    def test_frame_roundtrip(self):
+        a, b = _pair()
+        try:
+            deadline = P.Deadline(5.0)
+            P.send_frame(a, P.encode_request('chunk-key', 123), deadline, 1.0)
+            msg = P.decode_message(P.recv_frame(b, deadline, 1.0))
+            assert msg == {'v': 1, 'op': 'get', 'key': 'chunk-key',
+                           'length': 123}
+        finally:
+            a.close()
+            b.close()
+
+    def test_message_encodings(self):
+        ok = P.decode_message(P.encode_ok(42, 'ab' * 32))
+        assert ok['status'] == 'ok' and ok['length'] == 42
+        assert ok['sha256'] == 'ab' * 32
+        assert P.decode_message(P.encode_miss())['status'] == 'miss'
+        err = P.decode_message(P.encode_error('x' * 2000))
+        assert err['status'] == 'error' and len(err['message']) <= 512
+
+    def test_truncated_stream_is_protocol_error(self):
+        """EOF mid-payload must raise, never return short bytes."""
+        a, b = _pair()
+        try:
+            a.sendall(b'abc')
+            a.close()
+            with pytest.raises(P.FabricProtocolError):
+                P.recv_exactly(b, 10, P.Deadline(5.0), 1.0)
+        finally:
+            b.close()
+
+    def test_bad_magic_rejected(self):
+        a, b = _pair()
+        try:
+            a.sendall(struct.pack('>4sI', b'NOPE', 4) + b'body')
+            with pytest.raises(P.FabricProtocolError):
+                P.recv_frame(b, P.Deadline(5.0), 1.0)
+        finally:
+            a.close()
+            b.close()
+
+    def test_oversized_frame_rejected(self):
+        a, b = _pair()
+        try:
+            a.sendall(struct.pack('>4sI', P.MAGIC, P.MAX_FRAME_BYTES + 1))
+            with pytest.raises(P.FabricProtocolError):
+                P.recv_frame(b, P.Deadline(5.0), 1.0)
+        finally:
+            a.close()
+            b.close()
+
+    def test_content_hash_is_sha256(self):
+        import hashlib
+        assert P.content_hash(b'abc') == hashlib.sha256(b'abc').hexdigest()
+
+    def test_deadline_budget(self):
+        clock = [0.0]
+        d = P.Deadline(10.0, clock=lambda: clock[0])
+        assert d.remaining() == pytest.approx(10.0)
+        # per-op timeout is capped by BOTH the op cap and what remains
+        assert d.op_timeout(2.0) == pytest.approx(2.0)
+        clock[0] = 9.5
+        assert d.op_timeout(2.0) == pytest.approx(0.5)
+        clock[0] = 10.5
+        assert d.expired
+        with pytest.raises(P.FabricTimeout):
+            d.op_timeout(2.0)
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker
+# ---------------------------------------------------------------------------
+
+class TestCircuitBreaker:
+    def test_trips_after_threshold_and_reports_transition(self):
+        clock = [0.0]
+        b = CircuitBreaker(failure_threshold=3, reset_after_s=5.0,
+                           clock=lambda: clock[0])
+        assert b.state == fabric.CLOSED and b.allow()
+        assert b.record_failure() is False
+        assert b.record_failure() is False
+        assert b.record_failure() is True  # THIS failure opened it
+        assert b.state == fabric.OPEN
+        assert not b.allow()
+        assert b.record_failure() is False  # already open: no new transition
+
+    def test_half_open_probe_is_single_flight(self):
+        clock = [0.0]
+        b = CircuitBreaker(failure_threshold=1, reset_after_s=5.0,
+                           clock=lambda: clock[0])
+        assert b.record_failure() is True
+        clock[0] = 4.9
+        assert not b.allow()
+        clock[0] = 5.1
+        assert b.allow()            # the one half-open probe
+        assert b.state == fabric.HALF_OPEN
+        assert not b.allow()        # a second concurrent probe is refused
+        b.record_success()
+        assert b.state == fabric.CLOSED and b.allow()
+
+    def test_half_open_failure_reopens_immediately(self):
+        clock = [0.0]
+        b = CircuitBreaker(failure_threshold=3, reset_after_s=1.0,
+                           clock=lambda: clock[0])
+        for _ in range(3):
+            b.record_failure()
+        clock[0] = 1.5
+        assert b.allow()
+        assert b.record_failure() is True  # a failed probe re-opens at once
+        assert b.state == fabric.OPEN and not b.allow()
+
+    def test_success_resets_consecutive_count(self):
+        b = CircuitBreaker(failure_threshold=2)
+        b.record_failure()
+        b.record_success()
+        assert b.record_failure() is False  # count restarted
+        assert b.state == fabric.CLOSED
+
+
+# ---------------------------------------------------------------------------
+# rendezvous ranking
+# ---------------------------------------------------------------------------
+
+def test_rank_peers_is_stable_and_spreads_load():
+    peerset = [PeerInfo('p{}'.format(i), '127.0.0.1', 9000 + i)
+               for i in range(4)]
+    first = {}
+    for i in range(64):
+        digest = ChunkStore.digest('chunk-{}'.format(i))
+        ranked = rank_peers(digest, peerset)
+        assert sorted(p.host for p in ranked) == ['p0', 'p1', 'p2', 'p3']
+        assert [p.host for p in rank_peers(digest, peerset)] == \
+            [p.host for p in ranked]  # deterministic
+        first[ranked[0].host] = first.get(ranked[0].host, 0) + 1
+    # every peer is rendezvous-best for SOME chunks (no hot spot by design)
+    assert len(first) == 4
+
+
+# ---------------------------------------------------------------------------
+# runtime monitor
+# ---------------------------------------------------------------------------
+
+class TestFabricMonitor:
+    def test_double_populate_without_invalidation_raises(self):
+        m = FabricMonitor('t')
+        m.on_populate('d1', verified=True)
+        with pytest.raises(ProtocolViolation):
+            m.on_populate('d1', verified=True)
+
+    def test_invalidation_reopens_population(self):
+        m = FabricMonitor('t')
+        m.on_populate('d1', verified=True)
+        m.on_invalidate('d1')
+        m.on_populate('d1', verified=True)  # legitimate after eviction
+
+    def test_unverified_bytes_raise(self):
+        m = FabricMonitor('t')
+        with pytest.raises(ProtocolViolation):
+            m.on_populate('d1', verified=False)
+
+    def test_request_to_open_breaker_raises(self):
+        m = FabricMonitor('t')
+        m.on_request('pA', allowed=True)
+        with pytest.raises(ProtocolViolation):
+            m.on_request('pA', allowed=False)
+
+    def test_unknown_outcome_raises(self):
+        m = FabricMonitor('t')
+        m.on_outcome('k', 'peer')
+        m.on_outcome('k', 'fallback')
+        m.on_outcome('k', 'error')
+        with pytest.raises(ProtocolViolation):
+            m.on_outcome('k', 'gave-up')
+
+
+# ---------------------------------------------------------------------------
+# chunkstore: per-digest single-flight + send pins
+# ---------------------------------------------------------------------------
+
+def test_concurrent_ensure_fetches_exactly_once(tmp_path):
+    """The whole miss path is single-flight per digest: N threads demanding
+    the same chunk produce ONE fetch and ONE mirror write; the rest account
+    hits. This is the per-host exactly-once the fabric spec demands."""
+    store = ChunkStore(str(tmp_path / 'c'))
+    calls = []
+    gate = threading.Event()
+
+    def fetch():
+        calls.append(1)
+        gate.wait(timeout=5.0)  # hold the leader so followers really queue
+        return b'x' * 64
+
+    results = []
+    threads = [threading.Thread(
+        target=lambda: results.append(store.ensure('k', 64, fetch)))
+        for _ in range(6)]
+    for t in threads:
+        t.start()
+    time.sleep(0.2)  # let every follower reach the fetch mutex
+    gate.set()
+    for t in threads:
+        t.join(timeout=10)
+    assert len(calls) == 1
+    assert len(results) == 6
+    assert len({r[0] for r in results}) == 1
+    snap = store.stats_snapshot()
+    assert snap['misses'] == 1
+    assert snap['hits'] == 5
+
+
+def test_send_pin_refuses_eviction_then_releases(tmp_path):
+    """A mirror being streamed to a peer must survive the evictor: the
+    in-flight send holds a pin, the skip is counted, and eviction proceeds
+    once the transfer ends."""
+    store = ChunkStore(str(tmp_path / 'c'), size_limit_bytes=150)
+    path_a, _, _ = store.ensure('a', 100, lambda: b'a' * 100)
+    os.utime(path_a, ns=(1, 1))  # unambiguously the LRU victim
+    with store.pin_for_send('a') as pinned:
+        assert pinned == path_a
+        store.ensure('b', 100, lambda: b'b' * 100)  # 200 > 150: wants 'a'
+        assert os.path.exists(path_a), 'evictor truncated an in-flight send'
+        snap = store.stats_snapshot()
+        assert snap['evict_skipped_pinned'] >= 1
+    # pin released: the next over-budget population may now take 'a'
+    store.ensure('c', 100, lambda: b'c' * 100)
+    assert not os.path.exists(path_a)
+    snap = store.stats_snapshot()
+    assert snap['chunks_evicted'] >= 1
+
+
+def test_pin_for_send_reports_absent_chunk(tmp_path):
+    store = ChunkStore(str(tmp_path / 'c'))
+    with store.pin_for_send('never-populated') as pinned:
+        assert pinned is None
+
+
+# ---------------------------------------------------------------------------
+# client vs live server: the degradation matrix
+# ---------------------------------------------------------------------------
+
+class _StaticPeers(object):
+    """PeerRegistry stand-in: a fixed peer list, no membership machinery."""
+
+    def __init__(self, host_id, peerset):
+        self.host_id = host_id
+        self._peers = list(peerset)
+
+    def alive_peers(self):
+        return list(self._peers)
+
+
+def _serving_pair(tmp_path, chunks=('k1', 'k2', 'k3'), length=4096):
+    """A served store (populated) + an empty local store for the fetcher."""
+    served = ChunkStore(str(tmp_path / 'served'))
+    payloads = {}
+    for i, key in enumerate(chunks):
+        payloads[key] = bytes([i % 251]) * length
+        served.ensure(key, length, lambda i=i: payloads[chunks[i]])
+    local = ChunkStore(str(tmp_path / 'local'))
+    server = FabricServer(served).start()
+    return served, local, server, payloads
+
+
+def _client_for(local, server, tmp_path, **kwargs):
+    peerset = [PeerInfo('pA', server.endpoint[0], server.endpoint[1])]
+    defaults = dict(deadline_s=5.0, io_timeout_s=1.0, connect_timeout_s=1.0,
+                    failure_threshold=3, breaker_reset_s=5.0)
+    defaults.update(kwargs)
+    return fabric.FabricClient(local, _StaticPeers('pSelf', peerset),
+                               str(tmp_path / 'coord'), **defaults)
+
+
+class TestClientServer:
+    def test_peer_fetch_verified_end_to_end(self, tmp_path):
+        served, local, server, payloads = _serving_pair(tmp_path)
+        fallback_calls = []
+        try:
+            monitor = FabricMonitor('t')
+            with _client_for(local, server, tmp_path,
+                             monitor=monitor) as client:
+                def fetch_fn():
+                    fallback_calls.append(1)
+                    return payloads['k1']
+                data = client.fetch('k1', len(payloads['k1']), fetch_fn)
+            assert data == payloads['k1']
+            assert not fallback_calls, 'peer path must not touch the store'
+            assert monitor.events_checked > 0
+        finally:
+            server.stop()
+        stats_dir = os.path.join(str(tmp_path / 'coord'), 'fabric', 'stats')
+        files = os.listdir(stats_dir)
+        assert len(files) == 1
+        with open(os.path.join(stats_dir, files[0])) as f:
+            snap = json.load(f)
+        assert snap['peers']['pA']['hits'] == 1
+        assert snap['peers']['pA']['bytes'] == len(payloads['k1'])
+        assert snap['breakers']['pA'] == fabric.CLOSED
+
+    def test_peer_miss_falls_back_without_breaker_penalty(self, tmp_path):
+        served, local, server, payloads = _serving_pair(tmp_path)
+        try:
+            with _client_for(local, server, tmp_path) as client:
+                data = client.fetch('absent-key', 128, lambda: b'f' * 128)
+                assert data == b'f' * 128
+                # a miss means "healthy peer, does not mirror this chunk":
+                # the breaker must not move
+                assert client._breaker_for('pA').state == fabric.CLOSED
+        finally:
+            server.stop()
+
+    def test_corrupt_payload_discarded_and_degrades(self, tmp_path):
+        """A payload failing the sha256 must be discarded (fallback bytes
+        win) and count as a peer failure."""
+        served, local, server, payloads = _serving_pair(tmp_path)
+        faults.install_net(faults.NetFaultPlan(corrupt_payloads=1))
+        try:
+            with _client_for(local, server, tmp_path) as client:
+                data = client.fetch('k1', len(payloads['k1']),
+                                    lambda: payloads['k1'])
+                assert data == payloads['k1']
+                b = client._breaker_for('pA')
+                assert b.state == fabric.CLOSED  # one failure, threshold 3
+                # next fetch is clean again: the peer still serves
+                assert client.fetch('k2', len(payloads['k2']),
+                                    lambda: payloads['k2']) == payloads['k2']
+        finally:
+            faults.uninstall_net()
+            server.stop()
+
+    def test_reset_and_truncation_degrade_to_fallback(self, tmp_path):
+        served, local, server, payloads = _serving_pair(tmp_path)
+        faults.install_net(faults.NetFaultPlan(reset_payloads=1,
+                                               truncate_payloads=1))
+        try:
+            with _client_for(local, server, tmp_path) as client:
+                for key in ('k1', 'k2'):
+                    data = client.fetch(key, len(payloads[key]),
+                                        lambda key=key: payloads[key])
+                    assert data == payloads[key]
+        finally:
+            faults.uninstall_net()
+            server.stop()
+
+    def test_stalled_peer_bounded_by_deadline(self, tmp_path):
+        """A stalled transfer must cost at most the deadline budget, then
+        degrade — never wedge the fetch."""
+        served, local, server, payloads = _serving_pair(tmp_path)
+        faults.install_net(faults.NetFaultPlan(stall_payloads=1, stall_s=30.0))
+        try:
+            with _client_for(local, server, tmp_path, deadline_s=1.0,
+                             io_timeout_s=0.3) as client:
+                t0 = time.monotonic()
+                data = client.fetch('k1', len(payloads['k1']),
+                                    lambda: payloads['k1'])
+                elapsed = time.monotonic() - t0
+            assert data == payloads['k1']
+            assert elapsed < 10.0
+        finally:
+            faults.uninstall_net()
+            server.stop()
+
+    def test_breaker_opens_and_sheds_after_k_failures(self, tmp_path):
+        """A dead peer costs exactly K connection attempts, then zero: the
+        open breaker routes every later fetch straight to the fallback."""
+        served, local, server, payloads = _serving_pair(tmp_path)
+        endpoint = server.endpoint
+        server.stop()  # peer is now refusing connections
+        peerset = [PeerInfo('pA', endpoint[0], endpoint[1])]
+        connect_attempts = []
+        orig_on_net_connect = faults.on_net_connect
+
+        def counting_connect():
+            connect_attempts.append(1)
+            return orig_on_net_connect()
+
+        faults.on_net_connect = counting_connect
+        try:
+            with fabric.FabricClient(
+                    local, _StaticPeers('pSelf', peerset),
+                    str(tmp_path / 'coord'), deadline_s=5.0,
+                    io_timeout_s=1.0, connect_timeout_s=0.5,
+                    failure_threshold=2, breaker_reset_s=5.0) as client:
+                for i in range(5):
+                    data = client.fetch('k-{}'.format(i), 64, lambda: b'z' * 64)
+                    assert data == b'z' * 64
+                assert client._breaker_for('pA').state == fabric.OPEN
+        finally:
+            faults.on_net_connect = orig_on_net_connect
+        assert len(connect_attempts) == 2, \
+            'an open breaker must shed load (zero round trips)'
+
+
+# ---------------------------------------------------------------------------
+# executable spec + model checker
+# ---------------------------------------------------------------------------
+
+class TestFabricSpec:
+    def test_default_scope_exhausts_above_state_floor(self):
+        cfg = fabric_spec.FabricSpecConfig(**fabric_spec.DEFAULT_FABRIC_SCOPE)
+        res = fabric_spec.check(cfg, budget_s=300)
+        assert res.exhausted, 'default scope must exhaust in the tier-1 budget'
+        assert res.violation is None
+        assert res.states >= fabric_spec.DEFAULT_FABRIC_STATE_FLOOR
+
+    @pytest.mark.parametrize('mutation,invariant', [
+        ('skip_hash_check', 'hash_verified'),
+        ('double_populate', 'populate_once'),
+        ('request_open_peer', 'breaker_discipline'),
+        ('no_fallback', 'fetch_termination'),
+    ])
+    def test_every_mutation_yields_a_counterexample(self, mutation, invariant):
+        """Each seeded protocol bug must be CAUGHT: a checker that exhausts
+        cleanly over a broken protocol is checking nothing."""
+        cfg = fabric_spec.FabricSpecConfig(
+            mutation=mutation, **fabric_spec.DEFAULT_FABRIC_SCOPE)
+        res = fabric_spec.check(cfg, budget_s=300)
+        assert res.violation is not None
+        assert res.violation == invariant
+        assert res.trace, 'a counterexample must carry its trace'
+
+    @pytest.mark.parametrize('mutation', ['skip_hash_check', 'double_populate',
+                                          'request_open_peer'])
+    def test_counterexample_replays_into_monitor(self, mutation):
+        """The runtime monitor is the spec's observable projection: a safety
+        counterexample trace must trip it too."""
+        cfg = fabric_spec.FabricSpecConfig(
+            mutation=mutation, **fabric_spec.DEFAULT_FABRIC_SCOPE)
+        res = fabric_spec.check(cfg, budget_s=300)
+        with pytest.raises(ProtocolViolation):
+            fabric_spec.replay_into_monitor(res.trace, FabricMonitor('t'))
+
+    def test_random_walks_conform_to_monitor(self):
+        """Healthy-protocol walks must never trip the monitor (no false
+        positives), across many seeds."""
+        cfg = fabric_spec.FabricSpecConfig(**fabric_spec.DEFAULT_FABRIC_SCOPE)
+        checked = 0
+        for seed in range(25):
+            trace, violation = fabric_spec.random_walk(cfg, seed=seed)
+            assert violation is None, \
+                'seed {}: healthy walk hit {}'.format(seed, violation)
+            monitor = FabricMonitor('walk-{}'.format(seed))
+            fabric_spec.replay_into_monitor(trace, monitor)
+            checked += monitor.events_checked
+        assert checked > 0
+
+    def test_modelcheck_cli_exit_code_contract(self):
+        """--fabric honors the worker/serve/elastic exit-code contract:
+        0 exhausted-clean, 1 counterexample, 2 usage, 3 below the floor."""
+        base = [sys.executable, '-m',
+                'petastorm_tpu.analysis.protocol.modelcheck']
+        clean = subprocess.run(
+            base + ['--fabric', '--budget-s', '300',
+                    '--min-states',
+                    str(fabric_spec.DEFAULT_FABRIC_STATE_FLOOR)],
+            capture_output=True, text=True, timeout=420)
+        assert clean.returncode == 0, clean.stdout + clean.stderr
+        assert 'exhausted: all invariants hold' in clean.stdout
+
+        bad = subprocess.run(
+            base + ['--fabric', '--mutate', 'double_populate',
+                    '--budget-s', '300'],
+            capture_output=True, text=True, timeout=420)
+        assert bad.returncode == 1, bad.stdout + bad.stderr
+        assert 'counterexample' in bad.stdout
+
+        usage = subprocess.run(base + ['--fabric', '--elastic'],
+                               capture_output=True, text=True, timeout=120)
+        assert usage.returncode == 2
+        assert 'mutually exclusive' in usage.stderr
+
+
+# ---------------------------------------------------------------------------
+# diagnose --fabric
+# ---------------------------------------------------------------------------
+
+def test_diagnose_fabric_merges_stats(tmp_path):
+    from petastorm_tpu.observability import diagnose
+    stats_dir = tmp_path / 'coord' / 'fabric' / 'stats'
+    stats_dir.mkdir(parents=True)
+    (stats_dir / 'hA-pid1.json').write_text(json.dumps({
+        'host': 'hA',
+        'peers': {'pX': {'hits': 4, 'failures': 1, 'fallbacks': 1,
+                         'bytes': 4096, 'latency_sum': 0.2, 'latency_n': 4}},
+        'breakers': {'pX': 'closed'}}))
+    (stats_dir / 'hB-pid2.json').write_text(json.dumps({
+        'host': 'hB',
+        'peers': {'pX': {'hits': 2, 'failures': 3, 'fallbacks': 3,
+                         'bytes': 2048, 'latency_sum': 0.1, 'latency_n': 2}},
+        'breakers': {'pX': 'open'}}))
+    table = diagnose.fabric_peer_table(str(tmp_path / 'coord'))
+    assert table['pX']['hits'] == 6
+    assert table['pX']['failures'] == 4
+    assert table['pX']['fallbacks'] == 4
+    assert table['pX']['bytes'] == 6144
+    assert table['pX']['breaker'] == 'open'  # worst observed view wins
+    assert table['pX']['mean_latency_ms'] == pytest.approx(50.0)
+    rendered = diagnose.format_fabric_peers(table)
+    assert 'pX' in rendered and 'open' in rendered
+    assert diagnose.diagnose_fabric(str(tmp_path / 'coord')) == 0
+    assert diagnose.diagnose_fabric(str(tmp_path / 'empty')) == 1
+
+
+# ---------------------------------------------------------------------------
+# the chaos drill
+# ---------------------------------------------------------------------------
+
+def _native_available():
+    try:
+        from petastorm_tpu import native
+    except ImportError:
+        return False
+    return native.is_available()
+
+
+def _write_raw_store(tmp_path, rows=48, image_size=16):
+    from petastorm_tpu.codecs import RawTensorCodec, ScalarCodec
+    from petastorm_tpu.etl.dataset_metadata import write_petastorm_dataset
+    from petastorm_tpu.unischema import Unischema, UnischemaField
+
+    schema = Unischema('Raw', [
+        UnischemaField('image', np.uint8, (image_size, image_size, 3),
+                       RawTensorCodec(), False),
+        UnischemaField('label', np.int64, (), ScalarCodec(np.int64), False),
+    ])
+    rng = np.random.default_rng(0)
+    data = [{'image': rng.integers(0, 255, (image_size, image_size, 3),
+                                   np.uint8),
+             'label': int(i)} for i in range(rows)]
+    store = str(tmp_path / 'raw')
+    write_petastorm_dataset('file://' + store, schema, iter(data),
+                            rows_per_row_group=8, compression='none')
+    return store, data
+
+
+def _chunk_files(root):
+    out = {}
+    for dirpath, _dirs, files in os.walk(root):
+        for name in files:
+            if name.endswith('.chunk'):
+                out[name[:-len('.chunk')]] = os.path.join(dirpath, name)
+    return out
+
+
+@pytest.mark.skipif(not _native_available(),
+                    reason='chunk mirrors need the native page scanner')
+def test_chaos_drill_sigkill_and_network_faults(tmp_path):
+    """The drill from docs/fabric.md: three hosts on one mock-remote store.
+    Peer A (a real subprocess) stalls every payload and is SIGKILLed
+    mid-transfer; peer B serves one reset and one truncated payload; host C
+    reads a full epoch through a thread pool. C must finish the epoch with
+    byte-correct data, every mirror hash-verified against B's, exactly-once
+    population, and peer hits + fallbacks exactly accounting every miss."""
+    from petastorm_tpu import make_reader
+
+    store_path, data = _write_raw_store(tmp_path)
+    url = 'mock-remote://' + store_path
+    coord = str(tmp_path / 'coord')
+    marker = str(tmp_path / 'pA-request')
+    ready = str(tmp_path / 'pA-ready')
+
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    env['PYTHONPATH'] = (os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))) + os.pathsep + env.get('PYTHONPATH', ''))
+    proc_a = subprocess.Popen(
+        [sys.executable, '-m', 'petastorm_tpu.fabric._peerproc',
+         '--url', url, '--coord', coord, '--host', 'pA',
+         '--cache-root', str(tmp_path / 'cacheA'), '--lease-s', '2.0',
+         '--stall-s', '30.0', '--request-marker', marker,
+         '--ready-file', ready], env=env)
+
+    cache_b = ChunkCacheConfig(str(tmp_path / 'cacheB'))
+    cache_c = ChunkCacheConfig(str(tmp_path / 'cacheC'))
+    node_b = node_c = None
+    killed = []
+
+    def killer():
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if os.path.exists(marker):
+                os.kill(proc_a.pid, signal.SIGKILL)  # mid-transfer: A is
+                killed.append(True)                  # stalling inside a send
+                return
+            time.sleep(0.05)
+
+    try:
+        # peer B: warm its full mirror (no fabric yet), then serve it
+        with make_reader(url, reader_pool_type='dummy',
+                         shuffle_row_groups=False,
+                         chunk_cache=cache_b) as reader:
+            for _ in reader:
+                pass
+        node_b = fabric.start_node(fabric.FabricConfig(
+            coord, 'pB', cache_b, lease_s=2.0))
+
+        deadline = time.monotonic() + 120
+        while not os.path.exists(ready):
+            assert proc_a.poll() is None, 'peer A died during warmup'
+            assert time.monotonic() < deadline, 'peer A never became ready'
+            time.sleep(0.1)
+
+        # peer B mangles its first two payloads
+        faults.install_net(faults.NetFaultPlan(reset_payloads=1,
+                                               truncate_payloads=1))
+        kill_thread = threading.Thread(target=killer, daemon=True)
+        kill_thread.start()
+
+        node_c = fabric.start_node(
+            fabric.FabricConfig(coord, 'pC', cache_c, lease_s=2.0,
+                                deadline_s=1.5, io_timeout_s=0.5,
+                                connect_timeout_s=0.5, failure_threshold=3,
+                                breaker_reset_s=5.0),
+            monitor=FabricMonitor('drill'))
+        fabric.install(node_c)
+        with make_reader(url, reader_pool_type='thread', workers_count=3,
+                         shuffle_row_groups=False, num_epochs=1,
+                         chunk_cache=cache_c) as reader:
+            rows = {int(r.label): r.image for r in reader}
+        kill_thread.join(timeout=60)
+    finally:
+        fabric.uninstall()
+        faults.uninstall_net()
+        if node_c is not None:
+            node_c.stop()
+        if node_b is not None:
+            node_b.stop()
+        proc_a.kill()
+        proc_a.wait(timeout=30)
+
+    # the epoch completed with byte-correct data despite every fault
+    assert sorted(rows) == [row['label'] for row in data]
+    for row in data:
+        np.testing.assert_array_equal(rows[row['label']], row['image'])
+    assert killed, 'peer A was never killed — the drill did not run'
+    assert os.path.exists(marker), 'peer A never received a request'
+
+    # every mirror hash-verifies: C's chunk files must be byte-identical to
+    # B's warm mirror of the same digests
+    files_b = _chunk_files(cache_b.root)
+    files_c = _chunk_files(cache_c.root)
+    assert files_c, 'host C mirrored nothing'
+    for digest, path in files_c.items():
+        assert digest in files_b
+        with open(path, 'rb') as fc, open(files_b[digest], 'rb') as fb:
+            assert fc.read() == fb.read(), \
+                'mirror {} differs from the reference'.format(digest)
+
+    # exactly-once population per host: one fetch per distinct chunk
+    # (demand misses + prefetch fetches together cover the mirror exactly),
+    # and a second epoch over the warm mirror adds none
+    diag = cache_diagnostics(cache_c)
+    populated = (diag['chunk_cache_misses'] +
+                 diag['chunk_cache_prefetch_chunks'])
+    assert populated == len(files_c)
+    with make_reader(url, reader_pool_type='dummy', shuffle_row_groups=False,
+                     num_epochs=1, chunk_cache=cache_c) as reader:
+        for _ in reader:
+            pass
+    diag2 = cache_diagnostics(cache_c)
+    assert (diag2['chunk_cache_misses'] +
+            diag2['chunk_cache_prefetch_chunks']) == populated
+
+    # accounting: every miss resolved as a peer copy or a fallback — and
+    # every failed peer fetch is visible as a fallback, never a retry loop
+    stats_path = os.path.join(coord, 'fabric', 'stats',
+                              'pC-pid{}.json'.format(os.getpid()))
+    with open(stats_path) as f:
+        stats = json.load(f)
+    peer_hits = sum(s['hits'] for s in stats['peers'].values())
+    fallbacks = sum(s['fallbacks'] for s in stats['peers'].values())
+    assert peer_hits + fallbacks == populated
+    assert peer_hits > 0, 'no chunk ever rode the fabric'
+    assert fallbacks > 0, 'the faults never forced a fallback'
+    # the stalled/killed peer contributed failures, never a hit
+    assert stats['peers'].get('pA', {}).get('hits', 0) == 0
+    assert stats['peers'].get('pA', {}).get('failures', 0) >= 1
+
+
+@pytest.mark.skipif(not _native_available(),
+                    reason='chunk mirrors need the native page scanner')
+def test_healthy_two_host_fabric_copies_chunks_once(tmp_path):
+    """No faults: host 2's epoch sources every chunk from host 1's mirror —
+    zero object-store fallbacks after the first host's reads."""
+    from petastorm_tpu import make_reader
+
+    store_path, data = _write_raw_store(tmp_path, rows=24)
+    url = 'mock-remote://' + store_path
+    coord = str(tmp_path / 'coord')
+    cache_1 = ChunkCacheConfig(str(tmp_path / 'cache1'))
+    cache_2 = ChunkCacheConfig(str(tmp_path / 'cache2'))
+    node_1 = node_2 = None
+    try:
+        with make_reader(url, reader_pool_type='dummy',
+                         shuffle_row_groups=False,
+                         chunk_cache=cache_1) as reader:
+            for _ in reader:
+                pass
+        node_1 = fabric.start_node(fabric.FabricConfig(coord, 'h1', cache_1))
+        node_2 = fabric.start_node(fabric.FabricConfig(coord, 'h2', cache_2),
+                                   monitor=FabricMonitor('healthy'))
+        fabric.install(node_2)
+        with make_reader(url, reader_pool_type='thread', workers_count=2,
+                         shuffle_row_groups=False, num_epochs=1,
+                         chunk_cache=cache_2) as reader:
+            labels = sorted(int(r.label) for r in reader)
+        assert labels == [row['label'] for row in data]
+    finally:
+        fabric.uninstall()
+        if node_2 is not None:
+            node_2.stop()
+        if node_1 is not None:
+            node_1.stop()
+    stats_path = os.path.join(coord, 'fabric', 'stats',
+                              'h2-pid{}.json'.format(os.getpid()))
+    with open(stats_path) as f:
+        stats = json.load(f)
+    diag = cache_diagnostics(cache_2)
+    assert stats['peers']['h1']['hits'] == (
+        diag['chunk_cache_misses'] + diag['chunk_cache_prefetch_chunks'])
+    assert sum(s['fallbacks'] for s in stats['peers'].values()) == 0

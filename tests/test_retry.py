@@ -178,6 +178,61 @@ def test_policy_backoff_bounded():
 def test_policy_validates():
     with pytest.raises(ValueError):
         RetryPolicy(max_attempts=0)
+    with pytest.raises(ValueError):
+        RetryPolicy(deadline_s=0)
+    with pytest.raises(ValueError):
+        RetryPolicy(deadline_s=-1.0)
+
+
+def test_policy_deadline_cuts_retries_short():
+    """With an end-to-end deadline the policy surfaces the last error as soon
+    as the NEXT backoff would blow the budget — long before max_attempts."""
+    import time as _time
+    p = RetryPolicy(max_attempts=50, initial_backoff_s=0.05, multiplier=1.0,
+                    max_backoff_s=0.05, jitter=0.0, deadline_s=0.12)
+    calls = []
+
+    def always_reset():
+        calls.append(1)
+        raise ConnectionResetError('reset')
+
+    t0 = _time.monotonic()
+    with pytest.raises(ConnectionResetError):
+        p.call(always_reset)
+    assert _time.monotonic() - t0 < 1.0
+    assert 1 <= len(calls) < 50
+
+
+def test_with_deadline_clones_without_mutating():
+    p = RetryPolicy(max_attempts=7, initial_backoff_s=0.01)
+    bounded = p.with_deadline(2.5)
+    assert bounded is not p
+    assert bounded.deadline_s == 2.5 and p.deadline_s is None
+    assert bounded.max_attempts == 7
+    # the budget participates in identity: configs differing only in
+    # deadline must not collapse under caching keyed by the policy
+    assert bounded != p and hash(bounded) != hash(p)
+    assert p.with_deadline(None) == p
+
+
+def test_fetch_range_deadline_bounds_the_whole_fetch(tmp_path):
+    """The fabric fallback hands its remaining transfer budget to
+    fetch_range: a store that keeps resetting must surface the error within
+    the budget instead of grinding through every attempt."""
+    import time as _time
+    from petastorm_tpu.retry import fetch_range
+    path = str(tmp_path / 'blob.bin')
+    with open(path, 'wb') as f:
+        f.write(b'q' * 1000)
+    flaky, _handler = _flaky_fs(
+        fail_reads=10**6,
+        exc_factory=lambda: ConnectionResetError('connection reset'))
+    slow = RetryPolicy(max_attempts=50, initial_backoff_s=0.05,
+                       multiplier=1.0, max_backoff_s=0.05, jitter=0.0)
+    t0 = _time.monotonic()
+    with pytest.raises(ConnectionResetError):
+        fetch_range(flaky, path, 0, 10, policy=slow, deadline_s=0.12)
+    assert _time.monotonic() - t0 < 1.0
 
 
 # ---------------------------------------------------------------------------
